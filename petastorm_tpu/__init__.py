@@ -1,0 +1,50 @@
+"""petastorm_tpu: a TPU-native Parquet tensor-ingest framework.
+
+Capabilities of uber/petastorm (tensor-aware Parquet datasets, sharded prefetching
+readers, codecs, predicates, NGram readout, framework adapters), re-architected for
+JAX on TPU: columnar Arrow host pipeline, device-sharded ``jax.Array`` delivery
+driven by the process mesh, and on-device (XLA/Pallas) decode/normalize ops.
+
+Import layering: this module and everything under the core layers (schema, codecs,
+etl, reader) are **jax-free** - host-side ETL never initializes the TPU.  JAX enters
+only via ``petastorm_tpu.jax`` (loader), ``petastorm_tpu.ops`` (kernels) and
+``petastorm_tpu.models``.
+"""
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
+from petastorm_tpu.errors import NoDataAvailableError, PetastormTpuError
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.transform import TransformSpec
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Field", "Schema", "TransformSpec",
+    "ScalarCodec", "NdarrayCodec", "CompressedNdarrayCodec", "CompressedImageCodec",
+    "PetastormTpuError", "NoDataAvailableError",
+    "make_reader", "make_batch_reader", "materialize_dataset",
+]
+
+
+def _lazy(module: str, symbol: str):
+    import importlib
+
+    try:
+        mod = importlib.import_module(module)
+    except ImportError as exc:  # pragma: no cover - only during partial builds
+        raise NotImplementedError(
+            f"{symbol} requires {module}, which is not present in this build") from exc
+    return getattr(mod, symbol)
+
+
+def make_reader(*args, **kwargs):
+    return _lazy("petastorm_tpu.reader", "make_reader")(*args, **kwargs)
+
+
+def make_batch_reader(*args, **kwargs):
+    return _lazy("petastorm_tpu.reader", "make_batch_reader")(*args, **kwargs)
+
+
+def materialize_dataset(*args, **kwargs):
+    return _lazy("petastorm_tpu.etl.writer", "materialize_dataset")(*args, **kwargs)

@@ -1,0 +1,131 @@
+"""Dtype mapping between numpy, Arrow, and JAX.
+
+The reference scatters dtype conversion across adapters (petastorm/tf_utils.py:27-44
+numpy->tf promotions; petastorm/pytorch.py:39-69 torch promotions;
+petastorm/unischema.py:464-497 arrow->numpy). Here the mapping lives in one module so
+every layer (schema inference, codec storage types, device delivery) agrees.
+
+TPU note: TPUs have no native float64/int64 compute advantage and uint16/uint32 are
+promoted exactly like the reference adapters do, but promotion happens once, at device
+feed time (petastorm_tpu/jax/loader.py), never in the storage layer.
+"""
+
+from __future__ import annotations
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.errors import SchemaError
+
+# ---------------------------------------------------------------------------
+# numpy <-> arrow
+# ---------------------------------------------------------------------------
+
+_NUMPY_TO_ARROW = {
+    np.dtype("bool"): pa.bool_(),
+    np.dtype("int8"): pa.int8(),
+    np.dtype("int16"): pa.int16(),
+    np.dtype("int32"): pa.int32(),
+    np.dtype("int64"): pa.int64(),
+    np.dtype("uint8"): pa.uint8(),
+    np.dtype("uint16"): pa.uint16(),
+    np.dtype("uint32"): pa.uint32(),
+    np.dtype("uint64"): pa.uint64(),
+    np.dtype("float16"): pa.float16(),
+    np.dtype("float32"): pa.float32(),
+    np.dtype("float64"): pa.float64(),
+}
+
+# Arrow logical types that decay to the same numpy dtype.  Mirrors the inference
+# table the reference builds in petastorm/unischema.py:302-353 (from_arrow_schema).
+_ARROW_TO_NUMPY = {
+    **{v: k for k, v in _NUMPY_TO_ARROW.items()},
+    pa.string(): np.dtype("object"),
+    pa.large_string(): np.dtype("object"),
+    pa.binary(): np.dtype("object"),
+    pa.large_binary(): np.dtype("object"),
+    pa.date32(): np.dtype("datetime64[D]"),
+    pa.date64(): np.dtype("datetime64[ms]"),
+}
+
+
+def numpy_to_arrow(dtype: np.dtype) -> pa.DataType:
+    """Arrow storage type for a numpy dtype (scalars only)."""
+    dtype = np.dtype(dtype)
+    if dtype in _NUMPY_TO_ARROW:
+        return _NUMPY_TO_ARROW[dtype]
+    if dtype.kind in ("U", "S", "O"):
+        return pa.string()
+    if dtype.kind == "M":  # datetime64
+        return pa.timestamp("ns")
+    raise SchemaError(f"No arrow mapping for numpy dtype {dtype!r}")
+
+
+def arrow_to_numpy(atype: pa.DataType) -> np.dtype:
+    """Numpy dtype for an arrow type; raises SchemaError for nested types."""
+    if atype in _ARROW_TO_NUMPY:
+        return _ARROW_TO_NUMPY[atype]
+    if pa.types.is_timestamp(atype):
+        return np.dtype(f"datetime64[{atype.unit}]")
+    if pa.types.is_decimal(atype):
+        return np.dtype("object")  # decimal.Decimal objects; promoted at feed time
+    if pa.types.is_dictionary(atype):
+        return arrow_to_numpy(atype.value_type)
+    raise SchemaError(f"No numpy mapping for arrow type {atype!r}")
+
+
+def is_list_of_scalars(atype: pa.DataType) -> bool:
+    return (pa.types.is_list(atype) or pa.types.is_large_list(atype)) and not (
+        pa.types.is_nested(atype.value_type)
+    )
+
+
+# ---------------------------------------------------------------------------
+# numpy -> jax feed dtype (promotions applied at device boundary)
+# ---------------------------------------------------------------------------
+
+# uint16/uint32 and 64-bit ints are promoted the way the reference adapters promote
+# for tf/torch (petastorm/tf_utils.py:27-44, petastorm/pytorch.py:39-69): JAX defaults
+# to 32-bit (jax_enable_x64 off), and TPUs prefer <=32-bit integer and bf16/f32 float.
+_JAX_FEED_PROMOTIONS = {
+    np.dtype("uint16"): np.dtype("int32"),
+    np.dtype("uint32"): np.dtype("int64"),
+    np.dtype("float64"): np.dtype("float32"),
+    np.dtype("int64"): np.dtype("int32"),
+    np.dtype("uint64"): np.dtype("int64"),
+}
+
+
+def jax_feed_dtype(dtype: np.dtype, keep_wide: bool = False) -> np.dtype:
+    """Dtype an array should be cast to before `jax.device_put`.
+
+    `keep_wide=True` disables the 64->32 narrowing (for users running jax_enable_x64).
+    Raises SchemaError for non-numeric kinds - strings/objects never go to device.
+    """
+    dtype = np.dtype(dtype)
+    if dtype.kind in ("U", "S", "O", "M", "m"):
+        raise SchemaError(
+            f"dtype {dtype!r} cannot be fed to a device; keep it host-side or"
+            " promote it explicitly (e.g. datetime64 -> int64 ns)"
+        )
+    if keep_wide and dtype in (np.dtype("int64"), np.dtype("uint64"), np.dtype("float64")):
+        return dtype if dtype != np.dtype("uint64") else np.dtype("int64")
+    return _JAX_FEED_PROMOTIONS.get(dtype, dtype)
+
+
+def sanitize_value(value, dtype: np.dtype):
+    """Coerce one python value to `dtype`'s python-compatible form for encoding.
+
+    Mirrors petastorm's scalar casting behavior (petastorm/codecs.py:189-238):
+    bool/int/float/str cast with range check left to numpy; Decimal passed through.
+    """
+    if isinstance(value, decimal.Decimal):
+        return value
+    dtype = np.dtype(dtype)
+    if dtype.kind in ("U", "S"):
+        return str(value)
+    if dtype.kind == "O":
+        return value
+    return np.asarray(value).astype(dtype, casting="same_kind").item()

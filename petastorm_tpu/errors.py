@@ -1,0 +1,40 @@
+"""Exception types for petastorm_tpu.
+
+Reference parity: petastorm/errors.py (NoDataAvailableError at errors.py:16-17).
+"""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all petastorm_tpu errors."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when a reader shard/predicate/selector combination selects no data.
+
+    Reference: petastorm/errors.py:16, raised at petastorm/reader.py:502-504 when
+    there are fewer rowgroups than shards.
+    """
+
+
+class SchemaError(PetastormTpuError):
+    """Schema definition, serialization, or validation failure."""
+
+
+class CodecError(PetastormTpuError):
+    """Codec encode/decode failure (bad dtype, non-compliant shape, ...)."""
+
+
+class MetadataError(PetastormTpuError):
+    """Dataset metadata is missing or unreadable (not a petastorm_tpu dataset)."""
+
+
+class ReaderClosedError(PetastormTpuError):
+    """Operation on a reader that has been stopped/joined."""
+
+
+class EpochNotFinishedError(PetastormTpuError):
+    """reset() called mid-epoch.
+
+    Reference prohibits mid-epoch reset (petastorm/reader.py:438-445); we keep the
+    same contract because in-flight work items would leak across epochs.
+    """

@@ -1,0 +1,87 @@
+"""User transforms applied on reader workers.
+
+Reference parity: petastorm/transform.py - TransformSpec(func, edit_fields,
+removed_fields, selected_fields) (transform.py:27-57) and ``transform_schema``
+deriving the post-transform schema (transform.py:60-89).
+
+Difference: the transform here is **columnar** - ``func`` receives a dict of numpy
+column arrays (one entry per field, batch-major) and returns the same, matching the
+batch path the reference applies via pandas (arrow_reader_worker.py:190-222).  A
+``row_transform`` convenience wraps a per-row function for row-path readers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from petastorm_tpu.errors import SchemaError
+from petastorm_tpu.schema import Field, Schema
+
+#: edit_fields entries: (name, numpy_dtype, shape, nullable)
+EditFieldT = Tuple[str, "np.dtype", Tuple[Optional[int], ...], bool]
+
+
+class TransformSpec:
+    def __init__(self,
+                 func: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+                 edit_fields: Optional[Sequence[EditFieldT]] = None,
+                 removed_fields: Optional[Sequence[str]] = None,
+                 selected_fields: Optional[Sequence[str]] = None):
+        self.func = func
+        self.edit_fields = list(edit_fields or [])
+        self.removed_fields = list(removed_fields or [])
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+    def __call__(self, columns: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = self.func(columns) if self.func is not None else dict(columns)
+        for name in self.removed_fields:
+            out.pop(name, None)
+        if self.selected_fields is not None:
+            out = {k: out[k] for k in self.selected_fields}
+        return out
+
+
+def transform_schema(schema: Schema, spec: TransformSpec) -> Schema:
+    """Derive the post-transform schema (reference: transform.py:60-89)."""
+    fields = list(schema)
+    by_name = {f.name: i for i, f in enumerate(fields)}
+    for name, dtype, shape, nullable in spec.edit_fields:
+        new = Field(name, np.dtype(dtype), tuple(shape), nullable=nullable)
+        if name in by_name:
+            fields[by_name[name]] = new
+        else:
+            by_name[name] = len(fields)
+            fields.append(new)
+    fields = [f for f in fields if f.name not in set(spec.removed_fields)]
+    if spec.selected_fields is not None:
+        missing = set(spec.selected_fields) - {f.name for f in fields}
+        if missing:
+            raise SchemaError(f"selected_fields {sorted(missing)} not in post-transform schema")
+        order = {n: i for i, n in enumerate(spec.selected_fields)}
+        fields = sorted((f for f in fields if f.name in order), key=lambda f: order[f.name])
+    return Schema(schema.name, fields)
+
+
+def row_transform(fn: Callable[[Dict[str, object]], Dict[str, object]]):
+    """Adapt a per-row dict->dict function to the columnar transform contract."""
+    def columnar(columns: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        names = list(columns)
+        n = len(columns[names[0]]) if names else 0
+        rows = [fn({k: columns[k][i] for k in names}) for i in range(n)]
+        if not rows:
+            return columns
+        out: Dict[str, np.ndarray] = {}
+        for k in rows[0]:
+            vals = [r[k] for r in rows]
+            first = np.asarray(vals[0])
+            if first.ndim > 0 and all(np.asarray(v).shape == first.shape for v in vals):
+                out[k] = np.stack([np.asarray(v) for v in vals])
+            else:
+                col = np.empty(len(vals), dtype=object)
+                for i, v in enumerate(vals):
+                    col[i] = v
+                out[k] = col if first.ndim > 0 else np.asarray(vals)
+        return out
+    return columnar

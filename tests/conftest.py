@@ -1,0 +1,25 @@
+"""Test configuration.
+
+JAX runs on a virtual 8-device CPU mesh in tests (multi-chip sharding is validated
+without TPU hardware, mirroring how the reference simulates multi-node sharding
+in-process - petastorm/tests/test_end_to_end.py:454).  The env vars must be set
+before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)

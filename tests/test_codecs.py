@@ -1,0 +1,162 @@
+"""Codec tests (reference model: petastorm/tests/test_codec_{scalar,ndarray,compressed_image}.py)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec, Codec,
+                                  NdarrayCodec, ScalarCodec, check_shape_compliance,
+                                  codec_from_json)
+from petastorm_tpu.errors import CodecError
+from petastorm_tpu.schema import Field
+
+
+def _roundtrip(codec, field, value):
+    return codec.decode(field, codec.encode(field, value))
+
+
+# -- scalar -------------------------------------------------------------------
+
+def test_scalar_roundtrip_int():
+    f = Field("x", np.int32)
+    assert _roundtrip(f.codec, f, 42) == 42
+    assert isinstance(_roundtrip(f.codec, f, 42), np.int32)
+
+
+def test_scalar_roundtrip_string():
+    f = Field("s", np.dtype("object"))
+    assert _roundtrip(ScalarCodec(), f, "hello") == "hello"
+
+
+def test_scalar_store_dtype_override():
+    codec = ScalarCodec(store_dtype="int64")
+    f = Field("x", np.int32, codec=codec)
+    assert codec.storage_type(f) == pa.int64()
+    assert codec_from_json(codec.to_json()) == codec
+
+
+def test_scalar_rejects_nonscalar_field():
+    f = Field("x", np.int32, (3,))
+    with pytest.raises(CodecError):
+        ScalarCodec().encode(f, np.zeros(3, np.int32))
+
+
+def test_scalar_decode_column():
+    f = Field("x", np.int16)
+    col = pa.array([1, 2, 3], type=pa.int16())
+    out = ScalarCodec().decode_column(f, col)
+    assert out.dtype == np.int16 and out.tolist() == [1, 2, 3]
+
+
+# -- ndarray ------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec_cls", [NdarrayCodec, CompressedNdarrayCodec])
+def test_ndarray_roundtrip(codec_cls, rng):
+    f = Field("m", np.float32, (3, 4), codec_cls())
+    value = rng.standard_normal((3, 4)).astype(np.float32)
+    out = _roundtrip(codec_cls(), f, value)
+    np.testing.assert_array_equal(out, value)
+
+
+@pytest.mark.parametrize("codec_cls", [NdarrayCodec, CompressedNdarrayCodec])
+def test_ndarray_dtype_mismatch(codec_cls):
+    f = Field("m", np.float32, (2,), codec_cls())
+    with pytest.raises(CodecError):
+        codec_cls().encode(f, np.zeros(2, np.float64))
+
+
+def test_ndarray_shape_wildcards(rng):
+    f = Field("m", np.uint8, (None, 2), NdarrayCodec())
+    value = rng.integers(0, 255, (7, 2), dtype=np.uint8)
+    np.testing.assert_array_equal(_roundtrip(NdarrayCodec(), f, value), value)
+    with pytest.raises(CodecError):
+        NdarrayCodec().encode(f, np.zeros((7, 3), np.uint8))
+
+
+def test_ndarray_decode_column_stacks_fixed_shape(rng):
+    f = Field("m", np.float32, (2, 2), NdarrayCodec())
+    codec = NdarrayCodec()
+    values = [rng.standard_normal((2, 2)).astype(np.float32) for _ in range(4)]
+    col = pa.array([codec.encode(f, v) for v in values], type=pa.binary())
+    out = codec.decode_column(f, col)
+    assert out.shape == (4, 2, 2) and out.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(out, np.stack(values))
+
+
+def test_ndarray_decode_column_variable_shape(rng):
+    f = Field("m", np.float32, (None,), NdarrayCodec())
+    codec = NdarrayCodec()
+    values = [np.ones(n, np.float32) for n in (1, 3)]
+    col = pa.array([codec.encode(f, v) for v in values], type=pa.binary())
+    out = codec.decode_column(f, col)
+    assert out.dtype == object and out[1].shape == (3,)
+
+
+# -- compressed image ---------------------------------------------------------
+
+def test_png_lossless_roundtrip(rng):
+    f = Field("im", np.uint8, (16, 12, 3), CompressedImageCodec("png"))
+    value = rng.integers(0, 255, (16, 12, 3), dtype=np.uint8)
+    out = _roundtrip(CompressedImageCodec("png"), f, value)
+    np.testing.assert_array_equal(out, value)  # png is lossless, incl. RGB order
+
+
+def test_png_uint16_grayscale(rng):
+    f = Field("im", np.uint16, (8, 8), CompressedImageCodec("png"))
+    value = rng.integers(0, 2 ** 16 - 1, (8, 8), dtype=np.uint16)
+    out = _roundtrip(CompressedImageCodec("png"), f, value)
+    np.testing.assert_array_equal(out, value)
+
+
+def test_jpeg_lossy_close(rng):
+    f = Field("im", np.uint8, (32, 32, 3), CompressedImageCodec("jpeg", quality=95))
+    value = np.full((32, 32, 3), 128, dtype=np.uint8)
+    out = _roundtrip(CompressedImageCodec("jpeg", quality=95), f, value)
+    assert out.shape == value.shape
+    assert np.abs(out.astype(int) - value.astype(int)).mean() < 10
+
+
+def test_jpeg_rejects_uint16():
+    f = Field("im", np.uint16, (8, 8), CompressedImageCodec("jpeg"))
+    with pytest.raises(CodecError):
+        CompressedImageCodec("jpeg").encode(f, np.zeros((8, 8), np.uint16))
+
+
+def test_image_codec_json_roundtrip():
+    codec = CompressedImageCodec("jpeg", quality=77)
+    again = codec_from_json(codec.to_json())
+    assert again == codec and again.image_codec == "jpeg"
+
+
+def test_unknown_image_format():
+    with pytest.raises(CodecError):
+        CompressedImageCodec("webp")
+
+
+# -- misc ---------------------------------------------------------------------
+
+def test_check_shape_compliance():
+    f = Field("m", np.float32, (None, 3))
+    check_shape_compliance(f, np.zeros((5, 3), np.float32))
+    with pytest.raises(CodecError):
+        check_shape_compliance(f, np.zeros((5, 4), np.float32))
+    with pytest.raises(CodecError):
+        check_shape_compliance(f, np.zeros((5,), np.float32))
+
+
+def test_codec_from_json_unknown():
+    with pytest.raises(CodecError):
+        codec_from_json({"codec": "nope"})
+
+
+def test_scalar_decode_column_nullable_int_preserves_none():
+    # arrow->numpy of int-with-nulls goes via float64 NaN; must not become INT_MIN
+    f = Field("x", np.int32, nullable=True)
+    out = ScalarCodec().decode_column(f, pa.array([1, None, 3], type=pa.int32()))
+    assert out.dtype == object
+    assert out[0] == 1 and out[1] is None and out[2] == 3
+
+
+def test_scalar_list_registered_from_codecs_module():
+    from petastorm_tpu.codecs import ScalarListCodec
+    assert codec_from_json({"codec": "scalar_list"}) == ScalarListCodec()

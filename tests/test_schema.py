@@ -1,0 +1,160 @@
+"""Schema system tests (reference model: petastorm/tests/test_unischema.py, 501 LoC)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import SchemaError
+from petastorm_tpu.schema import (SCHEMA_METADATA_KEY, Field, Schema, ScalarListCodec,
+                                  insert_explicit_nulls)
+
+
+def _schema():
+    return Schema("TestSchema", [
+        Field("id", np.int64),
+        Field("name", np.dtype("object"), codec=ScalarCodec()),
+        Field("image", np.uint8, (None, None, 3), CompressedImageCodec("png")),
+        Field("matrix", np.float32, (4, 5), NdarrayCodec()),
+        Field("maybe", np.float64, (), nullable=True),
+    ])
+
+
+def test_field_defaults_scalar_codec():
+    f = Field("x", np.int32)
+    assert isinstance(f.codec, ScalarCodec)
+    assert f.is_fixed_shape
+
+
+def test_field_defaults_ndarray_codec():
+    f = Field("x", np.float32, (3, 3))
+    assert isinstance(f.codec, NdarrayCodec)
+
+
+def test_field_eq_hash_codec_invariant():
+    # reference: unischema.py:40-85 - codec does not participate in identity
+    a = Field("x", np.float32, (3,), NdarrayCodec())
+    b = Field("x", np.float32, (3,), None)
+    assert a == b and hash(a) == hash(b)
+    assert a != Field("x", np.float64, (3,))
+
+
+def test_attribute_access_and_getitem():
+    s = _schema()
+    assert s.id.dtype == np.int64
+    assert s["matrix"].shape == (4, 5)
+    with pytest.raises(AttributeError):
+        _ = s.nope
+
+
+def test_duplicate_field_rejected():
+    with pytest.raises(SchemaError):
+        Schema("s", [Field("a", np.int32), Field("a", np.int64)])
+
+
+def test_view_by_name_and_regex():
+    s = _schema()
+    v = s.view(["id", "ma.*"])
+    assert [f.name for f in v] == ["id", "matrix", "maybe"]
+
+
+def test_view_fullmatch_semantics():
+    # 'ma' must NOT match 'matrix' (fullmatch, reference unischema.py:434-461)
+    s = _schema()
+    with pytest.raises(SchemaError):
+        s.view(["ma"])
+
+
+def test_view_by_field_instance():
+    s = _schema()
+    v = s.view([s.id, s.matrix])
+    assert [f.name for f in v] == ["id", "matrix"]
+    with pytest.raises(SchemaError):
+        s.view([Field("other", np.int8)])
+
+
+def test_namedtuple_roundtrip_and_cache():
+    s = _schema()
+    t1 = s.make_namedtuple_type()
+    t2 = s.make_namedtuple_type()
+    assert t1 is t2
+    row = s.make_namedtuple(id=1, name="a", image=None, matrix=None, maybe=None)
+    assert row.id == 1 and row.name == "a"
+    with pytest.raises(SchemaError):
+        s.make_namedtuple(id=1)
+
+
+def test_json_roundtrip():
+    s = _schema()
+    s2 = Schema.from_json(s.to_json())
+    assert s2 == s
+    assert [f.codec for f in s2] == [f.codec for f in s]
+    assert s2.name == "TestSchema"
+
+
+def test_arrow_storage_schema():
+    s = _schema()
+    a = s.as_arrow_schema()
+    assert a.field("id").type == pa.int64()
+    assert a.field("image").type == pa.binary()
+    assert a.field("maybe").nullable
+
+
+def test_from_arrow_schema_inference():
+    arrow = pa.schema([
+        pa.field("a", pa.int32()),
+        pa.field("b", pa.string()),
+        pa.field("c", pa.list_(pa.float32())),
+    ])
+    s = Schema.from_arrow_schema(arrow, partition_columns=["part"])
+    assert s.a.dtype == np.int32 and s.a.shape == ()
+    assert s.b.dtype == np.dtype("object")
+    assert s.c.shape == (None,) and isinstance(s.c.codec, ScalarListCodec)
+    assert "part" in s
+
+
+def test_from_arrow_schema_rejects_nested():
+    arrow = pa.schema([pa.field("s", pa.struct([pa.field("x", pa.int32())]))])
+    with pytest.raises(SchemaError):
+        Schema.from_arrow_schema(arrow)
+
+
+def test_encode_row_nullability():
+    s = _schema()
+    with pytest.raises(SchemaError):
+        s.encode_row({"id": None, "name": "x", "image": None, "matrix": None, "maybe": None})
+    with pytest.raises(SchemaError):
+        s.encode_row({"bogus": 1})
+
+
+def test_encode_row_applies_codecs():
+    s = Schema("s", [Field("m", np.float32, (2, 2), NdarrayCodec()),
+                     Field("i", np.int32)])
+    out = s.encode_row({"m": np.zeros((2, 2), np.float32), "i": 7})
+    assert isinstance(out["m"], bytes) and out["i"] == 7
+
+
+def test_insert_explicit_nulls():
+    s = _schema()
+    row = insert_explicit_nulls(s, {"id": 1, "name": "n", "image": 0, "matrix": 0})
+    assert row["maybe"] is None
+    with pytest.raises(SchemaError):
+        insert_explicit_nulls(s, {"name": "n"})
+
+
+def test_metadata_key_is_bytes():
+    assert isinstance(SCHEMA_METADATA_KEY, bytes)
+
+
+def test_view_exact_name_with_regex_metachars():
+    s = Schema("s", [Field("a+b", np.int32), Field("axb", np.int32), Field("a.b", np.int32)])
+    assert [f.name for f in s.view(["a+b"])] == ["a+b"]
+    assert [f.name for f in s.view(["a.b"])] == ["a.b"]  # exact wins over regex
+
+
+def test_json_roundtrip_unicode_and_bytes_dtypes():
+    s = Schema("s", [Field("u", np.dtype("U10")), Field("b", np.dtype("S5")),
+                     Field("o", np.dtype("object"))])
+    s2 = Schema.from_json(s.to_json())
+    assert s2 == s
+    assert s2.u.dtype == np.dtype("U10") and s2.b.dtype == np.dtype("S5")

@@ -1,0 +1,45 @@
+"""TransformSpec tests (reference model: petastorm/transform.py contract)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import SchemaError
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.transform import TransformSpec, row_transform, transform_schema
+
+
+def _schema():
+    return Schema("s", [Field("a", np.int32), Field("b", np.float32, (2,)),
+                        Field("c", np.int64)])
+
+
+def test_transform_schema_edit_and_remove():
+    spec = TransformSpec(edit_fields=[("b", np.float64, (4,), False),
+                                      ("d", np.int8, (), True)],
+                         removed_fields=["c"])
+    out = transform_schema(_schema(), spec)
+    assert [f.name for f in out] == ["a", "b", "d"]
+    assert out.b.dtype == np.float64 and out.b.shape == (4,)
+    assert out.d.nullable
+
+
+def test_transform_schema_selected_fields_order():
+    spec = TransformSpec(selected_fields=["c", "a"])
+    out = transform_schema(_schema(), spec)
+    assert [f.name for f in out] == ["c", "a"]
+    with pytest.raises(SchemaError):
+        transform_schema(_schema(), TransformSpec(selected_fields=["zz"]))
+
+
+def test_columnar_transform_applies():
+    spec = TransformSpec(func=lambda cols: {**cols, "a": cols["a"] * 2},
+                         removed_fields=["c"])
+    out = spec({"a": np.array([1, 2]), "b": np.zeros((2, 2)), "c": np.array([0, 0])})
+    assert out["a"].tolist() == [2, 4] and "c" not in out
+
+
+def test_row_transform_wrapper():
+    fn = row_transform(lambda row: {"a": row["a"] + 1, "v": np.full(3, row["a"])})
+    out = fn({"a": np.array([1, 2, 3])})
+    assert out["a"].tolist() == [2, 3, 4]
+    assert out["v"].shape == (3, 3)
