@@ -128,4 +128,13 @@ def sanitize_value(value, dtype: np.dtype):
         return str(value)
     if dtype.kind == "O":
         return value
-    return np.asarray(value).astype(dtype, casting="same_kind").item()
+    try:
+        arr = np.asarray(value)
+        out = arr.astype(dtype)
+    except (OverflowError, TypeError, ValueError) as exc:
+        raise SchemaError(f"Value {value!r} cannot be stored as dtype {dtype}: {exc}") from exc
+    # int/bool targets must preserve the exact value (catches overflow/truncation);
+    # float targets may lose precision (f64 -> f32 is a legitimate narrowing)
+    if dtype.kind in "uib" and not np.array_equal(out.astype(np.float64), arr.astype(np.float64)):
+        raise SchemaError(f"Value {value!r} does not fit dtype {dtype} without loss")
+    return out.item()

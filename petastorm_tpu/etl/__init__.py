@@ -1,0 +1,20 @@
+"""Dataset ETL: metadata, writers, rowgroup indexing.
+
+Reference parity: petastorm/etl/ (~1,000 LoC) - dataset_metadata.py (schema +
+rowgroup-count stamping, load_row_groups), rowgroup_indexing.py, rowgroup_indexers.py.
+Spark-free: all ETL here is pyarrow-native; Spark interop lives in petastorm_tpu/spark.
+"""
+
+from petastorm_tpu.etl.indexing import (FieldNotNullIndexer, RowGroupIndexer,
+                                        SingleFieldIndexer, build_rowgroup_index,
+                                        get_row_group_indexes)
+from petastorm_tpu.etl.metadata import (DatasetInfo, RowGroupRef, infer_or_load_schema,
+                                        load_row_groups, open_dataset)
+from petastorm_tpu.etl.writer import materialize_dataset, write_dataset
+
+__all__ = [
+    "DatasetInfo", "RowGroupRef", "open_dataset", "load_row_groups",
+    "infer_or_load_schema", "materialize_dataset", "write_dataset",
+    "RowGroupIndexer", "SingleFieldIndexer", "FieldNotNullIndexer",
+    "build_rowgroup_index", "get_row_group_indexes",
+]

@@ -1,0 +1,268 @@
+"""Dataset discovery and metadata: schema + rowgroup enumeration.
+
+Reference parity: petastorm/etl/dataset_metadata.py - schema stamping under a KV key
+(dataset_metadata.py:35-36,195-206), per-file rowgroup counts under a second KV key
+computed at write time (dataset_metadata.py:209-242), ``load_row_groups`` with three
+strategies (dataset_metadata.py:245-350: summary ``_metadata``, cached counts with
+path-sorted deterministic ordering, parallel footer reads), and
+``infer_or_load_unischema`` (dataset_metadata.py:403-411).
+
+Differences: all KV payloads are JSON (never pickle); discovery uses pyarrow.dataset
+(hive partitioning handled by Arrow C++); rowgroup refs carry ``num_rows`` so the
+read planner can do row-level accounting (row-drop splits, resumable iterator state)
+without re-reading footers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import posixpath
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.fs as pafs
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+from petastorm_tpu.schema import SCHEMA_METADATA_KEY, Schema
+
+logger = logging.getLogger(__name__)
+
+#: Parquet KV key: JSON ``{"files": {relative_path: [rows_in_rg0, rows_in_rg1, ...]}}``
+ROW_GROUPS_METADATA_KEY = b"petastorm-tpu.row_groups_per_file.v1"
+#: Parquet KV key: JSON rowgroup index (petastorm_tpu/etl/indexing.py)
+ROWGROUP_INDEX_METADATA_KEY = b"petastorm-tpu.rowgroup_index.v1"
+
+_METADATA_FILENAMES = ("_common_metadata", "_metadata")
+_FOOTER_READ_THREADS = 10  # reference uses metadata_nthreads=10 (reader.py:359)
+
+
+@dataclasses.dataclass(frozen=True)
+class RowGroupRef:
+    """One unit of read work: a single rowgroup of a single file.
+
+    ``global_index`` is the deterministic ordinal across the whole dataset
+    (files path-sorted, rowgroups in file order - reference ordering contract at
+    dataset_metadata.py:277-287); sharding and shuffling permute these ordinals.
+    """
+
+    path: str                       # absolute path within the dataset's filesystem
+    row_group: int                  # ordinal within the file
+    num_rows: int
+    global_index: int
+    partition_values: Tuple[Tuple[str, str], ...] = ()  # hive key=value pairs
+
+
+class DatasetInfo:
+    """Resolved dataset: filesystem, files, schema, rowgroups, KV metadata."""
+
+    def __init__(self, url_or_urls, filesystem: pafs.FileSystem, path_or_paths,
+                 files: List[str], arrow_schema: pa.Schema,
+                 kv_metadata: Dict[bytes, bytes], row_groups: List[RowGroupRef],
+                 stored_schema: Optional[Schema]):
+        self.url = url_or_urls
+        self.filesystem = filesystem
+        self.path = path_or_paths
+        self.files = files
+        self.arrow_schema = arrow_schema
+        self.kv_metadata = kv_metadata
+        self.row_groups = row_groups
+        self.stored_schema = stored_schema
+
+    @property
+    def root_path(self) -> str:
+        return self.path if isinstance(self.path, str) else posixpath.dirname(self.path[0])
+
+    @property
+    def partition_keys(self) -> List[str]:
+        keys = []
+        for rg in self.row_groups:
+            for k, _ in rg.partition_values:
+                if k not in keys:
+                    keys.append(k)
+        return keys
+
+
+def hive_partition_segment(key: str, value: str) -> str:
+    """``key=value`` path segment with the value percent-encoded (hive/spark
+    convention), so '/', '=', '%' in values cannot corrupt the path structure."""
+    from urllib.parse import quote
+
+    return f"{key}={quote(str(value), safe='')}"
+
+
+def parse_hive_partitions(root: str, file_path: str) -> Tuple[Tuple[str, str], ...]:
+    """Extract hive ``key=value`` pairs from the path segments under ``root``."""
+    from urllib.parse import unquote
+
+    rel = file_path[len(root):].lstrip("/") if file_path.startswith(root) else file_path
+    pairs = []
+    for seg in rel.split("/")[:-1]:
+        if "=" in seg:
+            k, _, v = seg.partition("=")
+            pairs.append((k, unquote(v)))
+    return tuple(pairs)
+
+
+def _is_data_file(path: str) -> bool:
+    name = posixpath.basename(path)
+    return not (name.startswith("_") or name.startswith(".") or name.endswith(".crc"))
+
+
+def _read_kv_metadata(fs: pafs.FileSystem, root: str) -> Dict[bytes, bytes]:
+    """KV metadata from ``_common_metadata``/``_metadata`` if present (else {})."""
+    for name in _METADATA_FILENAMES:
+        mpath = posixpath.join(root, name)
+        try:
+            info = fs.get_file_info(mpath)
+        except (OSError, pa.ArrowInvalid):
+            continue
+        if info.type == pafs.FileType.File:
+            try:
+                md = pq.read_metadata(mpath, filesystem=fs).metadata or {}
+                return dict(md)
+            except (pa.ArrowInvalid, OSError) as exc:
+                logger.warning("Failed reading %s: %s", mpath, exc)
+    return {}
+
+
+def _footer_row_groups(fs: pafs.FileSystem, path: str) -> List[int]:
+    with fs.open_input_file(path) as f:
+        md = pq.ParquetFile(f).metadata
+        return [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+
+
+def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
+                    kv_metadata: Dict[bytes, bytes]) -> List[RowGroupRef]:
+    """Enumerate rowgroups for path-sorted ``files``.
+
+    Strategy 1 (fast): cached per-file counts from KV metadata - no footer reads
+    (reference dataset_metadata.py:264-287).  Strategy 2: parallel footer reads
+    (reference dataset_metadata.py:337-350).
+    """
+    files = sorted(files)
+    counts: Optional[Dict[str, List[int]]] = None
+    if ROW_GROUPS_METADATA_KEY in kv_metadata:
+        try:
+            payload = json.loads(kv_metadata[ROW_GROUPS_METADATA_KEY])
+            counts = payload["files"]
+        except (ValueError, KeyError) as exc:
+            logger.warning("Corrupt %s payload (%s); falling back to footer reads",
+                           ROW_GROUPS_METADATA_KEY, exc)
+    per_file: Dict[str, List[int]] = {}
+    if counts is not None:
+        for f in files:
+            rel = posixpath.relpath(f, root)
+            if rel not in counts:
+                logger.warning("File %s missing from cached rowgroup counts; "
+                               "falling back to footer reads", rel)
+                counts = None
+                break
+        if counts is not None:
+            per_file = {f: counts[posixpath.relpath(f, root)] for f in files}
+    if counts is None:
+        with ThreadPoolExecutor(max_workers=_FOOTER_READ_THREADS) as pool:
+            results = list(pool.map(lambda p: _footer_row_groups(fs, p), files))
+        per_file = dict(zip(files, results))
+
+    refs: List[RowGroupRef] = []
+    for f in files:
+        parts = parse_hive_partitions(root, f)
+        for rg_idx, nrows in enumerate(per_file[f]):
+            refs.append(RowGroupRef(path=f, row_group=rg_idx, num_rows=nrows,
+                                    global_index=len(refs), partition_values=parts))
+    return refs
+
+
+def open_dataset(url_or_urls: Union[str, Sequence[str]],
+                 storage_options: Optional[dict] = None,
+                 filesystem: Optional[pafs.FileSystem] = None,
+                 require_stored_schema: bool = False) -> DatasetInfo:
+    """Resolve URL(s) -> DatasetInfo with schema, files, rowgroups.
+
+    ``url_or_urls`` may be a dataset directory URL or an explicit list of parquet
+    file URLs (reference supports both in make_batch_reader, fs_utils.py:199-228).
+    """
+    fs, path_or_paths = get_filesystem_and_path_or_paths(
+        url_or_urls, storage_options, filesystem)
+
+    if isinstance(path_or_paths, str):
+        root = path_or_paths
+        info = fs.get_file_info(root)
+        if info.type == pafs.FileType.NotFound:
+            raise MetadataError(f"Dataset path not found: {url_or_urls!r}")
+        if info.type == pafs.FileType.File:
+            files = [root]
+            root = posixpath.dirname(root)
+        else:
+            selector = pafs.FileSelector(root, recursive=True)
+            files = sorted(f.path for f in fs.get_file_info(selector)
+                           if f.type == pafs.FileType.File and _is_data_file(f.path))
+    else:
+        files = sorted(path_or_paths)
+        # longest common directory prefix, so hive segments directly above each
+        # file still parse as partitions (dirname(files[0]) would swallow the
+        # first file's own partition directory)
+        dirs = [posixpath.dirname(f) for f in files]
+        root = posixpath.commonpath(dirs) if len(set(dirs)) > 1 else dirs[0] if dirs else ""
+    if not files:
+        raise MetadataError(f"No parquet data files found under {url_or_urls!r}")
+
+    kv = _read_kv_metadata(fs, root)
+    stored_schema = None
+    if SCHEMA_METADATA_KEY in kv:
+        stored_schema = Schema.from_json(kv[SCHEMA_METADATA_KEY])
+    else:
+        # schema may be stamped in data-file footers instead (single-file writes)
+        with fs.open_input_file(files[0]) as f:
+            file_kv = pq.ParquetFile(f).schema_arrow.metadata or {}
+        if SCHEMA_METADATA_KEY in file_kv:
+            stored_schema = Schema.from_json(file_kv[SCHEMA_METADATA_KEY])
+            kv = {**file_kv, **kv}
+    if require_stored_schema and stored_schema is None:
+        raise MetadataError(
+            f"Dataset at {url_or_urls!r} has no petastorm-tpu schema metadata. It was"
+            " not created by petastorm_tpu (or metadata was lost); use"
+            " make_batch_reader for plain parquet stores, or regenerate metadata with"
+            " petastorm_tpu.tools.generate_metadata.")
+
+    dset = pads.dataset(files, filesystem=fs, format="parquet",
+                        partitioning=pads.HivePartitioning.discover())
+    row_groups = load_row_groups(fs, root, files, kv)
+    return DatasetInfo(url_or_urls, fs, path_or_paths, files, dset.schema, kv,
+                       row_groups, stored_schema)
+
+
+def infer_or_load_schema(info: DatasetInfo) -> Schema:
+    """Stored schema if present, else inferred from the arrow schema.
+
+    Reference: ``infer_or_load_unischema`` (dataset_metadata.py:403-411).
+    """
+    if info.stored_schema is not None:
+        return info.stored_schema
+    partition_cols = [k for k in info.partition_keys]
+    return Schema.from_arrow_schema(info.arrow_schema, name="inferred",
+                                    partition_columns=partition_cols)
+
+
+def write_metadata_file(fs: pafs.FileSystem, root: str, arrow_schema: pa.Schema,
+                        kv_metadata: Dict[bytes, bytes]) -> None:
+    """Write ``_common_metadata`` with merged KV (reference utils.py:90-134)."""
+    existing = _read_kv_metadata(fs, root)
+    merged = {**existing, **kv_metadata}
+    schema = arrow_schema.with_metadata(merged)
+    pq.write_metadata(schema, posixpath.join(root, "_common_metadata"), filesystem=fs)
+
+
+def collect_row_group_counts(fs: pafs.FileSystem, root: str,
+                             files: List[str]) -> Dict[str, List[int]]:
+    """Per-file rowgroup row counts keyed by path relative to ``root``."""
+    with ThreadPoolExecutor(max_workers=_FOOTER_READ_THREADS) as pool:
+        results = list(pool.map(lambda p: _footer_row_groups(fs, p), sorted(files)))
+    return {posixpath.relpath(f, root): counts
+            for f, counts in zip(sorted(files), results)}

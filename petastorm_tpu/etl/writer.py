@@ -1,0 +1,214 @@
+"""Spark-free dataset writer.
+
+Reference parity: ``materialize_dataset`` (petastorm/etl/dataset_metadata.py:53-133)
+which (a) pre-configures the writer (rowgroup size), (b) lets the user write parquet,
+(c) stamps schema + per-file rowgroup counts into ``_common_metadata`` and validates
+readability (dataset_metadata.py:118-131).  The reference's row encoding is
+``dict_to_spark_row`` on Spark executors (unischema.py:356-403).
+
+Here the default writer is pyarrow-native (no JVM): ``write_dataset`` encodes rows
+columnar-batch-at-a-time and writes parquet directly; ``materialize_dataset`` is kept
+as a context manager for interop flows (user writes parquet by any means - pandas,
+polars, Spark-over-parquet - and we stamp metadata on exit).  Distributed writes on a
+TPU pod: every host calls ``write_dataset`` with a distinct ``file_prefix`` (e.g.
+``f"part-{jax.process_index()}"``) into the same directory, then exactly one host
+calls ``stamp_dataset_metadata`` - coordination is the caller's (or
+petastorm_tpu.parallel's) job, not a JVM's.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import logging
+import posixpath
+import uuid
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.fs as pafs
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import MetadataError, SchemaError
+from petastorm_tpu.etl.metadata import (ROW_GROUPS_METADATA_KEY, _is_data_file,
+                                        collect_row_group_counts, hive_partition_segment,
+                                        open_dataset, write_metadata_file)
+from petastorm_tpu.fs import get_filesystem_and_path
+from petastorm_tpu.schema import SCHEMA_METADATA_KEY, Schema, insert_explicit_nulls
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_ROW_GROUP_SIZE_MB = 32  # reference default: row_group_size_mb (dataset_metadata.py:62)
+
+
+def _encode_chunk(schema: Schema, file_schema: pa.Schema,
+                  rows: List[dict]) -> pa.RecordBatch:
+    """Encode a chunk of row dicts into one arrow RecordBatch (storage types)."""
+    encoded_rows = [schema.encode_row(insert_explicit_nulls(schema, r)) for r in rows]
+    arrays = [pa.array([r[name] for r in encoded_rows], type=file_schema.field(name).type)
+              for name in file_schema.names]
+    return pa.RecordBatch.from_arrays(arrays, schema=file_schema)
+
+
+def _estimate_rows_per_group(batch: pa.RecordBatch, target_mb: float) -> int:
+    nbytes = max(batch.nbytes, 1)
+    per_row = nbytes / max(batch.num_rows, 1)
+    return max(1, int(target_mb * 1024 * 1024 / per_row))
+
+
+def write_dataset(url: str,
+                  schema: Schema,
+                  rows: Iterable[dict],
+                  row_group_size_mb: Optional[float] = None,
+                  row_group_size_rows: Optional[int] = None,
+                  rows_per_file: Optional[int] = None,
+                  partition_by: Sequence[str] = (),
+                  file_prefix: str = "part",
+                  filesystem: Optional[pafs.FileSystem] = None,
+                  storage_options: Optional[dict] = None,
+                  stamp_metadata: bool = True) -> List[str]:
+    """Encode + write rows as a petastorm_tpu parquet dataset; returns file paths.
+
+    ``partition_by`` names scalar fields materialized as hive ``key=value``
+    directories (values must be str/int/bool-convertible); partitioned fields are
+    not duplicated inside the files, matching parquet convention.
+    """
+    if row_group_size_mb is None and row_group_size_rows is None:
+        row_group_size_mb = DEFAULT_ROW_GROUP_SIZE_MB
+    for pcol in partition_by:
+        if pcol not in schema:
+            raise SchemaError(f"partition_by field {pcol!r} not in schema")
+        if schema[pcol].shape != ():
+            raise SchemaError(f"partition_by field {pcol!r} must be scalar")
+
+    fs, root = get_filesystem_and_path(url, storage_options, filesystem)
+    fs.create_dir(root, recursive=True)
+
+    storage = schema.as_arrow_schema()
+    file_schema = pa.schema([storage.field(f.name) for f in schema
+                             if f.name not in set(partition_by)],
+                            metadata={SCHEMA_METADATA_KEY: schema.to_json()})
+
+    writers: Dict[str, pq.ParquetWriter] = {}
+    files: List[str] = []
+    rows_written: Dict[str, int] = {}
+    rows_per_group = row_group_size_rows
+
+    def _writer_for(partition_values: tuple) -> pq.ParquetWriter:
+        key = "/".join(hive_partition_segment(k, v) for k, v in partition_values)
+        if key not in writers:
+            subdir = posixpath.join(root, key) if key else root
+            fs.create_dir(subdir, recursive=True)
+            fname = f"{file_prefix}-{len(files):05d}-{uuid.uuid4().hex[:8]}.parquet"
+            path = posixpath.join(subdir, fname)
+            writers[key] = pq.ParquetWriter(path, file_schema, filesystem=fs)
+            files.append(path)
+            rows_written[key] = 0
+        return writers[key]
+
+    _ESTIMATE_CHUNK = 1024  # rows encoded to estimate bytes/row for MB-based sizing
+    pending: Dict[tuple, List[dict]] = {}
+
+    def _flush(pv: tuple, final: bool) -> None:
+        """Write full rowgroups from the partition buffer; keep the remainder.
+
+        Buffering per partition (not per encode-chunk) is what prevents runt
+        rowgroups when rows interleave across partitions.
+        """
+        nonlocal rows_per_group
+        buf = pending.get(pv, [])
+        threshold = rows_per_group if rows_per_group is not None else _ESTIMATE_CHUNK
+        while buf and (final or len(buf) >= threshold):
+            chunk, buf = buf[:threshold], buf[threshold:]
+            batch = _encode_chunk(schema, file_schema, chunk)
+            if rows_per_group is None:
+                rows_per_group = _estimate_rows_per_group(batch, row_group_size_mb)
+                threshold = rows_per_group
+            writer = _writer_for(pv)
+            # write_table splits into ceil(n/rows_per_group) rowgroups itself,
+            # which only matters for the estimate chunk exceeding the target
+            writer.write_table(pa.Table.from_batches([batch]),
+                               row_group_size=rows_per_group)
+            key = "/".join(hive_partition_segment(k, v) for k, v in pv)
+            rows_written[key] += batch.num_rows
+            if rows_per_file and rows_written[key] >= rows_per_file:
+                writers.pop(key).close()
+                rows_written[key] = 0
+        pending[pv] = buf
+
+    for r in rows:
+        for k in partition_by:
+            if r.get(k) is None:
+                raise SchemaError(f"Row is missing a value for partition field {k!r}"
+                                  " (partition values must be non-null)")
+        pv = tuple((k, str(r[k])) for k in partition_by)
+        pending.setdefault(pv, []).append(r)
+        if len(pending[pv]) >= (rows_per_group or _ESTIMATE_CHUNK):
+            _flush(pv, final=False)
+    for pv in list(pending):
+        _flush(pv, final=True)
+
+    for w in writers.values():
+        w.close()
+    if not files:
+        logger.warning("write_dataset(%s): no rows were written; dataset left empty",
+                       url)
+        return []
+    if stamp_metadata:
+        stamp_dataset_metadata(url, schema, filesystem=fs)
+    return files
+
+
+def stamp_dataset_metadata(url: str, schema: Optional[Schema] = None,
+                           filesystem: Optional[pafs.FileSystem] = None,
+                           storage_options: Optional[dict] = None,
+                           validate: bool = True) -> None:
+    """Write/refresh ``_common_metadata``: schema JSON + per-file rowgroup counts.
+
+    Reference: the post-write half of ``materialize_dataset``
+    (dataset_metadata.py:113-131) and the standalone regenerator CLI
+    (etl/petastorm_generate_metadata.py).
+    """
+    fs, root = get_filesystem_and_path(url, storage_options, filesystem)
+    selector = pafs.FileSelector(root, recursive=True)
+    files = sorted(f.path for f in fs.get_file_info(selector)
+                   if f.type == pafs.FileType.File and _is_data_file(f.path))
+    if not files:
+        raise MetadataError(f"No data files under {url!r} to stamp metadata for")
+    counts = collect_row_group_counts(fs, root, files)
+    with fs.open_input_file(files[0]) as f:
+        arrow_schema = pq.ParquetFile(f).schema_arrow
+    if schema is None:
+        file_kv = arrow_schema.metadata or {}
+        if SCHEMA_METADATA_KEY not in file_kv:
+            raise MetadataError(
+                "No schema given and data files carry no petastorm-tpu schema;"
+                " pass schema= explicitly")
+        schema = Schema.from_json(file_kv[SCHEMA_METADATA_KEY])
+    kv = {
+        SCHEMA_METADATA_KEY: schema.to_json().encode(),
+        ROW_GROUPS_METADATA_KEY: json.dumps({"files": counts}).encode(),
+    }
+    write_metadata_file(fs, root, arrow_schema, kv)
+    if validate:
+        info = open_dataset(url, filesystem=fs, require_stored_schema=True)
+        if not info.row_groups:
+            raise MetadataError(f"Validation failed: no rowgroups visible at {url!r}")
+
+
+@contextlib.contextmanager
+def materialize_dataset(url: str, schema: Schema,
+                        filesystem: Optional[pafs.FileSystem] = None,
+                        storage_options: Optional[dict] = None) -> Iterator[None]:
+    """Context manager: user writes parquet under ``url`` inside the block (by any
+    engine), metadata is stamped + validated on exit.
+
+    Reference: ``materialize_dataset`` (dataset_metadata.py:53-133), minus the JVM.
+    Encoded cell values must follow the schema's storage types - use
+    ``schema.encode_row`` (the ``dict_to_spark_row`` equivalent) on each row.
+    """
+    yield
+    stamp_dataset_metadata(url, schema, filesystem=filesystem,
+                           storage_options=storage_options)

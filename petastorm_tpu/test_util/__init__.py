@@ -1,0 +1,5 @@
+"""Test utilities: synthetic datasets, mock readers, shuffle-quality analysis.
+
+Reference parity: petastorm/test_util/ (reader_mock.py, shuffling_analysis.py) and
+the synthetic TestSchema generator in petastorm/tests/test_common.py:40-102.
+"""

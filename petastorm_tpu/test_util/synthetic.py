@@ -1,0 +1,83 @@
+"""Synthetic dataset generation for tests and benchmarks.
+
+Reference parity: petastorm/tests/test_common.py:40-102 - a single TestSchema
+covering every codec/dtype/nullable/variable-shape case, materialized into tmpdirs by
+session fixtures (tests/conftest.py:92-126) instead of golden files; and
+petastorm/generator.py (random datapoint for a schema).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.schema import Field, Schema
+
+TEST_SCHEMA = Schema("TestSchema", [
+    Field("id", np.int64),
+    Field("id2", np.int32),
+    Field("partition_key", np.dtype("object")),
+    Field("python_primitive_uint8", np.uint8),
+    Field("image_png", np.uint8, (16, 12, 3), CompressedImageCodec("png")),
+    Field("matrix", np.float32, (4, 5), NdarrayCodec()),
+    Field("matrix_compressed", np.float32, (4, 5), CompressedNdarrayCodec()),
+    Field("matrix_var", np.float64, (None, 2), NdarrayCodec()),
+    Field("sensor_name", np.dtype("object")),
+    Field("timestamp_s", np.int64),
+    Field("nullable_float", np.float64, nullable=True),
+])
+
+
+def random_row(schema: Schema, rng: np.random.Generator, row_index: int) -> Dict:
+    """One schema-conformant random row (reference: generator.py:21-47)."""
+    row: Dict = {}
+    for f in schema:
+        if f.name == "id":
+            row[f.name] = row_index
+            continue
+        if f.name == "timestamp_s":
+            row[f.name] = 1_000_000 + row_index
+            continue
+        if f.nullable and rng.random() < 0.3:
+            row[f.name] = None
+            continue
+        if f.shape == ():
+            if f.dtype.kind == "O":
+                row[f.name] = f"{f.name}_{rng.integers(0, 5)}"
+            elif f.dtype.kind in "ui":
+                row[f.name] = int(rng.integers(0, np.iinfo(f.dtype).max // 2, dtype=f.dtype))
+            elif f.dtype.kind == "f":
+                row[f.name] = float(rng.random())
+            elif f.dtype.kind == "b":
+                row[f.name] = bool(rng.integers(0, 2))
+            else:
+                raise ValueError(f"no generator for {f}")
+        else:
+            shape = tuple(d if d is not None else int(rng.integers(1, 6)) for d in f.shape)
+            if f.dtype.kind in "ui":
+                row[f.name] = rng.integers(0, 255, shape).astype(f.dtype)
+            else:
+                row[f.name] = rng.standard_normal(shape).astype(f.dtype)
+    return row
+
+
+def create_test_dataset(url: str,
+                        num_rows: int = 100,
+                        row_group_size_rows: int = 10,
+                        schema: Optional[Schema] = None,
+                        seed: int = 1234,
+                        **write_kwargs) -> List[Dict]:
+    """Write a synthetic dataset; returns the (decoded-form) rows for assertions.
+
+    Reference: create_test_dataset (tests/test_common.py:102+).
+    """
+    schema = schema or TEST_SCHEMA
+    rng = np.random.default_rng(seed)
+    rows = [random_row(schema, rng, i) for i in range(num_rows)]
+    write_dataset(url, schema, rows, row_group_size_rows=row_group_size_rows,
+                  **write_kwargs)
+    return rows

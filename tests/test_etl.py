@@ -1,0 +1,254 @@
+"""Dataset writer/metadata/indexing tests.
+
+Reference models: petastorm/tests/test_dataset_metadata.py, test_generate_metadata.py,
+test_parquet_reader.py (plain-parquet inference), rowgroup indexing tests.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.errors import MetadataError, SchemaError
+from petastorm_tpu.etl import (FieldNotNullIndexer, SingleFieldIndexer,
+                               build_rowgroup_index, get_row_group_indexes,
+                               infer_or_load_schema, open_dataset)
+from petastorm_tpu.etl.metadata import ROW_GROUPS_METADATA_KEY
+from petastorm_tpu.etl.writer import (materialize_dataset, stamp_dataset_metadata,
+                                      write_dataset)
+from petastorm_tpu.schema import SCHEMA_METADATA_KEY, Field, Schema
+from petastorm_tpu.test_util.synthetic import TEST_SCHEMA, create_test_dataset
+
+
+@pytest.fixture(scope="module")
+def small_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp("ds") / "small"
+    rows = create_test_dataset(str(path), num_rows=50, row_group_size_rows=10)
+    return str(path), rows
+
+
+def test_write_open_roundtrip(small_dataset):
+    url, rows = small_dataset
+    info = open_dataset(url)
+    assert info.stored_schema == TEST_SCHEMA
+    assert sum(rg.num_rows for rg in info.row_groups) == 50
+    assert all(rg.num_rows == 10 for rg in info.row_groups)
+    # deterministic global ordering: files path-sorted, rowgroups in file order
+    assert [rg.global_index for rg in info.row_groups] == list(range(len(info.row_groups)))
+
+
+def test_cached_rowgroup_counts_present(small_dataset):
+    url, _ = small_dataset
+    info = open_dataset(url)
+    assert ROW_GROUPS_METADATA_KEY in info.kv_metadata
+    payload = json.loads(info.kv_metadata[ROW_GROUPS_METADATA_KEY])
+    assert sum(sum(v) for v in payload["files"].values()) == 50
+
+
+def test_corrupt_counts_falls_back_to_footers(small_dataset, tmp_path):
+    url, _ = small_dataset
+    info = open_dataset(url)
+    from petastorm_tpu.etl.metadata import load_row_groups
+    bad_kv = dict(info.kv_metadata)
+    bad_kv[ROW_GROUPS_METADATA_KEY] = b"{not json"
+    refs = load_row_groups(info.filesystem, info.root_path, info.files, bad_kv)
+    assert sum(r.num_rows for r in refs) == 50
+
+
+def test_open_dataset_missing_path():
+    with pytest.raises(MetadataError):
+        open_dataset("/nonexistent/nope")
+
+
+def test_require_stored_schema_on_plain_parquet(tmp_path):
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    pq.write_table(pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"]}),
+                   str(plain / "f.parquet"))
+    info = open_dataset(str(plain))
+    assert info.stored_schema is None
+    schema = infer_or_load_schema(info)
+    assert schema.a.dtype == np.int64 and schema.b.dtype == np.dtype("object")
+    with pytest.raises(MetadataError):
+        open_dataset(str(plain), require_stored_schema=True)
+
+
+def test_materialize_context_manager(tmp_path):
+    schema = Schema("M", [Field("x", np.int32), Field("v", np.float32, (3,))])
+    url = str(tmp_path / "mat")
+    os.makedirs(url)
+    with materialize_dataset(url, schema):
+        rows = [schema.encode_row({"x": i, "v": np.full(3, i, np.float32)})
+                for i in range(20)]
+        table = pa.Table.from_pylist(rows, schema=schema.as_arrow_schema())
+        pq.write_table(table, os.path.join(url, "data.parquet"), row_group_size=5)
+    info = open_dataset(url, require_stored_schema=True)
+    assert info.stored_schema == schema
+    assert len(info.row_groups) == 4 and all(r.num_rows == 5 for r in info.row_groups)
+
+
+def test_partitioned_write_and_discovery(tmp_path):
+    schema = Schema("P", [Field("label", np.dtype("object")), Field("x", np.int64)])
+    url = str(tmp_path / "part")
+    write_dataset(url, schema, ({"label": "ab"[i % 2], "x": i} for i in range(40)),
+                  row_group_size_rows=5, partition_by=["label"])
+    info = open_dataset(url)
+    keys = {rg.partition_values for rg in info.row_groups}
+    assert keys == {(("label", "a"),), (("label", "b"),)}
+    assert info.partition_keys == ["label"]
+    assert sum(rg.num_rows for rg in info.row_groups) == 40
+
+
+def test_partition_by_validation(tmp_path):
+    schema = Schema("P", [Field("m", np.float32, (2,))])
+    with pytest.raises(SchemaError):
+        write_dataset(str(tmp_path / "x"), schema, [], partition_by=["m"])
+    with pytest.raises(SchemaError):
+        write_dataset(str(tmp_path / "x"), schema, [], partition_by=["nope"])
+
+
+def test_open_explicit_file_list(small_dataset):
+    url, _ = small_dataset
+    info0 = open_dataset(url)
+    some_files = info0.files[:1]
+    info = open_dataset(some_files)
+    assert sum(rg.num_rows for rg in info.row_groups) == 50  # single file holds all
+
+
+def test_stamp_metadata_regeneration(tmp_path):
+    # simulate lost _common_metadata, regenerate from file footers
+    schema = Schema("R", [Field("x", np.int32)])
+    url = str(tmp_path / "regen")
+    write_dataset(url, schema, [{"x": i} for i in range(10)], row_group_size_rows=2)
+    os.remove(os.path.join(url, "_common_metadata"))
+    info = open_dataset(url)
+    assert info.stored_schema == schema  # recovered from data-file footer KV
+    stamp_dataset_metadata(url)
+    info2 = open_dataset(url, require_stored_schema=True)
+    assert len(info2.row_groups) == 5
+
+
+def test_rows_per_file_split(tmp_path):
+    schema = Schema("F", [Field("x", np.int64)])
+    url = str(tmp_path / "многоfile")
+    files = write_dataset(url, schema, [{"x": i} for i in range(100)],
+                          row_group_size_rows=10, rows_per_file=30)
+    assert len(files) >= 3
+    info = open_dataset(url)
+    assert sum(rg.num_rows for rg in info.row_groups) == 100
+
+
+# -- indexing -----------------------------------------------------------------
+
+def test_single_field_index_build_and_lookup(tmp_path):
+    schema = Schema("I", [Field("id", np.int64), Field("label", np.dtype("object"))])
+    url = str(tmp_path / "ix")
+    write_dataset(url, schema,
+                  [{"id": i, "label": "ab"[i // 10 % 2]} for i in range(40)],
+                  row_group_size_rows=10)
+    build_rowgroup_index(url, [SingleFieldIndexer("by_label", "label")])
+    info = open_dataset(url)
+    indexes = get_row_group_indexes(info)
+    assert set(indexes) == {"by_label"}
+    a_groups = indexes["by_label"].get_row_group_indexes("a")
+    b_groups = indexes["by_label"].get_row_group_indexes("b")
+    assert a_groups == {0, 2} and b_groups == {1, 3}
+    assert indexes["by_label"].indexed_values() == ["a", "b"]
+
+
+def test_not_null_index(tmp_path):
+    schema = Schema("N", [Field("id", np.int64),
+                          Field("opt", np.float64, nullable=True)])
+    url = str(tmp_path / "nn")
+    rows = [{"id": i, "opt": None if i < 20 else 1.0} for i in range(40)]
+    write_dataset(url, schema, rows, row_group_size_rows=10)
+    build_rowgroup_index(url, [FieldNotNullIndexer("opt_nn", "opt")])
+    indexes = get_row_group_indexes(open_dataset(url))
+    assert indexes["opt_nn"].get_row_group_indexes() == {2, 3}
+
+
+def test_index_rebuild_merges(tmp_path):
+    schema = Schema("I", [Field("id", np.int64), Field("k", np.int32)])
+    url = str(tmp_path / "merge")
+    write_dataset(url, schema, [{"id": i, "k": i % 3} for i in range(30)],
+                  row_group_size_rows=10)
+    build_rowgroup_index(url, [SingleFieldIndexer("by_k", "k")])
+    build_rowgroup_index(url, [FieldNotNullIndexer("k_nn", "k")])
+    indexes = get_row_group_indexes(open_dataset(url))
+    assert set(indexes) == {"by_k", "k_nn"}  # second build preserved the first
+
+
+def test_partitioned_write_no_runt_rowgroups(tmp_path):
+    # per-partition buffering: interleaved partition values must still produce
+    # full-size rowgroups, not one runt group per encode chunk
+    schema = Schema("P", [Field("tag", np.dtype("object")), Field("x", np.int64)])
+    url = str(tmp_path / "runt")
+    write_dataset(url, schema, ({"tag": "abc"[i % 3], "x": i} for i in range(75)),
+                  row_group_size_rows=5, partition_by=["tag"])
+    info = open_dataset(url)
+    assert len(info.row_groups) == 15  # 25 rows/partition / 5 = 5 groups x 3
+    assert all(rg.num_rows == 5 for rg in info.row_groups)
+
+
+def test_empty_write_returns_no_files(tmp_path):
+    schema = Schema("E", [Field("x", np.int64)])
+    assert write_dataset(str(tmp_path / "empty"), schema, []) == []
+
+
+def test_index_unknown_field(tmp_path):
+    schema = Schema("I", [Field("id", np.int64)])
+    url = str(tmp_path / "uf")
+    write_dataset(url, schema, [{"id": 1}])
+    with pytest.raises(MetadataError):
+        build_rowgroup_index(url, [SingleFieldIndexer("x", "missing")])
+
+
+def test_index_on_partition_column(tmp_path):
+    schema = Schema("P", [Field("label", np.dtype("object")), Field("x", np.int64)])
+    url = str(tmp_path / "ixpart")
+    write_dataset(url, schema, [{"label": "ab"[i // 10], "x": i} for i in range(20)],
+                  row_group_size_rows=10, partition_by=["label"])
+    build_rowgroup_index(url, [SingleFieldIndexer("by_label", "label")])
+    indexes = get_row_group_indexes(open_dataset(url))
+    a = indexes["by_label"].get_row_group_indexes("a")
+    b = indexes["by_label"].get_row_group_indexes("b")
+    assert a and b and not (a & b) and len(a | b) == 2
+
+
+def test_explicit_file_list_keeps_partition_values(tmp_path):
+    schema = Schema("P", [Field("label", np.dtype("object")), Field("x", np.int64)])
+    url = str(tmp_path / "flist")
+    write_dataset(url, schema, [{"label": "ab"[i % 2], "x": i} for i in range(20)],
+                  row_group_size_rows=5, partition_by=["label"])
+    all_files = open_dataset(url).files
+    info = open_dataset(all_files)
+    labels = {dict(rg.partition_values).get("label") for rg in info.row_groups}
+    assert labels == {"a", "b"}  # first file's partition must not be swallowed
+
+
+def test_partition_value_escaping(tmp_path):
+    schema = Schema("P", [Field("label", np.dtype("object")), Field("x", np.int64)])
+    url = str(tmp_path / "esc")
+    write_dataset(url, schema, [{"label": "a/b=c%d", "x": 1}], partition_by=["label"])
+    info = open_dataset(url)
+    assert dict(info.row_groups[0].partition_values)["label"] == "a/b=c%d"
+
+
+def test_partition_value_none_rejected(tmp_path):
+    schema = Schema("P", [Field("label", np.dtype("object"), nullable=True),
+                          Field("x", np.int64)])
+    with pytest.raises(SchemaError):
+        write_dataset(str(tmp_path / "pn"), schema, [{"label": None, "x": 1}],
+                      partition_by=["label"])
+
+
+def test_sanitize_bool_exact():
+    from petastorm_tpu.dtypes import sanitize_value
+    assert sanitize_value(1, np.dtype("bool")) is True
+    with pytest.raises(SchemaError):
+        sanitize_value(2, np.dtype("bool"))
+    with pytest.raises(SchemaError):
+        sanitize_value(2 ** 70, np.dtype("int64"))
