@@ -1,0 +1,69 @@
+"""ColumnBatch: the unit of data flowing through the pipeline.
+
+The reference moves python dicts (row path, py_dict_reader_worker.py:100) or
+pyarrow Tables (batch path, arrow_reader_worker.py:90) between workers and
+consumer.  Here everything downstream of parquet decode is a ColumnBatch: a dict
+of numpy arrays (batch-major, contiguous for fixed-shape fields) - the exact form
+``jax.device_put`` wants, with zero per-row python in between.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ColumnBatch:
+    columns: Dict[str, np.ndarray]
+    num_rows: int
+
+    def __post_init__(self):
+        for name, col in self.columns.items():
+            if len(col) != self.num_rows:
+                raise ValueError(
+                    f"Column {name!r} has {len(col)} rows, expected {self.num_rows}")
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    @property
+    def field_names(self) -> List[str]:
+        return list(self.columns)
+
+    def select(self, names: Sequence[str]) -> "ColumnBatch":
+        return ColumnBatch({n: self.columns[n] for n in names}, self.num_rows)
+
+    def slice_rows(self, start: int, stop: int) -> "ColumnBatch":
+        stop = min(stop, self.num_rows)
+        return ColumnBatch({n: c[start:stop] for n, c in self.columns.items()},
+                           max(stop - start, 0))
+
+    def mask_rows(self, mask: np.ndarray) -> "ColumnBatch":
+        n = int(np.count_nonzero(mask))
+        return ColumnBatch({name: col[mask] for name, col in self.columns.items()}, n)
+
+    def row(self, i: int) -> Dict:
+        return {name: col[i] for name, col in self.columns.items()}
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnBatch"]) -> "ColumnBatch":
+        batches = [b for b in batches if b.num_rows]
+        if not batches:
+            return ColumnBatch({}, 0)
+        names = batches[0].field_names
+        out = {}
+        for name in names:
+            cols = [b.columns[name] for b in batches]
+            if all(isinstance(c, np.ndarray) and c.dtype != object for c in cols):
+                out[name] = np.concatenate(cols)
+            else:
+                merged = np.empty(sum(len(c) for c in cols), dtype=object)
+                i = 0
+                for c in cols:
+                    merged[i:i + len(c)] = c
+                    i += len(c)
+                out[name] = merged
+        return ColumnBatch(out, sum(b.num_rows for b in batches))

@@ -1,0 +1,127 @@
+"""Read-through caches for decoded rowgroup batches.
+
+Reference parity: petastorm/cache.py (CacheBase.get contract, cache.py:20-33;
+NullCache cache.py:35-39) and petastorm/local_disk_cache.py (LocalDiskCache over
+diskcache.FanoutCache, local_disk_cache.py:22-63).
+
+Difference: ``diskcache`` is not a dependency - LocalDiskCache here is a small
+self-contained file-per-key store (sha1-named pickle files, best-effort LRU eviction
+by mtime against a size cap).  Entries are whole decoded *columnar batches*, not
+rows, so a hit skips parquet IO + decode for an entire rowgroup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import tempfile
+from abc import ABC, abstractmethod
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+class CacheBase(ABC):
+    @abstractmethod
+    def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
+        """Return cached value or compute+store via ``fill_cache_func``."""
+
+    def cleanup(self) -> None:
+        pass
+
+
+class NullCache(CacheBase):
+    """No-op cache (reference cache.py:35-39)."""
+
+    def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
+        return fill_cache_func()
+
+
+class LocalDiskCache(CacheBase):
+    """File-per-key pickle cache with a byte-size cap.
+
+    Reference semantics (local_disk_cache.py:22-63): persistent across runs unless
+    ``cleanup()`` is called; sized eviction.  Keys are hashed, so any string key
+    works.  Concurrent readers/writers are safe per-entry (atomic rename); the
+    eviction sweep is best-effort.
+    """
+
+    def __init__(self, path: str, size_limit_bytes: int = 10 * 2 ** 30):
+        self._dir = path
+        self._size_limit = size_limit_bytes
+        os.makedirs(path, exist_ok=True)
+
+    def _entry_path(self, key: str) -> str:
+        return os.path.join(self._dir, hashlib.sha1(key.encode()).hexdigest() + ".bin")
+
+    def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
+        path = self._entry_path(key)
+        try:
+            with open(path, "rb") as f:
+                value = pickle.load(f)
+            os.utime(path)  # LRU touch
+            return value
+        except FileNotFoundError:
+            pass
+        except Exception as exc:  # corrupt entry: recompute
+            logger.warning("Dropping corrupt cache entry %s: %s", path, exc)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        value = fill_cache_func()
+        tmp_fd, tmp_path = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
+        try:
+            with os.fdopen(tmp_fd, "wb") as f:
+                pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp_path, path)
+        except Exception:
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+            raise
+        self._maybe_evict()
+        return value
+
+    def _maybe_evict(self) -> None:
+        entries = []
+        total = 0
+        for name in os.listdir(self._dir):
+            p = os.path.join(self._dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            total += st.st_size
+            entries.append((st.st_mtime, st.st_size, p))
+        if total <= self._size_limit:
+            return
+        entries.sort()  # oldest first
+        for _mtime, size, p in entries:
+            try:
+                os.remove(p)
+                total -= size
+            except OSError:
+                continue
+            if total <= self._size_limit:
+                return
+
+    def cleanup(self) -> None:
+        import shutil
+
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+
+def make_cache(cache_type: str = "null", cache_location: str = None,
+               cache_size_limit: int = None) -> CacheBase:
+    """'null' | 'local-disk' (reference: make_reader cache args, reader.py:126-131)."""
+    if cache_type in (None, "null", "none"):
+        return NullCache()
+    if cache_type == "local-disk":
+        if not cache_location:
+            cache_location = os.path.join(tempfile.gettempdir(), "petastorm_tpu_cache")
+        return LocalDiskCache(cache_location, cache_size_limit or 10 * 2 ** 30)
+    raise ValueError(f"Unknown cache_type {cache_type!r}")

@@ -64,7 +64,7 @@ class DatasetInfo:
     def __init__(self, url_or_urls, filesystem: pafs.FileSystem, path_or_paths,
                  files: List[str], arrow_schema: pa.Schema,
                  kv_metadata: Dict[bytes, bytes], row_groups: List[RowGroupRef],
-                 stored_schema: Optional[Schema]):
+                 stored_schema: Optional[Schema], root_path: str):
         self.url = url_or_urls
         self.filesystem = filesystem
         self.path = path_or_paths
@@ -73,10 +73,9 @@ class DatasetInfo:
         self.kv_metadata = kv_metadata
         self.row_groups = row_groups
         self.stored_schema = stored_schema
-
-    @property
-    def root_path(self) -> str:
-        return self.path if isinstance(self.path, str) else posixpath.dirname(self.path[0])
+        #: dataset root (above any hive partition directories) - the single place
+        #: _common_metadata lives and partition parsing anchors to
+        self.root_path = root_path
 
     @property
     def partition_keys(self) -> List[str]:
@@ -205,11 +204,14 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
                            if f.type == pafs.FileType.File and _is_data_file(f.path))
     else:
         files = sorted(path_or_paths)
-        # longest common directory prefix, so hive segments directly above each
-        # file still parse as partitions (dirname(files[0]) would swallow the
-        # first file's own partition directory)
+        # dataset root = longest common directory prefix, then strip any trailing
+        # hive 'key=value' segments - so partition values survive both for lists
+        # spanning partitions AND for a list drawn from a single partition, and
+        # _common_metadata at the true dataset root is found
         dirs = [posixpath.dirname(f) for f in files]
-        root = posixpath.commonpath(dirs) if len(set(dirs)) > 1 else dirs[0] if dirs else ""
+        root = posixpath.commonpath(dirs) if len(set(dirs)) > 1 else (dirs[0] if dirs else "")
+        while root and "=" in posixpath.basename(root):
+            root = posixpath.dirname(root)
     if not files:
         raise MetadataError(f"No parquet data files found under {url_or_urls!r}")
 
@@ -235,7 +237,7 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
                         partitioning=pads.HivePartitioning.discover())
     row_groups = load_row_groups(fs, root, files, kv)
     return DatasetInfo(url_or_urls, fs, path_or_paths, files, dset.schema, kv,
-                       row_groups, stored_schema)
+                       row_groups, stored_schema, root_path=root)
 
 
 def infer_or_load_schema(info: DatasetInfo) -> Schema:

@@ -93,13 +93,22 @@ class FilesystemFactory:
 
     Reference: the serializable ``filesystem_factory`` closure (fs_utils.py:42-196) -
     pyarrow filesystems themselves may hold unpicklable native handles.
+
+    When the user supplied an explicit ``filesystem`` (one that cannot be
+    re-derived from the URL - credentialed S3, in-memory/mock fs), it is carried
+    along and handed back verbatim; such readers require a thread/serial pool
+    unless the filesystem object itself pickles.
     """
 
-    def __init__(self, url: str, storage_options: Optional[dict] = None):
+    def __init__(self, url: str, storage_options: Optional[dict] = None,
+                 filesystem: Optional[pafs.FileSystem] = None):
         self._url = normalize_dir_url(url)
         self._storage_options = storage_options
+        self._filesystem = filesystem
 
     def __call__(self) -> pafs.FileSystem:
+        if self._filesystem is not None:
+            return self._filesystem
         return get_filesystem_and_path(self._url, self._storage_options)[0]
 
     @property

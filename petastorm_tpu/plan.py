@@ -1,0 +1,145 @@
+"""Read plan: deterministic, seedable, shardable ordering of rowgroup work items.
+
+Reference parity: the rowgroup filtering/ordering logic inside Reader.__init__ -
+shard filter ``index % shard_count == cur_shard`` (petastorm/reader.py:492-509),
+``shuffle_row_groups`` ventilation-order shuffle re-done per epoch
+(petastorm/workers_pool/ventilator.py:143-144), and ``shuffle_row_drop_partitions``
+splitting each rowgroup into N items keeping 1/N rows each
+(petastorm/reader.py:565-592).
+
+Design differences (TPU-first):
+
+* The epoch order is a **pure function of (seed, epoch, shard)** - the reference
+  shuffles with unseeded ``random.shuffle`` in the ventilator thread, so orders are
+  irreproducible and there is no mid-epoch resume.  Determinism here gives (a) exact
+  multi-host agreement without communication (every host computes every shard's
+  plan), and (b) checkpoint/resume via a plain (epoch, position) cursor - the gap
+  called out in SURVEY.md section 5.
+* Two shard modes: ``static`` is reference-compatible (rowgroup i on shard
+  ``i % shard_count`` forever; shuffle only permutes order within the shard) and
+  ``epoch`` re-deals rowgroups to shards each epoch from the seeded global
+  permutation (global shuffle across shards; still zero-communication).
+* Sharding defaults are wired to ``jax.process_index()/process_count()`` by the
+  reader layer, not here - this module stays jax-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from petastorm_tpu.errors import NoDataAvailableError, PetastormTpuError
+from petastorm_tpu.etl.metadata import RowGroupRef
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkItem:
+    """One unit of executor work: a rowgroup, optionally restricted to a row-drop
+    partition (keep rows in [start_fraction, end_fraction) of the group).
+
+    Reference: shuffle_row_drop_partitions ventilation items
+    (petastorm/reader.py:577-592; row arithmetic py_dict_reader_worker.py:254-274).
+    """
+
+    row_group: RowGroupRef
+    drop_partition: Optional[Tuple[int, int]] = None  # (partition_index, num_partitions)
+
+    @property
+    def num_rows(self) -> int:
+        if self.drop_partition is None:
+            return self.row_group.num_rows
+        idx, count = self.drop_partition
+        start, stop = _drop_slice(self.row_group.num_rows, idx, count)
+        return stop - start
+
+    def row_slice(self) -> Tuple[int, int]:
+        if self.drop_partition is None:
+            return 0, self.row_group.num_rows
+        idx, count = self.drop_partition
+        return _drop_slice(self.row_group.num_rows, idx, count)
+
+
+def _drop_slice(num_rows: int, idx: int, count: int) -> Tuple[int, int]:
+    base = num_rows // count
+    extra = num_rows % count
+    start = idx * base + min(idx, extra)
+    stop = start + base + (1 if idx < extra else 0)
+    return start, stop
+
+
+class ReadPlan:
+    """Epoch-indexed, shard-filtered, seeded ordering over rowgroups."""
+
+    def __init__(self,
+                 row_groups: Sequence[RowGroupRef],
+                 shard_index: Optional[int] = None,
+                 shard_count: Optional[int] = None,
+                 shuffle_row_groups: bool = True,
+                 shuffle_seed: Optional[int] = None,
+                 shuffle_row_drop_partitions: int = 1,
+                 shard_mode: str = "static"):
+        if (shard_index is None) != (shard_count is None):
+            raise PetastormTpuError("shard_index and shard_count must be set together")
+        if shard_count is not None:
+            if not 0 <= shard_index < shard_count:
+                raise PetastormTpuError(
+                    f"shard_index {shard_index} out of range for shard_count {shard_count}")
+            if shard_count > len(row_groups):
+                # reference raises NoDataAvailableError here (reader.py:502-504)
+                raise NoDataAvailableError(
+                    f"Dataset has {len(row_groups)} rowgroups but {shard_count} shards"
+                    " were requested; some shards would be empty. Write the dataset"
+                    " with more/smaller rowgroups or reduce shard_count.")
+        if shard_mode not in ("static", "epoch"):
+            raise PetastormTpuError(f"Unknown shard_mode {shard_mode!r}")
+        if shuffle_row_drop_partitions < 1:
+            raise PetastormTpuError("shuffle_row_drop_partitions must be >= 1")
+        self._row_groups = list(row_groups)
+        self._shard_index = shard_index
+        self._shard_count = shard_count
+        self._shuffle = shuffle_row_groups
+        self._seed = 0 if shuffle_seed is None else shuffle_seed
+        self._drop_partitions = shuffle_row_drop_partitions
+        self._shard_mode = shard_mode
+
+    @property
+    def row_groups(self) -> List[RowGroupRef]:
+        return self._row_groups
+
+    def rows_per_epoch(self) -> int:
+        return sum(item.num_rows for item in self.epoch_items(0))
+
+    def epoch_items(self, epoch: int) -> List[WorkItem]:
+        """The exact ordered work-item list for one epoch of this shard."""
+        n = len(self._row_groups)
+        if n == 0:
+            return []
+        if self._shuffle:
+            order = np.random.default_rng((self._seed, epoch)).permutation(n)
+        else:
+            order = np.arange(n)
+
+        if self._shard_count is None:
+            mine = order
+        elif self._shard_mode == "static":
+            # shard membership fixed by global index (reference reader.py:508);
+            # permutation only affects order within the shard
+            mine = order[order % self._shard_count == self._shard_index]
+        else:  # epoch mode: deal the permuted sequence round-robin to shards
+            mine = order[self._shard_index::self._shard_count]
+
+        items: List[WorkItem] = []
+        for gi in mine:
+            rg = self._row_groups[int(gi)]
+            if self._drop_partitions == 1:
+                items.append(WorkItem(rg))
+            else:
+                items.extend(WorkItem(rg, (k, self._drop_partitions))
+                             for k in range(self._drop_partitions))
+        if self._shuffle and self._drop_partitions > 1:
+            # re-shuffle so partitions of one rowgroup don't stay adjacent
+            sub = np.random.default_rng((self._seed, epoch, 1)).permutation(len(items))
+            items = [items[int(i)] for i in sub]
+        return items

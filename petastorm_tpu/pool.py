@@ -1,0 +1,409 @@
+"""Executor pools: the host-side concurrency plane feeding the device pipeline.
+
+Reference parity: petastorm/workers_pool/ (~1,100 LoC) - WorkerBase protocol
+(worker_base.py:18-35), ThreadPool with bounded results queue + stop-aware puts +
+exception forwarding (thread_pool.py:78-221), zmq-based ProcessPool with spawned
+workers, startup barrier, orphan watchdog and slow-joiner workarounds
+(process_pool.py:114-428), DummyPool doing work inside get_results
+(dummy_pool.py:20-91), and ConcurrentVentilator with bounded in-flight and per-epoch
+reshuffle (ventilator.py:55-166).
+
+Design differences (TPU-first):
+
+* **Threads are the default.** pyarrow parquet IO and decode release the GIL, so the
+  reference's zmq process plumbing is usually pure overhead on a TPU host VM;
+  ``ProcessExecutor`` (multiprocessing.spawn, no zmq) remains for GIL-bound python
+  transforms.  Spawn (not fork) for the same reason the reference documents
+  (process_pool.py:15-17: forked JVM/arrow handles break).
+* **Completion-order results with explicit epoch accounting.** The consumer knows
+  exactly how many items each epoch ventilates (ReadPlan is deterministic), so
+  epoch-end is a counted event, not a sentinel race.
+* Worker exceptions carry the formatted remote traceback and re-raise at the
+  consumer (reference thread_pool.py:68-73,169-172).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Iterable, Optional
+
+from petastorm_tpu.errors import PetastormTpuError, ReaderClosedError
+
+logger = logging.getLogger(__name__)
+
+_POLL_S = 0.05
+DEFAULT_RESULTS_QUEUE_SIZE = 50  # reference: reader.py:61
+
+
+class WorkerError(PetastormTpuError):
+    """A worker failed; message includes the remote traceback."""
+
+
+class _Failure:
+    __slots__ = ("formatted",)
+
+    def __init__(self, exc: BaseException):
+        self.formatted = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+
+
+#: worker factory: () -> process_fn(item) -> result.  Must be picklable for
+#: ProcessExecutor (a module-level class instance holding plain-data config).
+WorkerFactory = Callable[[], Callable[[Any], Any]]
+
+
+class ExecutorBase(ABC):
+    """start -> (put*/get*) -> stop -> join lifecycle, mirroring the reference pool
+    protocol (start/ventilate/get_results/stop/join)."""
+
+    def __init__(self):
+        self._stopped = False
+        self._ventilated = 0
+        self._consumed = 0
+
+    @abstractmethod
+    def start(self, worker_factory: WorkerFactory) -> None:
+        ...
+
+    @abstractmethod
+    def put(self, item: Any) -> None:
+        ...
+
+    @abstractmethod
+    def get(self, timeout: Optional[float] = None) -> Any:
+        ...
+
+    @abstractmethod
+    def stop(self) -> None:
+        ...
+
+    @abstractmethod
+    def join(self) -> None:
+        ...
+
+    @property
+    def diagnostics(self) -> dict:
+        return {"ventilated": self._ventilated, "consumed": self._consumed,
+                "stopped": self._stopped}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        self.join()
+
+
+class SerialExecutor(ExecutorBase):
+    """Synchronous executor: work happens inside ``get`` (reference DummyPool,
+    dummy_pool.py:20-91) - for tests, profiling, and debugging.
+
+    The input queue is bounded so a Ventilator with ``num_epochs=None`` cannot
+    enqueue unboundedly ahead of the consumer."""
+
+    def __init__(self, in_queue_size: int = 32):
+        super().__init__()
+        self._items: "queue.Queue[Any]" = queue.Queue(maxsize=in_queue_size)
+        self._fn: Optional[Callable] = None
+
+    def start(self, worker_factory: WorkerFactory) -> None:
+        self._fn = worker_factory()
+
+    def put(self, item: Any) -> None:
+        while not self._stopped:
+            try:
+                self._items.put(item, timeout=_POLL_S)
+                self._ventilated += 1
+                return
+            except queue.Full:
+                continue
+        raise ReaderClosedError("Executor is stopped")
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        if self._fn is None:
+            raise PetastormTpuError("Executor not started")
+        try:
+            item = self._items.get(timeout=timeout or _POLL_S)
+        except queue.Empty:
+            raise queue.Empty("No ventilated items to process")
+        self._consumed += 1
+        return self._fn(item)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def join(self) -> None:
+        pass
+
+
+class ThreadedExecutor(ExecutorBase):
+    """Bounded-queue thread pool (reference ThreadPool, thread_pool.py:78-221).
+
+    pyarrow IO/decompress and cv2 decode release the GIL, so threads scale on
+    multi-core TPU host VMs with zero serialization cost.
+    """
+
+    def __init__(self, workers_count: int = 3,
+                 results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
+                 in_queue_size: Optional[int] = None):
+        super().__init__()
+        self._workers_count = workers_count
+        # reference bounds ventilation at workers_count + 2 (reader.py:45-47,412)
+        self._in_queue: "queue.Queue[Any]" = queue.Queue(in_queue_size or workers_count + 2)
+        self._out_queue: "queue.Queue[Any]" = queue.Queue(results_queue_size)
+        self._stop_event = threading.Event()
+        self._threads = []
+
+    def start(self, worker_factory: WorkerFactory) -> None:
+        if self._threads:
+            raise PetastormTpuError("Executor already started")
+        for i in range(self._workers_count):
+            fn = worker_factory()
+            t = threading.Thread(target=self._worker_loop, args=(fn,),
+                                 name=f"petastorm-tpu-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _worker_loop(self, fn: Callable) -> None:
+        while not self._stop_event.is_set():
+            try:
+                item = self._in_queue.get(timeout=_POLL_S)
+            except queue.Empty:
+                continue
+            try:
+                result = fn(item)
+            except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
+                result = _Failure(exc)
+            self._put_stop_aware(self._out_queue, result)
+
+    def _put_stop_aware(self, q: "queue.Queue", value: Any) -> None:
+        # reference _stop_aware_put (thread_pool.py:200-214)
+        while not self._stop_event.is_set():
+            try:
+                q.put(value, timeout=_POLL_S)
+                return
+            except queue.Full:
+                continue
+
+    def put(self, item: Any) -> None:
+        if self._stopped:
+            raise ReaderClosedError("Executor is stopped")
+        while not self._stop_event.is_set():
+            try:
+                self._in_queue.put(item, timeout=_POLL_S)
+                self._ventilated += 1
+                return
+            except queue.Full:
+                continue
+        raise ReaderClosedError("Executor stopped while putting")
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        result = self._out_queue.get(timeout=timeout)
+        if isinstance(result, _Failure):
+            self.stop()
+            raise WorkerError(f"Worker failed:\n{result.formatted}")
+        self._consumed += 1
+        return result
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._stop_event.set()
+
+    def join(self) -> None:
+        if not self._stopped:
+            raise PetastormTpuError("call stop() before join()")
+        for t in self._threads:
+            t.join()
+
+    @property
+    def diagnostics(self) -> dict:
+        return {**super().diagnostics,
+                "in_queue_size": self._in_queue.qsize(),
+                "results_queue_size": self._out_queue.qsize(),
+                "workers_count": self._workers_count}
+
+
+def _process_worker_main(worker_factory, in_queue, out_queue, stop_event):
+    """Worker-process entrypoint (module-level: must be picklable for spawn)."""
+    try:
+        fn = worker_factory()
+    except BaseException as exc:  # noqa: BLE001
+        out_queue.put(_Failure(exc))
+        return
+    while not stop_event.is_set():
+        try:
+            item = in_queue.get(timeout=_POLL_S)
+        except queue.Empty:
+            continue
+        if item is _ProcessExecutor._STOP_SENTINEL_VALUE:
+            break
+        try:
+            result = fn(item)
+        except BaseException as exc:  # noqa: BLE001
+            result = _Failure(exc)
+        out_queue.put(result)
+
+
+class _ProcessExecutor(ExecutorBase):
+    """Spawned multiprocessing pool for GIL-bound worker functions.
+
+    Replaces the reference's zmq ProcessPool (process_pool.py:114-428): spawn
+    semantics and exception forwarding are kept; the zmq data plane, startup
+    barrier, and slow-joiner workarounds fall away because multiprocessing queues
+    provide them.  Daemon processes make the parent-death watchdog
+    (process_pool.py:324-331) unnecessary.
+    """
+
+    _STOP_SENTINEL_VALUE = "__petastorm_tpu_stop__"
+
+    def __init__(self, workers_count: int = 3,
+                 results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
+                 in_queue_size: Optional[int] = None):
+        super().__init__()
+        import multiprocessing as mp
+
+        self._ctx = mp.get_context("spawn")
+        self._workers_count = workers_count
+        self._in_queue = self._ctx.Queue(in_queue_size or workers_count + 2)
+        self._out_queue = self._ctx.Queue(results_queue_size)
+        self._stop_event = self._ctx.Event()
+        self._procs = []
+
+    def start(self, worker_factory: WorkerFactory) -> None:
+        if self._procs:
+            raise PetastormTpuError("Executor already started")
+        for i in range(self._workers_count):
+            p = self._ctx.Process(
+                target=_process_worker_main,
+                args=(worker_factory, self._in_queue, self._out_queue, self._stop_event),
+                name=f"petastorm-tpu-worker-{i}", daemon=True)
+            p.start()
+            self._procs.append(p)
+
+    def put(self, item: Any) -> None:
+        if self._stopped:
+            raise ReaderClosedError("Executor is stopped")
+        while True:
+            try:
+                self._in_queue.put(item, timeout=_POLL_S)
+                self._ventilated += 1
+                return
+            except queue.Full:
+                if self._stopped:
+                    raise ReaderClosedError("Executor stopped while putting")
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                result = self._out_queue.get(timeout=_POLL_S)
+                break
+            except queue.Empty:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise
+                if self._procs and not any(p.is_alive() for p in self._procs):
+                    raise WorkerError("All worker processes died (possible crash/OOM);"
+                                      " no result will arrive")
+        if isinstance(result, _Failure):
+            self.stop()
+            raise WorkerError(f"Worker failed:\n{result.formatted}")
+        self._consumed += 1
+        return result
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._stop_event.set()
+
+    def join(self) -> None:
+        if not self._stopped:
+            raise PetastormTpuError("call stop() before join()")
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for q in (self._in_queue, self._out_queue):
+            q.cancel_join_thread()
+
+    @property
+    def diagnostics(self) -> dict:
+        return {**super().diagnostics, "workers_count": self._workers_count,
+                "workers_alive": sum(p.is_alive() for p in self._procs)}
+
+
+def make_executor(kind: str = "thread", workers_count: int = 3,
+                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE) -> ExecutorBase:
+    """'thread' | 'process' | 'serial' (reference: reader_pool_type, reader.py:139-150)."""
+    if kind == "thread":
+        return ThreadedExecutor(workers_count, results_queue_size)
+    if kind == "process":
+        return _ProcessExecutor(workers_count, results_queue_size)
+    if kind in ("serial", "dummy"):
+        return SerialExecutor()
+    raise PetastormTpuError(f"Unknown executor kind {kind!r}")
+
+
+class Ventilator:
+    """Background thread feeding epoch work-items into an executor.
+
+    Reference: ConcurrentVentilator (ventilator.py:55-166).  Backpressure comes
+    from the executor's bounded input queue; per-epoch ordering comes from the
+    deterministic ReadPlan, so this thread holds no shuffle state.
+    """
+
+    def __init__(self, executor: ExecutorBase, plan, num_epochs: Optional[int] = 1,
+                 start_item: int = 0):
+        if num_epochs is not None and num_epochs < 1:
+            raise PetastormTpuError("num_epochs must be >= 1 or None (infinite)")
+        if start_item < 0:
+            raise PetastormTpuError("start_item must be >= 0")
+        self._executor = executor
+        self._plan = plan
+        self._num_epochs = num_epochs
+        self._start_item = start_item
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.items_per_epoch = len(plan.epoch_items(0))
+
+    @property
+    def total_items(self) -> Optional[int]:
+        """Items this ventilator will emit (excludes skipped resume prefix)."""
+        if self._num_epochs is None:
+            return None
+        return max(self.items_per_epoch * self._num_epochs - self._start_item, 0)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="petastorm-tpu-ventilator",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        # resume: skip whole epochs cheaply, then a within-epoch offset
+        if self.items_per_epoch > 0:
+            epoch = self._start_item // self.items_per_epoch
+            offset = self._start_item % self.items_per_epoch
+        else:
+            epoch, offset = 0, 0
+        while not self._stop_event.is_set():
+            if self._num_epochs is not None and epoch >= self._num_epochs:
+                return
+            for item in self._plan.epoch_items(epoch)[offset:]:
+                if self._stop_event.is_set():
+                    return
+                try:
+                    self._executor.put(item)
+                except ReaderClosedError:
+                    return
+            offset = 0
+            epoch += 1
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    def join(self) -> None:
+        if self._thread is not None:
+            self._thread.join()

@@ -1,0 +1,353 @@
+"""Reader core: make_reader / make_batch_reader factories and the Reader iterator.
+
+Reference parity: petastorm/reader.py (631 LoC) -
+``make_reader`` (reader.py:59-176), ``make_batch_reader`` (reader.py:179-290),
+``Reader.__init__`` pipeline (reader.py:344-351: open dataset -> load schema ->
+view/transform -> list rowgroups -> filter by predicate/selector/shard -> ventilate
+-> start pool), sharding (reader.py:492-509), partition-level predicate pushdown
+(reader.py:532-563), selector filtering (reader.py:511-530), shuffle knobs
+(reader.py:565-592), epoch iteration + reset-after-epoch-only (reader.py:423-447),
+context manager stop/join (reader.py:594-631), diagnostics (reader.py:603-605).
+
+Design differences (TPU-first):
+
+* One columnar decode plane (petastorm_tpu/worker.py) serves both factories; the
+  row/batch distinction is only how the iterator unpacks ColumnBatches.  The
+  reference's per-row dict path (its main CPU bottleneck, SURVEY.md section 7) does
+  not exist here.
+* Deterministic seeded plans (petastorm_tpu/plan.py) make epochs reproducible and
+  resumable: ``Reader.state_dict()`` captures a work-item cursor and
+  ``make_reader(..., resume_from=state)`` restarts ventilation at that cursor -
+  the checkpoint/resume gap called out in SURVEY.md section 5.  The cursor is
+  exact at epoch boundaries; mid-epoch it is approximate by up to the in-flight
+  window (workers complete items out of order), so pair it with a shuffle_seed
+  and snapshot at step boundaries for deterministic training resumption.
+* ``cur_shard``/``shard_count`` stay explicit here; ``petastorm_tpu.jax`` defaults
+  them from the JAX process mesh (this module stays jax-free).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.cache import make_cache
+from petastorm_tpu.errors import (EpochNotFinishedError, MetadataError,
+                                  NoDataAvailableError, PetastormTpuError,
+                                  ReaderClosedError)
+from petastorm_tpu.etl.indexing import get_row_group_indexes
+from petastorm_tpu.etl.metadata import open_dataset
+from petastorm_tpu.fs import FilesystemFactory
+from petastorm_tpu.plan import ReadPlan
+from petastorm_tpu.pool import Ventilator, make_executor
+from petastorm_tpu.schema import Schema
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.worker import RowGroupDecoderWorker
+
+logger = logging.getLogger(__name__)
+
+_GET_TIMEOUT_S = 0.5
+_DEFAULT_RESULTS_QUEUE_BATCHES = 10  # batches are whole rowgroups; keep RAM bounded
+
+
+def make_reader(dataset_url: str,
+                schema_fields: Optional[Sequence] = None,
+                reader_pool_type: str = "thread",
+                workers_count: int = 4,
+                results_queue_size: int = _DEFAULT_RESULTS_QUEUE_BATCHES,
+                shuffle_row_groups: bool = True,
+                shuffle_row_drop_partitions: int = 1,
+                shuffle_seed: Optional[int] = None,
+                predicate=None,
+                rowgroup_selector=None,
+                num_epochs: Optional[int] = 1,
+                cur_shard: Optional[int] = None,
+                shard_count: Optional[int] = None,
+                shard_mode: str = "static",
+                cache_type: str = "null",
+                cache_location: Optional[str] = None,
+                cache_size_limit: Optional[int] = None,
+                transform_spec: Optional[TransformSpec] = None,
+                storage_options: Optional[dict] = None,
+                filesystem=None,
+                resume_from: Optional[dict] = None) -> "Reader":
+    """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
+
+    Reference: ``make_reader`` (reader.py:59-176).  Yields one namedtuple row per
+    ``next()``; for the TPU feed path prefer ``make_batch_reader`` +
+    ``petastorm_tpu.jax`` (columnar, batched, device-sharded).
+    """
+    return _make_reader_impl(dataset_url, schema_fields, reader_pool_type,
+                             workers_count, results_queue_size, shuffle_row_groups,
+                             shuffle_row_drop_partitions, shuffle_seed, predicate,
+                             rowgroup_selector, num_epochs, cur_shard, shard_count,
+                             shard_mode, cache_type, cache_location, cache_size_limit,
+                             transform_spec, storage_options, filesystem,
+                             batched_output=False, require_stored_schema=True,
+                             resume_from=resume_from)
+
+
+def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
+                      schema_fields: Optional[Sequence] = None,
+                      reader_pool_type: str = "thread",
+                      workers_count: int = 4,
+                      results_queue_size: int = _DEFAULT_RESULTS_QUEUE_BATCHES,
+                      shuffle_row_groups: bool = True,
+                      shuffle_row_drop_partitions: int = 1,
+                      shuffle_seed: Optional[int] = None,
+                      predicate=None,
+                      rowgroup_selector=None,
+                      num_epochs: Optional[int] = 1,
+                      cur_shard: Optional[int] = None,
+                      shard_count: Optional[int] = None,
+                      shard_mode: str = "static",
+                      cache_type: str = "null",
+                      cache_location: Optional[str] = None,
+                      cache_size_limit: Optional[int] = None,
+                      transform_spec: Optional[TransformSpec] = None,
+                      storage_options: Optional[dict] = None,
+                      filesystem=None,
+                      resume_from: Optional[dict] = None) -> "Reader":
+    """Columnar batch reader for arbitrary parquet stores (schema inferred when no
+    petastorm_tpu metadata exists).
+
+    Reference: ``make_batch_reader`` (reader.py:179-290).  Yields one namedtuple of
+    column arrays per decoded rowgroup.
+    """
+    return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
+                             workers_count, results_queue_size, shuffle_row_groups,
+                             shuffle_row_drop_partitions, shuffle_seed, predicate,
+                             rowgroup_selector, num_epochs, cur_shard, shard_count,
+                             shard_mode, cache_type, cache_location, cache_size_limit,
+                             transform_spec, storage_options, filesystem,
+                             batched_output=True, require_stored_schema=False,
+                             resume_from=resume_from)
+
+
+def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
+                      results_queue_size, shuffle_row_groups,
+                      shuffle_row_drop_partitions, shuffle_seed, predicate,
+                      rowgroup_selector, num_epochs, cur_shard, shard_count,
+                      shard_mode, cache_type, cache_location, cache_size_limit,
+                      transform_spec, storage_options, filesystem,
+                      batched_output, require_stored_schema,
+                      resume_from: Optional[dict] = None) -> "Reader":
+    try:
+        info = open_dataset(dataset_url, storage_options=storage_options,
+                            filesystem=filesystem,
+                            require_stored_schema=require_stored_schema)
+    except MetadataError as exc:
+        if require_stored_schema:
+            raise MetadataError(
+                f"{exc}  (make_reader requires a petastorm_tpu dataset; for plain"
+                " parquet use make_batch_reader)") from exc
+        raise
+
+    from petastorm_tpu.etl.metadata import infer_or_load_schema
+
+    full_schema = infer_or_load_schema(info)
+    view = full_schema.view(schema_fields) if schema_fields is not None else full_schema
+    output_schema = (transform_schema(view, transform_spec)
+                     if transform_spec is not None else view)
+
+    row_groups = info.row_groups
+    # selector filter (reference reader.py:511-530)
+    if rowgroup_selector is not None:
+        indexes = get_row_group_indexes(info)
+        selected = rowgroup_selector.select_row_groups(indexes)
+        row_groups = [rg for rg in row_groups if rg.global_index in selected]
+        if not row_groups:
+            raise NoDataAvailableError("Rowgroup selector selected no rowgroups")
+    # partition-level predicate pushdown (reference reader.py:532-563)
+    worker_predicate = predicate
+    if predicate is not None:
+        pred_fields = set(predicate.get_fields())
+        pkeys = set(info.partition_keys)
+        if pred_fields and pred_fields <= pkeys:
+            kept = []
+            for rg in row_groups:
+                pvals = dict(rg.partition_values)
+                cols = {}
+                for f in pred_fields:
+                    # hive path values are strings; restore the field's dtype so
+                    # the predicate sees the same types the worker path would
+                    value = pvals[f]
+                    field = full_schema[f] if f in full_schema else None
+                    if field is not None and field.dtype.kind not in ("U", "S", "O"):
+                        value = field.dtype.type(value)
+                    cols[f] = np.asarray([value], dtype=object)
+                if bool(predicate.do_include_vectorized(cols)[0]):
+                    kept.append(rg)
+            row_groups = kept
+            worker_predicate = None
+            if not row_groups:
+                raise NoDataAvailableError("Predicate filtered out all partitions")
+
+    plan = ReadPlan(row_groups, shard_index=cur_shard, shard_count=shard_count,
+                    shuffle_row_groups=shuffle_row_groups, shuffle_seed=shuffle_seed,
+                    shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                    shard_mode=shard_mode)
+
+    cache = make_cache(cache_type, cache_location, cache_size_limit)
+    # cache+predicate is disallowed (reference py_dict_reader_worker.py:145-150);
+    # cache+row-drop is fine here because cache keys include the row slice
+    if cache_type not in (None, "null", "none") and worker_predicate is not None:
+        raise PetastormTpuError("cache_type cannot be combined with a predicate")
+
+    read_fields = [f.name for f in view]
+    fs_factory = FilesystemFactory(dataset_url if isinstance(dataset_url, str)
+                                   else dataset_url[0], storage_options,
+                                   filesystem=filesystem)
+    worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
+                                   predicate=worker_predicate,
+                                   transform=transform_spec, cache=cache)
+
+    executor = make_executor(reader_pool_type, workers_count, results_queue_size)
+    start_item = 0
+    if resume_from is not None:
+        start_item = int(resume_from.get("position", 0))
+    return Reader(info=info, schema=output_schema, plan=plan, executor=executor,
+                  worker=worker, num_epochs=num_epochs, batched_output=batched_output,
+                  start_item=start_item)
+
+
+class Reader:
+    """Iterator over decoded data; context manager owning the executor.
+
+    Row path: one namedtuple per row.  Batch path: one namedtuple of column arrays
+    per rowgroup (reference reader.py:277-290).
+    """
+
+    def __init__(self, info, schema: Schema, plan: ReadPlan, executor, worker,
+                 num_epochs: Optional[int], batched_output: bool,
+                 start_item: int = 0):
+        self.dataset_info = info
+        self.schema = schema
+        self.batched_output = batched_output
+        self._plan = plan
+        self._executor = executor
+        self._num_epochs = num_epochs
+        self._stopped = False
+        self.last_row_consumed = False
+
+        self._start_item = start_item
+        self._consumed_items = 0
+        self._current: Optional[ColumnBatch] = None
+        self._current_pos = 0
+        self._namedtuple_type = schema.make_namedtuple_type()
+
+        self._executor.start(worker)
+        self._ventilator = Ventilator(executor, plan, num_epochs,
+                                      start_item=start_item)
+        self._expected_items = self._ventilator.total_items
+        self._ventilator.start()
+
+    # -- iteration ------------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stopped:
+            raise ReaderClosedError("Reader is stopped")
+        if self.batched_output:
+            batch = self._next_batch()
+            return self._namedtuple_type(**{n: batch.columns[n]
+                                            for n in self.schema.fields})
+        if self._current is None or self._current_pos >= self._current.num_rows:
+            self._current = self._next_batch()
+            self._current_pos = 0
+        row = self._current.row(self._current_pos)
+        self._current_pos += 1
+        if (self._current_pos >= self._current.num_rows
+                and self._all_items_consumed()):
+            self.last_row_consumed = True
+        return self._namedtuple_type(**{n: row[n] for n in self.schema.fields})
+
+    def _all_items_consumed(self) -> bool:
+        return (self._expected_items is not None
+                and self._consumed_items >= self._expected_items)
+
+    def _next_batch(self) -> ColumnBatch:
+        """Next non-empty ColumnBatch, or StopIteration at end of all epochs."""
+        while True:
+            if self._all_items_consumed():
+                self.last_row_consumed = True
+                raise StopIteration
+            try:
+                batch = self._executor.get(timeout=_GET_TIMEOUT_S)
+            except queue.Empty:
+                continue
+            self._consumed_items += 1
+            if batch.num_rows > 0:
+                if self.batched_output and self._all_items_consumed():
+                    # batch path: flag as the final value is returned; the row
+                    # path flags only after the last row is actually popped
+                    self.last_row_consumed = True
+                return batch
+            # empty batch (predicate filtered everything): keep pulling
+
+
+    # -- epoch control --------------------------------------------------------
+
+    def reset(self) -> None:
+        """Restart iteration; only legal after the epoch finished (reference
+        contract, reader.py:423-447)."""
+        if self._stopped:
+            raise ReaderClosedError("Reader is stopped")
+        if not self._all_items_consumed():
+            raise EpochNotFinishedError(
+                "reset() called mid-epoch: in-flight work items would leak into"
+                " the next epoch. Consume the iterator fully first.")
+        self._ventilator.stop()
+        self._ventilator.join()
+        self._start_item = 0
+        self._consumed_items = 0
+        self._current = None
+        self._current_pos = 0
+        self.last_row_consumed = False
+        self._ventilator = Ventilator(self._executor, self._plan, self._num_epochs)
+        self._expected_items = self._ventilator.total_items
+        self._ventilator.start()
+
+    # -- resume support (reference gap: SURVEY.md section 5 checkpoint/resume) --
+
+    def state_dict(self) -> dict:
+        """Work-item cursor for ``make_reader(..., resume_from=state)``.
+
+        Exact at epoch boundaries; mid-epoch the cursor counts *completed* items,
+        which can differ from the ventilation prefix by up to the in-flight window
+        (see module docstring).  Same (dataset, seed, shard, epoch-count) settings
+        must be passed when resuming.
+        """
+        return {"position": self._start_item + self._consumed_items,
+                "items_per_epoch": self._ventilator.items_per_epoch}
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._ventilator.stop()
+        self._executor.stop()
+
+    def join(self) -> None:
+        self._ventilator.join()
+        self._executor.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        self.join()
+
+    @property
+    def diagnostics(self) -> dict:
+        return {**self._executor.diagnostics,
+                "items_per_epoch": self._ventilator.items_per_epoch,
+                "consumed_items": self._consumed_items,
+                "expected_items": self._expected_items}

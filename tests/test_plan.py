@@ -1,0 +1,110 @@
+"""ReadPlan tests: determinism, sharding, row-drop splits.
+
+Reference models: shard tests test_end_to_end.py:395,454 and the
+normalize/row-drop logic reader.py:565-592.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import NoDataAvailableError, PetastormTpuError
+from petastorm_tpu.etl.metadata import RowGroupRef
+from petastorm_tpu.plan import ReadPlan, WorkItem, _drop_slice
+
+
+def _rgs(n, rows_each=10):
+    return [RowGroupRef(path=f"/f{i // 4}.parquet", row_group=i % 4,
+                        num_rows=rows_each, global_index=i) for i in range(n)]
+
+
+def test_no_shuffle_is_sequential():
+    plan = ReadPlan(_rgs(8), shuffle_row_groups=False)
+    items = plan.epoch_items(0)
+    assert [it.row_group.global_index for it in items] == list(range(8))
+
+
+def test_shuffle_deterministic_per_seed_and_epoch():
+    plan = ReadPlan(_rgs(32), shuffle_seed=7)
+    e0a = [it.row_group.global_index for it in plan.epoch_items(0)]
+    e0b = [it.row_group.global_index for it in plan.epoch_items(0)]
+    e1 = [it.row_group.global_index for it in plan.epoch_items(1)]
+    assert e0a == e0b            # reproducible
+    assert e0a != e1             # reshuffled per epoch
+    assert sorted(e0a) == sorted(e1) == list(range(32))
+    other_seed = [it.row_group.global_index for it in ReadPlan(_rgs(32), shuffle_seed=8)
+                  .epoch_items(0)]
+    assert e0a != other_seed
+
+
+def test_static_sharding_disjoint_and_complete():
+    # reference: test_partition_multi_node (test_end_to_end.py:454)
+    shards = [ReadPlan(_rgs(10), shard_index=i, shard_count=3, shuffle_seed=1,
+                       shard_mode="static") for i in range(3)]
+    per_shard = [{it.row_group.global_index for it in s.epoch_items(0)} for s in shards]
+    assert set().union(*per_shard) == set(range(10))
+    for i in range(3):
+        for j in range(i + 1, 3):
+            assert not (per_shard[i] & per_shard[j])
+    # static: same membership every epoch
+    assert per_shard[0] == {it.row_group.global_index for it in shards[0].epoch_items(5)}
+
+
+def test_epoch_sharding_redeals_but_stays_disjoint():
+    shards = [ReadPlan(_rgs(12), shard_index=i, shard_count=4, shuffle_seed=3,
+                       shard_mode="epoch") for i in range(4)]
+    for epoch in (0, 1):
+        per_shard = [{it.row_group.global_index for it in s.epoch_items(epoch)}
+                     for s in shards]
+        assert set().union(*per_shard) == set(range(12))
+        assert sum(len(p) for p in per_shard) == 12
+    e0 = {it.row_group.global_index for it in shards[0].epoch_items(0)}
+    e1 = {it.row_group.global_index for it in shards[0].epoch_items(1)}
+    assert e0 != e1  # membership re-dealt across epochs (global shuffle)
+
+
+def test_items_per_epoch_constant():
+    plan = ReadPlan(_rgs(13), shard_index=1, shard_count=4, shard_mode="epoch",
+                    shuffle_seed=0)
+    lengths = {len(plan.epoch_items(e)) for e in range(5)}
+    assert len(lengths) == 1
+
+
+def test_too_many_shards_raises():
+    # reference: test_too_many_shards (test_end_to_end.py:395)
+    with pytest.raises(NoDataAvailableError):
+        ReadPlan(_rgs(2), shard_index=0, shard_count=5)
+
+
+def test_shard_args_validation():
+    with pytest.raises(PetastormTpuError):
+        ReadPlan(_rgs(4), shard_index=1)
+    with pytest.raises(PetastormTpuError):
+        ReadPlan(_rgs(4), shard_index=4, shard_count=4)
+
+
+def test_row_drop_partitions_cover_all_rows():
+    plan = ReadPlan(_rgs(3, rows_each=11), shuffle_row_drop_partitions=3,
+                    shuffle_seed=2)
+    items = plan.epoch_items(0)
+    assert len(items) == 9
+    by_rg = {}
+    for it in items:
+        by_rg.setdefault(it.row_group.global_index, []).append(it.row_slice())
+    for slices in by_rg.values():
+        covered = sorted(slices)
+        assert covered[0][0] == 0 and covered[-1][1] == 11
+        for (a, b), (c, d) in zip(covered, covered[1:]):
+            assert b == c  # contiguous, non-overlapping
+    assert plan.rows_per_epoch() == 33
+
+
+def test_drop_slice_arithmetic():
+    assert _drop_slice(10, 0, 3) == (0, 4)
+    assert _drop_slice(10, 1, 3) == (4, 7)
+    assert _drop_slice(10, 2, 3) == (7, 10)
+
+
+def test_work_item_num_rows():
+    rg = RowGroupRef("/f", 0, 10, 0)
+    assert WorkItem(rg).num_rows == 10
+    assert WorkItem(rg, (0, 4)).num_rows == 3

@@ -1,0 +1,149 @@
+"""Executor pool tests across all flavors with stub workers.
+
+Reference model: petastorm/workers_pool/tests/test_workers_pool.py:19-60 - one
+shared test impl parametrized over pools; exception propagation; ventilator
+semantics tested separately (test_ventilator.py).
+"""
+
+import queue
+import time
+
+import pytest
+
+from petastorm_tpu.errors import ReaderClosedError
+from petastorm_tpu.etl.metadata import RowGroupRef
+from petastorm_tpu.plan import ReadPlan
+from petastorm_tpu.pool import (SerialExecutor, ThreadedExecutor, Ventilator,
+                                WorkerError, make_executor)
+from petastorm_tpu.test_util.stub_workers import (ExplodingWorker, MultiplierWorker,
+                                                  PidWorker, SleepyWorker)
+
+ALL_KINDS = ["serial", "thread", "process"]
+FAST_KINDS = ["serial", "thread"]
+
+
+def _collect(executor, n, timeout=30):
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(out) < n:
+        remaining = deadline - time.monotonic()
+        assert remaining > 0, f"timed out with {len(out)}/{n} results"
+        try:
+            out.append(executor.get(timeout=min(remaining, 0.5)))
+        except queue.Empty:
+            continue
+    return out
+
+
+@pytest.mark.parametrize("kind", ALL_KINDS)
+def test_roundtrip_all_flavors(kind):
+    with make_executor(kind, workers_count=2) as ex:
+        ex.start(MultiplierWorker(3))
+        for i in range(10):
+            ex.put(i)
+        results = _collect(ex, 10)
+    assert sorted(results) == [i * 3 for i in range(10)]
+
+
+@pytest.mark.parametrize("kind", ALL_KINDS)
+def test_exception_propagates(kind):
+    with make_executor(kind, workers_count=2) as ex:
+        ex.start(ExplodingWorker(trigger=3))
+        for i in range(5):
+            ex.put(i)
+        with pytest.raises((WorkerError, RuntimeError)) as ei:
+            _collect(ex, 5)
+        assert "boom" in str(ei.value)
+
+
+def test_process_pool_real_isolation():
+    import os
+    with make_executor("process", workers_count=2) as ex:
+        ex.start(PidWorker())
+        for i in range(4):
+            ex.put(i)
+        pids = set(_collect(ex, 4))
+    assert os.getpid() not in pids
+    assert 1 <= len(pids) <= 2
+
+
+def test_thread_pool_parallelism():
+    with ThreadedExecutor(workers_count=4) as ex:
+        ex.start(SleepyWorker(0.05))
+        t0 = time.monotonic()
+        for i in range(8):
+            ex.put(i)
+        _collect(ex, 8)
+        elapsed = time.monotonic() - t0
+    assert elapsed < 8 * 0.05  # must overlap sleeps
+
+
+def test_put_after_stop_raises():
+    ex = SerialExecutor()
+    ex.start(MultiplierWorker(1))
+    ex.stop()
+    with pytest.raises(ReaderClosedError):
+        ex.put(1)
+
+
+def test_diagnostics():
+    with ThreadedExecutor(workers_count=2) as ex:
+        ex.start(MultiplierWorker(1))
+        ex.put(1)
+        ex.get(timeout=5)
+        d = ex.diagnostics
+        assert d["ventilated"] == 1 and d["consumed"] == 1
+        assert d["workers_count"] == 2
+
+
+def _plan(n=6):
+    rgs = [RowGroupRef(f"/f{i}", 0, 5, i) for i in range(n)]
+    return ReadPlan(rgs, shuffle_row_groups=False)
+
+
+def test_ventilator_single_epoch():
+    with ThreadedExecutor(workers_count=2) as ex:
+        ex.start(SleepyWorker(0))
+        vent = Ventilator(ex, _plan(6), num_epochs=1)
+        assert vent.total_items == 6
+        vent.start()
+        results = _collect(ex, 6)
+        vent.join()
+    assert len(results) == 6
+
+
+def test_ventilator_multi_epoch():
+    with ThreadedExecutor(workers_count=2) as ex:
+        ex.start(SleepyWorker(0))
+        vent = Ventilator(ex, _plan(4), num_epochs=3)
+        assert vent.total_items == 12
+        vent.start()
+        results = _collect(ex, 12)
+        vent.join()
+    assert len(results) == 12
+
+
+def test_ventilator_infinite_stops_cleanly():
+    with ThreadedExecutor(workers_count=2) as ex:
+        ex.start(SleepyWorker(0))
+        vent = Ventilator(ex, _plan(4), num_epochs=None)
+        assert vent.total_items is None
+        vent.start()
+        _collect(ex, 20)  # well past one epoch
+        vent.stop()
+        ex.stop()
+        vent.join()
+
+
+def test_ventilator_backpressure():
+    # bounded in-queue: ventilator must not race ahead of consumption
+    ex = ThreadedExecutor(workers_count=1, in_queue_size=2, results_queue_size=2)
+    with ex:
+        ex.start(SleepyWorker(0))
+        vent = Ventilator(ex, _plan(50), num_epochs=1)
+        vent.start()
+        time.sleep(0.3)
+        # at most in_queue(2) + results(2) + 1 in-hand can be in flight
+        assert ex.diagnostics["ventilated"] <= 6
+        _collect(ex, 50)
+        vent.join()
