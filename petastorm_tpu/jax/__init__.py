@@ -1,0 +1,11 @@
+"""JAX delivery layer: readers -> device-sharded ``jax.Array`` batches.
+
+This is the BASELINE.json north star ("a petastorm.jax.DataLoader alongside
+petastorm.pytorch and tf_utils"): ColumnBatches from the reader land on TPU as
+global ``jax.Array``s with a caller-chosen ``NamedSharding``, with host-side
+shuffle/batch/pad and a device-transfer prefetch queue in between.
+"""
+
+from petastorm_tpu.jax.loader import JaxDataLoader, make_jax_loader
+
+__all__ = ["JaxDataLoader", "make_jax_loader"]

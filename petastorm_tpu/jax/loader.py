@@ -1,0 +1,405 @@
+"""JaxDataLoader: columnar batches -> device-sharded jax.Array pytrees.
+
+Reference parity: petastorm/pytorch.py DataLoader/BatchedDataLoader (shuffling
+buffer -> collate -> torch tensors, pytorch.py:130-367) and tf_utils
+``make_petastorm_dataset`` (tf_utils.py:329-399).  What replaces what:
+
+* torch shuffling buffers        -> columnar numpy RandomShufflingBuffer
+                                    (petastorm_tpu/shuffle.py)
+* default_collate per batch      -> exact-size batch assembly crossing rowgroup
+                                    boundaries (reference's un-wired
+                                    batching_table_queue, SURVEY.md 2.13)
+* torch.as_tensor(device=...)    -> ``jax.make_array_from_process_local_data``
+                                    with an explicit NamedSharding: each host
+                                    feeds exactly its slice of the global batch;
+                                    XLA moves shards over ICI/DCN
+* tf py_func/queue runners       -> a plain python producer thread + bounded
+                                    device-transfer queue (depth ``prefetch``,
+                                    default 2 = double buffering; jax transfers
+                                    are async so host prep overlaps device step)
+
+TPU-specific behavior:
+
+* dtype promotion happens here, once, at the device boundary
+  (petastorm_tpu/dtypes.jax_feed_dtype - uint16->int32 etc., f64->f32).
+* variable-shape fields must be resolved to static shapes via ``pad_shapes``
+  (XLA compiles per shape; pad-to-bucket beats recompilation) or excluded.
+* string/object fields cannot reach the device: select them out with ``fields=``
+  or keep them host-side via ``host_fields``.
+* sequence-parallel consumers: pass a PartitionSpec sharding the sequence axis
+  (e.g. P('data', 'seq')); the loader materializes only this host's sequence
+  slice before assembly (petastorm_tpu/parallel/mesh.local_data_slice).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.dtypes import jax_feed_dtype
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.parallel.mesh import local_data_slice, sharding_for_batch
+from petastorm_tpu.shuffle import NoopShufflingBuffer, RandomShufflingBuffer
+
+logger = logging.getLogger(__name__)
+
+_QUEUE_POLL_S = 0.1
+
+
+class _Done:
+    pass
+
+
+class _Error:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class JaxDataLoader:
+    """Iterate device-sharded batches (dict field -> jax.Array) from a Reader.
+
+    ``batch_size`` is the GLOBAL batch size across the whole mesh; this process
+    materializes only its slice (global/process_count for a data-sharded axis).
+
+    With ``drop_last=False`` on a mesh, the final partial batch is zero-padded to
+    the static batch size (constant shapes = no XLA recompile, even shards) and
+    carries an extra ``'_valid_rows'`` host int with the true row count.
+    """
+
+    def __init__(self,
+                 reader,
+                 batch_size: int,
+                 mesh: Optional[Mesh] = None,
+                 shardings: Union[None, PartitionSpec, Dict[str, PartitionSpec]] = None,
+                 fields: Optional[Sequence[str]] = None,
+                 host_fields: Sequence[str] = (),
+                 shuffling_queue_capacity: int = 0,
+                 min_after_retrieve: Optional[int] = None,
+                 buffer_seed: Optional[int] = None,
+                 pad_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 pad_values: Union[float, Dict[str, float]] = 0,
+                 drop_last: bool = True,
+                 prefetch: int = 2,
+                 keep_wide_dtypes: bool = False,
+                 transform_fn: Optional[Callable[[Dict[str, np.ndarray]],
+                                                 Dict[str, np.ndarray]]] = None):
+        self._reader = reader
+        self._mesh = mesh
+        self._specs = shardings
+        self._pad_shapes = dict(pad_shapes or {})
+        self._pad_values = pad_values
+        self._drop_last = drop_last
+        self._keep_wide = keep_wide_dtypes
+        self._transform_fn = transform_fn
+        self._host_fields = list(host_fields)
+
+        schema = reader.schema
+        self._fields = list(fields) if fields is not None else [
+            f.name for f in schema if f.name not in self._host_fields]
+        unknown = [f for f in self._fields + self._host_fields if f not in schema]
+        if unknown:
+            raise PetastormTpuError(f"Unknown fields {unknown}; schema has"
+                                    f" {[f.name for f in schema]}")
+        if not self._fields:
+            raise PetastormTpuError(
+                "JaxDataLoader needs at least one device-deliverable field"
+                " (all schema fields were excluded or routed to host_fields)")
+        self._validate_deliverable(schema)
+
+        if batch_size < 1:
+            raise PetastormTpuError("batch_size must be >= 1")
+        self._global_batch = batch_size
+        self._local_rows, self._local_seq_slices = self._local_layout()
+
+        if shuffling_queue_capacity and shuffling_queue_capacity > 0:
+            min_after = (min_after_retrieve if min_after_retrieve is not None
+                         else shuffling_queue_capacity // 2)
+            self._make_buffer = lambda: RandomShufflingBuffer(
+                shuffling_queue_capacity, min_after, seed=buffer_seed)
+        else:
+            self._make_buffer = NoopShufflingBuffer
+
+        self._out: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+        self._stop_event = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="petastorm-tpu-jax-loader")
+        self._started = False
+
+    # -- shape/sharding bookkeeping ------------------------------------------
+
+    def _validate_deliverable(self, schema) -> None:
+        for name in self._fields:
+            field = schema[name]
+            if field.dtype.kind in ("U", "S", "O", "M", "m"):
+                raise PetastormTpuError(
+                    f"Field {name!r} (dtype {field.dtype}) cannot be fed to a"
+                    " device. Exclude it with fields=, or keep it host-side via"
+                    " host_fields=.")
+            if not field.is_fixed_shape and name not in self._pad_shapes:
+                raise PetastormTpuError(
+                    f"Field {name!r} has variable shape {field.shape}; XLA needs"
+                    " static shapes - give it a pad_shapes entry (pad-to-bucket)"
+                    " or exclude it.")
+
+    def _spec_for(self, name: str) -> PartitionSpec:
+        if isinstance(self._specs, dict):
+            spec = self._specs.get(name)
+        else:
+            spec = self._specs
+        if spec is None:
+            axis = self._mesh.axis_names[0] if self._mesh is not None else "data"
+            spec = PartitionSpec(axis)
+        return spec
+
+    def _local_layout(self):
+        """Rows this process contributes + per-field sequence slices."""
+        if self._mesh is None:
+            return self._global_batch, {}
+        local_rows = None
+        for name in self._fields:
+            spec = self._spec_for(name)
+            # probe only the batch axis: trailing sharded dims resolve per batch
+            batch_axis_spec = PartitionSpec(spec[0] if len(spec) else None)
+            sharding = NamedSharding(self._mesh, batch_axis_spec)
+            sl = local_data_slice(sharding, (self._global_batch,))
+            rows = sl[0].stop - sl[0].start
+            if local_rows is None:
+                local_rows = rows
+            elif local_rows != rows:
+                raise PetastormTpuError(
+                    "All delivered fields must shard the batch axis identically"
+                    f" (field {name!r} wants {rows} local rows, others"
+                    f" {local_rows})")
+        return int(local_rows), {}
+
+    # -- producer thread ------------------------------------------------------
+
+    def _prepare(self, batch: ColumnBatch) -> ColumnBatch:
+        cols: Dict[str, np.ndarray] = {}
+        for name in self._fields + self._host_fields:
+            col = batch.columns[name]
+            if name in self._pad_shapes:
+                col = _pad_to(col, self._pad_shapes[name],
+                              self._pad_value_for(name),
+                              self._reader.schema[name].dtype)
+            cols[name] = col
+        return ColumnBatch(cols, batch.num_rows)
+
+    def _pad_value_for(self, name: str):
+        if isinstance(self._pad_values, dict):
+            return self._pad_values.get(name, 0)
+        return self._pad_values
+
+    def _produce(self) -> None:
+        try:
+            buffer = self._make_buffer()
+            local_bs = self._local_rows
+            source = self._reader.iter_batches()
+            exhausted = False
+            while not self._stop_event.is_set():
+                # fill until a batch is retrievable (or source exhausted)
+                while not exhausted and not buffer.can_retrieve(local_bs):
+                    try:
+                        raw = next(source)
+                    except StopIteration:
+                        exhausted = True
+                        buffer.finish()
+                        break
+                    batch = self._prepare(raw)
+                    # add in slices that respect buffer capacity
+                    pos = 0
+                    while pos < batch.num_rows and not self._stop_event.is_set():
+                        if isinstance(buffer, RandomShufflingBuffer):
+                            free = buffer.free_space
+                            if free == 0:
+                                if buffer.can_retrieve(local_bs):
+                                    self._emit(buffer.retrieve(local_bs))
+                                    continue
+                                raise PetastormTpuError(
+                                    "Shuffling buffer deadlock: capacity"
+                                    f" {buffer._capacity} cannot hold"
+                                    f" min_after + batch; raise"
+                                    " shuffling_queue_capacity")
+                            take = min(free, batch.num_rows - pos)
+                        else:
+                            take = batch.num_rows - pos
+                        buffer.add(batch.slice_rows(pos, pos + take))
+                        pos += take
+                while buffer.can_retrieve(local_bs) and not self._stop_event.is_set():
+                    out = buffer.retrieve(local_bs)
+                    if out.num_rows < local_bs:
+                        if not self._drop_last:
+                            self._emit(out)
+                        break
+                    self._emit(out)
+                if exhausted and buffer.size == 0:
+                    break
+            self._push(_Done())
+        except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
+            self._push(_Error(exc))
+
+    def _emit(self, host_batch: ColumnBatch) -> None:
+        cols = {n: host_batch.columns[n] for n in self._fields}
+        if self._transform_fn is not None:
+            cols = self._transform_fn(cols)
+        device_batch = {}
+        valid_rows = host_batch.num_rows
+        if self._mesh is not None and valid_rows < self._local_rows:
+            # partial final batch on a mesh: zero-pad to the static local batch so
+            # the global shape (and the consumer's jit signature) never changes -
+            # XLA recompiles per shape, and uneven shards break global assembly.
+            # '_valid_rows' tells the consumer how many rows are real.
+            pad = self._local_rows - valid_rows
+            cols = {name: np.concatenate(
+                [col, np.zeros((pad,) + col.shape[1:], dtype=col.dtype)])
+                for name, col in cols.items()}
+        for name, col in cols.items():
+            arr = np.ascontiguousarray(col)
+            feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
+            if arr.dtype != feed_dtype:
+                arr = arr.astype(feed_dtype)
+            if self._mesh is not None:
+                sharding = NamedSharding(self._mesh, self._spec_for(name))
+                global_shape = (self._global_batch,) + arr.shape[1:]
+                sl = local_data_slice(sharding, global_shape)
+                arr = arr[(slice(None),) + sl[1:]]  # sequence/model-axis slice
+                device_batch[name] = jax.make_array_from_process_local_data(
+                    sharding, arr, global_shape)
+            else:
+                device_batch[name] = jax.device_put(arr)
+        for name in self._host_fields:
+            device_batch[name] = host_batch.columns[name]
+        if self._mesh is not None and valid_rows < self._local_rows:
+            device_batch["_valid_rows"] = valid_rows
+        self._push(device_batch)
+
+    def _push(self, value) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self._out.put(value, timeout=_QUEUE_POLL_S)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer -------------------------------------------------------------
+
+    def __iter__(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def __next__(self) -> Dict[str, jax.Array]:
+        if not self._started:
+            iter(self)
+        while True:
+            try:
+                value = self._out.get(timeout=_QUEUE_POLL_S)
+                break
+            except queue.Empty:
+                if self._stop_event.is_set():
+                    raise StopIteration
+                if not self._thread.is_alive():
+                    # the producer may have pushed its sentinel between our
+                    # timeout and this liveness check - drain before concluding
+                    try:
+                        value = self._out.get_nowait()
+                        break
+                    except queue.Empty:
+                        raise PetastormTpuError(
+                            "Loader producer thread died silently")
+        if isinstance(value, _Done):
+            raise StopIteration
+        if isinstance(value, _Error):
+            raise value.exc
+        return value
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        self._reader.stop()
+
+    def join(self) -> None:
+        if self._started:
+            self._thread.join(timeout=10)
+        self._reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        self.join()
+
+
+def make_jax_loader(dataset_url: str,
+                    batch_size: int,
+                    mesh: Optional[Mesh] = None,
+                    shardings=None,
+                    reader_factory=None,
+                    shard_by_process: bool = True,
+                    **kwargs) -> JaxDataLoader:
+    """One-call path: dataset URL -> sharded reader -> JaxDataLoader.
+
+    Shard assignment defaults to the JAX process topology
+    (``jax.process_index/process_count``) - the TPU-native replacement for the
+    reference's externally-supplied ``cur_shard`` + env-var rank sniffing.
+
+    Reader kwargs (predicate, num_epochs, shuffle_seed, ...) and loader kwargs
+    (shuffling_queue_capacity, pad_shapes, ...) are split automatically.
+    """
+    import inspect
+
+    from petastorm_tpu.reader import make_batch_reader
+
+    loader_params = set(inspect.signature(JaxDataLoader.__init__).parameters) - {
+        "self", "reader", "batch_size", "mesh", "shardings"}
+    loader_kwargs = {k: kwargs.pop(k) for k in list(kwargs) if k in loader_params}
+
+    if shard_by_process and "cur_shard" not in kwargs:
+        cur, count = jax.process_index(), jax.process_count()
+        if count > 1:
+            kwargs["cur_shard"], kwargs["shard_count"] = cur, count
+    factory = reader_factory or make_batch_reader
+    reader = factory(dataset_url, **kwargs)
+    try:
+        return JaxDataLoader(reader, batch_size, mesh=mesh, shardings=shardings,
+                             **loader_kwargs)
+    except BaseException:
+        # the loader never came to own the reader: shut it down, or its
+        # executor threads/ventilator would poll forever
+        reader.stop()
+        reader.join()
+        raise
+
+
+def _pad_to(col: np.ndarray, target: Tuple[int, ...], pad_value, dtype) -> np.ndarray:
+    """Pad/truncate each row to ``target`` shape (pad-to-bucket for XLA)."""
+    n = len(col)
+    target = tuple(target)
+    if col.dtype != object:
+        # already stacked (all rows same shape): one vectorized copy
+        if col.shape[1:] == target:
+            return col
+        out = np.full((n,) + target, pad_value, dtype=dtype)
+        clipped = tuple(slice(0, min(a, b)) for a, b in zip(col.shape[1:], target))
+        out[(slice(None),) + clipped] = col[(slice(None),) + clipped]
+        return out
+    out = np.full((n,) + target, pad_value, dtype=dtype)
+    for i in range(n):
+        row = np.asarray(col[i])
+        if row.ndim != len(target):
+            raise PetastormTpuError(
+                f"pad_shapes rank mismatch: row has shape {row.shape}, target"
+                f" {target}")
+        clipped = tuple(slice(0, min(a, b)) for a, b in zip(row.shape, target))
+        out[(i,) + clipped] = row[clipped]
+    return out

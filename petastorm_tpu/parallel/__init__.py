@@ -1,0 +1,12 @@
+"""Mesh/process-aware sharding helpers (the only layer that talks to jax.distributed).
+
+Reference parity: the reference's distributed story is externally-supplied rank
+(reader.py:508) plus Horovod/MPI env sniffing (spark_dataset_converter.py:124-163).
+Here shard assignment is derived from the JAX runtime itself.
+"""
+
+from petastorm_tpu.parallel.mesh import (data_parallel_mesh, local_data_slice,
+                                         shard_options_from_jax, sharding_for_batch)
+
+__all__ = ["data_parallel_mesh", "shard_options_from_jax", "sharding_for_batch",
+           "local_data_slice"]

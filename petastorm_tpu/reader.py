@@ -268,6 +268,19 @@ class Reader:
             self.last_row_consumed = True
         return self._namedtuple_type(**{n: row[n] for n in self.schema.fields})
 
+    def iter_batches(self):
+        """Yield raw ColumnBatches (the TPU feed path: no namedtuple wrapping).
+
+        Used by petastorm_tpu.jax loaders; do not mix with ``__next__`` on the
+        same reader instance.  Ends cleanly (StopIteration) if the reader is
+        stopped mid-iteration.
+        """
+        while True:
+            try:
+                yield self._next_batch()
+            except (StopIteration, ReaderClosedError):
+                return
+
     def _all_items_consumed(self) -> bool:
         return (self._expected_items is not None
                 and self._consumed_items >= self._expected_items)
@@ -275,6 +288,8 @@ class Reader:
     def _next_batch(self) -> ColumnBatch:
         """Next non-empty ColumnBatch, or StopIteration at end of all epochs."""
         while True:
+            if self._stopped:
+                raise ReaderClosedError("Reader was stopped mid-iteration")
             if self._all_items_consumed():
                 self.last_row_consumed = True
                 raise StopIteration

@@ -9,10 +9,17 @@ before the first ``import jax`` anywhere in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon (the real-TPU tunnel), so env vars alone are too late.
+# The backend itself is lazy, so overriding config BEFORE the first
+# jax.devices() call still lands us on the virtual 8-device CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
