@@ -1,0 +1,74 @@
+"""Throughput benchmark - prints ONE JSON line for the driver.
+
+Config mirrors the reference's only published numbers (BASELINE.md): the
+hello_world dataset read rate via ``petastorm-throughput.py`` defaults - thread
+pool, 3 workers, 200 warmup / 1000 measured samples over the HelloWorldSchema
+(id int32, 128x256x3 PNG image, variable 4-D uint8 array; 10 rows,
+/root/reference/examples/hello_world/petastorm_dataset/generate_petastorm_dataset.py:29-41,
+/root/reference/petastorm/benchmark/throughput.py:39).  Baseline: 709.84
+samples/sec (docs/benchmarks_tutorial.rst:20-21, hardware unspecified).
+
+Ours is measured on the same row-oriented make_reader path (the slowest,
+apples-to-apples path - the columnar/jax path is far faster).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_SAMPLES_PER_SEC = 709.84
+WARMUP, MEASURE = 200, 1000
+
+
+def build_hello_world(url: str) -> None:
+    import numpy as np
+
+    from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.schema import Field, Schema
+
+    schema = Schema("HelloWorld", [
+        Field("id", np.int32, (), ScalarCodec()),
+        Field("image1", np.uint8, (128, 256, 3), CompressedImageCodec("png")),
+        Field("array_4d", np.uint8, (None, 128, 30, None), NdarrayCodec()),
+    ])
+    rng = np.random.default_rng(1234)
+    rows = [{"id": i,
+             "image1": rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
+             "array_4d": rng.integers(0, 255, (4, 128, 30, 3), dtype=np.uint8)}
+            for i in range(10)]
+    write_dataset(url, schema, rows, row_group_size_mb=256)
+
+
+def main() -> None:
+    from petastorm_tpu.reader import make_reader
+
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_bench_")
+    url = os.path.join(tmp, "hello_world")
+    build_hello_world(url)
+
+    with make_reader(url, reader_pool_type="thread", workers_count=3,
+                     num_epochs=None) as reader:
+        it = iter(reader)
+        for _ in range(WARMUP):
+            next(it)
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            next(it)
+        dt = time.perf_counter() - t0
+
+    value = MEASURE / dt
+    print(json.dumps({
+        "metric": "hello_world_samples_per_sec",
+        "value": round(value, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(value / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

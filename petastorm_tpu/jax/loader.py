@@ -116,7 +116,7 @@ class JaxDataLoader:
         if batch_size < 1:
             raise PetastormTpuError("batch_size must be >= 1")
         self._global_batch = batch_size
-        self._local_rows, self._local_seq_slices = self._local_layout()
+        self._local_rows = self._local_layout()
 
         if shuffling_queue_capacity and shuffling_queue_capacity > 0:
             min_after = (min_after_retrieve if min_after_retrieve is not None
@@ -131,6 +131,12 @@ class JaxDataLoader:
         self._thread = threading.Thread(target=self._produce, daemon=True,
                                         name="petastorm-tpu-jax-loader")
         self._started = False
+        self._finished = False
+        self._failure: Optional[BaseException] = None
+        #: per-(field, trailing-shape) cache of (sharding, local slice) - static
+        #: for the loader's lifetime, rebuilt per batch otherwise
+        self._placement_cache: Dict[Tuple[str, Tuple[int, ...]],
+                                    Tuple[NamedSharding, Tuple[slice, ...]]] = {}
 
     # -- shape/sharding bookkeeping ------------------------------------------
 
@@ -158,10 +164,10 @@ class JaxDataLoader:
             spec = PartitionSpec(axis)
         return spec
 
-    def _local_layout(self):
-        """Rows this process contributes + per-field sequence slices."""
+    def _local_layout(self) -> int:
+        """Rows of the global batch this process materializes."""
         if self._mesh is None:
-            return self._global_batch, {}
+            return self._global_batch
         local_rows = None
         for name in self._fields:
             spec = self._spec_for(name)
@@ -177,7 +183,7 @@ class JaxDataLoader:
                     "All delivered fields must shard the batch axis identically"
                     f" (field {name!r} wants {rows} local rows, others"
                     f" {local_rows})")
-        return int(local_rows), {}
+        return int(local_rows)
 
     # -- producer thread ------------------------------------------------------
 
@@ -213,23 +219,20 @@ class JaxDataLoader:
                         buffer.finish()
                         break
                     batch = self._prepare(raw)
-                    # add in slices that respect buffer capacity
+                    # add in slices that respect buffer capacity (free_space is
+                    # inf for unbounded buffers)
                     pos = 0
                     while pos < batch.num_rows and not self._stop_event.is_set():
-                        if isinstance(buffer, RandomShufflingBuffer):
-                            free = buffer.free_space
-                            if free == 0:
-                                if buffer.can_retrieve(local_bs):
-                                    self._emit(buffer.retrieve(local_bs))
-                                    continue
-                                raise PetastormTpuError(
-                                    "Shuffling buffer deadlock: capacity"
-                                    f" {buffer._capacity} cannot hold"
-                                    f" min_after + batch; raise"
-                                    " shuffling_queue_capacity")
-                            take = min(free, batch.num_rows - pos)
-                        else:
-                            take = batch.num_rows - pos
+                        free = buffer.free_space
+                        if free <= 0:
+                            if buffer.can_retrieve(local_bs):
+                                self._emit(buffer.retrieve(local_bs))
+                                continue
+                            raise PetastormTpuError(
+                                "Shuffling buffer deadlock: capacity cannot"
+                                " hold min_after + one batch; raise"
+                                " shuffling_queue_capacity")
+                        take = int(min(free, batch.num_rows - pos))
                         buffer.add(batch.slice_rows(pos, pos + take))
                         pos += take
                 while buffer.can_retrieve(local_bs) and not self._stop_event.is_set():
@@ -266,9 +269,7 @@ class JaxDataLoader:
             if arr.dtype != feed_dtype:
                 arr = arr.astype(feed_dtype)
             if self._mesh is not None:
-                sharding = NamedSharding(self._mesh, self._spec_for(name))
-                global_shape = (self._global_batch,) + arr.shape[1:]
-                sl = local_data_slice(sharding, global_shape)
+                sharding, sl, global_shape = self._placement_for(name, arr.shape[1:])
                 arr = arr[(slice(None),) + sl[1:]]  # sequence/model-axis slice
                 device_batch[name] = jax.make_array_from_process_local_data(
                     sharding, arr, global_shape)
@@ -279,6 +280,18 @@ class JaxDataLoader:
         if self._mesh is not None and valid_rows < self._local_rows:
             device_batch["_valid_rows"] = valid_rows
         self._push(device_batch)
+
+    def _placement_for(self, name: str, trailing: Tuple[int, ...]
+                       ) -> Tuple[NamedSharding, Tuple[slice, ...], Tuple[int, ...]]:
+        key = (name, trailing)
+        hit = self._placement_cache.get(key)
+        global_shape = (self._global_batch,) + trailing
+        if hit is None:
+            sharding = NamedSharding(self._mesh, self._spec_for(name))
+            sl = local_data_slice(sharding, global_shape)
+            hit = (sharding, sl)
+            self._placement_cache[key] = hit
+        return hit[0], hit[1], global_shape
 
     def _push(self, value) -> None:
         while not self._stop_event.is_set():
@@ -297,6 +310,10 @@ class JaxDataLoader:
         return self
 
     def __next__(self) -> Dict[str, jax.Array]:
+        if self._failure is not None:
+            raise self._failure
+        if self._finished:
+            raise StopIteration  # repeatable after exhaustion (iterator protocol)
         if not self._started:
             iter(self)
         while True:
@@ -305,6 +322,7 @@ class JaxDataLoader:
                 break
             except queue.Empty:
                 if self._stop_event.is_set():
+                    self._finished = True
                     raise StopIteration
                 if not self._thread.is_alive():
                     # the producer may have pushed its sentinel between our
@@ -313,11 +331,14 @@ class JaxDataLoader:
                         value = self._out.get_nowait()
                         break
                     except queue.Empty:
-                        raise PetastormTpuError(
+                        self._failure = PetastormTpuError(
                             "Loader producer thread died silently")
+                        raise self._failure
         if isinstance(value, _Done):
+            self._finished = True
             raise StopIteration
         if isinstance(value, _Error):
+            self._failure = value.exc
             raise value.exc
         return value
 
@@ -363,6 +384,12 @@ def make_jax_loader(dataset_url: str,
     loader_params = set(inspect.signature(JaxDataLoader.__init__).parameters) - {
         "self", "reader", "batch_size", "mesh", "shardings"}
     loader_kwargs = {k: kwargs.pop(k) for k in list(kwargs) if k in loader_params}
+    if "schema_fields" not in kwargs:
+        # don't read+decode columns the loader would only throw away
+        wanted = list(loader_kwargs.get("fields") or [])
+        wanted += list(loader_kwargs.get("host_fields") or [])
+        if wanted:
+            kwargs["schema_fields"] = wanted
 
     if shard_by_process and "cur_shard" not in kwargs:
         cur, count = jax.process_index(), jax.process_count()
@@ -389,6 +416,10 @@ def _pad_to(col: np.ndarray, target: Tuple[int, ...], pad_value, dtype) -> np.nd
         # already stacked (all rows same shape): one vectorized copy
         if col.shape[1:] == target:
             return col
+        if col.ndim - 1 != len(target):
+            raise PetastormTpuError(
+                f"pad_shapes rank mismatch: rows have shape {col.shape[1:]},"
+                f" target {target}")
         out = np.full((n,) + target, pad_value, dtype=dtype)
         clipped = tuple(slice(0, min(a, b)) for a, b in zip(col.shape[1:], target))
         out[(slice(None),) + clipped] = col[(slice(None),) + clipped]

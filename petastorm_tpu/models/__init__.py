@@ -1,0 +1,12 @@
+"""Reference consumer models for benchmarks, examples, and the driver dry-run.
+
+The framework is a data-ingest library (the reference has no model code either);
+these models exist to exercise and benchmark the ingest path end-to-end: ResNet-50
+matches the BASELINE.json north-star workload (ImageNet ingest), the MLP mirrors
+examples/mnist in the reference.
+"""
+
+from petastorm_tpu.models.mlp import MLP
+from petastorm_tpu.models.resnet import ResNet50
+
+__all__ = ["MLP", "ResNet50"]

@@ -1,0 +1,20 @@
+"""Small MLP (mirrors the reference's examples/mnist consumer)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(self.num_classes)(x)

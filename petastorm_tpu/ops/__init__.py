@@ -1,0 +1,13 @@
+"""On-device (XLA/Pallas) data ops.
+
+Reference parity: the decode half of CompressedImageCodec + the normalize work
+every training loop does on host in the reference stack (petastorm/codecs.py:92-101
+decodes on CPU; torch/tf pipelines then normalize on device or host).  Here
+uint8->float normalize runs ON-CHIP fused (BASELINE.json north star: "uint8->float
+normalization happens on-chip"), keeping the host->device transfer at 1 byte/pixel
+(4x less PCIe/DCN traffic than shipping float32).
+"""
+
+from petastorm_tpu.ops.normalize import normalize_images
+
+__all__ = ["normalize_images"]

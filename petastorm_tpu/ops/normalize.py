@@ -1,0 +1,117 @@
+"""Fused on-chip uint8 -> float image normalization.
+
+The op computes ``(u8/255 - mean[c]) / std[c]`` per channel, emitting bfloat16 by
+default (MXU-native).  Shipping uint8 to the device and normalizing there cuts
+host->device bytes 4x vs normalizing on host in float32 - on TPU the transfer is
+usually the ingest bottleneck (HBM/PCIe bound), so this is the single highest-value
+"decode on device" op (BASELINE.json north star).
+
+Two implementations:
+
+* ``_normalize_pallas``: a Pallas TPU kernel over (8, lane)-tiled blocks of the
+  flattened (N, H*W*C) image, with per-position scale/bias vectors materialized
+  once (channel pattern tiled across the row).  VPU-bound elementwise work with
+  explicit VMEM blocking (see /opt/skills/guides/pallas_guide.md tiling table).
+* ``_normalize_xla``: plain jnp fallback (XLA fuses this into one kernel too) -
+  used on non-TPU backends and for shapes that violate the tiling constraints.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _choose_block(n: int, length: int) -> Optional[Tuple[int, int]]:
+    """(rows, cols) VMEM block for an (n, length) array, or None if untileable."""
+    if length % _LANE != 0 or n % _SUBLANE != 0:
+        return None
+    bl = next((c for c in (8 * _LANE, 4 * _LANE, 2 * _LANE, _LANE)
+               if length % c == 0), None)
+    if bl is None:
+        return None
+    bn = next((r for r in (64, 32, 16, _SUBLANE) if n % r == 0), None)
+    return (bn, bl) if bn else None
+
+
+def _normalize_kernel(x_ref, scale_ref, bias_ref, o_ref):
+    # Mosaic has no direct u8->f32 cast; widen through int32 first
+    x = x_ref[:].astype(jnp.int32).astype(jnp.float32)
+    o_ref[:] = (x * scale_ref[:] + bias_ref[:]).astype(o_ref.dtype)
+
+
+def _normalize_pallas(flat_u8: jax.Array, scale_vec: jax.Array, bias_vec: jax.Array,
+                      block: Tuple[int, int], out_dtype) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    n, length = flat_u8.shape
+    bn, bl = block
+    grid = (n // bn, length // bl)
+    return pl.pallas_call(
+        _normalize_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, length), out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, bl), lambda i, j: (i, j)),
+            pl.BlockSpec((1, bl), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bl), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bn, bl), lambda i, j: (i, j)),
+    )(flat_u8, scale_vec, bias_vec)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "use_pallas"))
+def _normalize_impl(images: jax.Array, scale_vec: jax.Array, bias_vec: jax.Array,
+                    out_dtype: jnp.dtype, use_pallas: bool) -> jax.Array:
+    shape = images.shape
+    flat = images.reshape(shape[0], -1)
+    if use_pallas:
+        block = _choose_block(*flat.shape)
+        out = _normalize_pallas(flat, scale_vec, bias_vec, block, out_dtype)
+    else:
+        out = (flat.astype(jnp.float32) * scale_vec + bias_vec).astype(out_dtype)
+    return out.reshape(shape)
+
+
+def normalize_images(images: jax.Array,
+                     mean: Sequence[float] = (0.485, 0.456, 0.406),
+                     std: Sequence[float] = (0.229, 0.224, 0.225),
+                     out_dtype=jnp.bfloat16) -> jax.Array:
+    """``(images/255 - mean) / std`` fused on device; images are NHWC uint8.
+
+    mean/std are per-channel in [0,1] units (torchvision convention).  Uses the
+    Pallas kernel when the flattened shape satisfies TPU tiling; XLA elementwise
+    otherwise (identical math).
+    """
+    if images.dtype != jnp.uint8:
+        raise TypeError(f"normalize_images expects uint8, got {images.dtype}")
+    if images.ndim < 2:
+        raise TypeError("normalize_images expects at least (N, ...) images")
+    c = images.shape[-1]
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if mean.size == 1:
+        mean = np.full(c, float(mean), np.float32)
+    if std.size == 1:
+        std = np.full(c, float(std), np.float32)
+    if mean.size != c or std.size != c:
+        raise ValueError(f"mean/std size {mean.size}/{std.size} != channels {c}")
+
+    length = int(np.prod(images.shape[1:]))
+    # per-position scale/bias row: channel pattern tiled across H*W
+    scale_np = np.tile(1.0 / (255.0 * std), length // c).astype(np.float32)[None, :]
+    bias_np = np.tile(-mean / std, length // c).astype(np.float32)[None, :]
+
+    # trace-safe platform check: inside jit the array is abstract, so key off
+    # the backend jit compiles for ('axon' is the tunneled TPU PJRT plugin)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    use_pallas = on_tpu and _choose_block(images.shape[0], length) is not None
+    return _normalize_impl(images, jnp.asarray(scale_np), jnp.asarray(bias_np),
+                           out_dtype, use_pallas)

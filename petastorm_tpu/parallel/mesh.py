@@ -25,6 +25,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from petastorm_tpu.errors import PetastormTpuError
+
 
 def shard_options_from_jax() -> Tuple[int, int]:
     """(cur_shard, shard_count) for make_reader, from the JAX process topology."""
@@ -60,6 +62,11 @@ def local_data_slice(sharding: NamedSharding, global_shape: Tuple[int, ...]
     addressable = [d for d in sharding.mesh.devices.flat
                    if d.process_index == jax.process_index()]
     indices = sharding.addressable_devices_indices_map(global_shape)
+    if not indices:
+        raise PetastormTpuError(
+            "Mesh contains no devices addressable by this process"
+            f" (process_index {jax.process_index()}); build the loader's mesh"
+            " from devices this host owns")
     starts = [s.start or 0 for s in next(iter(indices.values()))]
     stops = [s.stop if s.stop is not None else dim
              for s, dim in zip(next(iter(indices.values())), global_shape)]

@@ -45,6 +45,11 @@ class ShufflingBufferBase:
     def can_add(self) -> bool:
         raise NotImplementedError
 
+    @property
+    def free_space(self) -> float:
+        """Rows that may still be added (inf for unbounded buffers)."""
+        raise NotImplementedError
+
     def can_retrieve(self, n: int) -> bool:
         raise NotImplementedError
 
@@ -90,6 +95,10 @@ class NoopShufflingBuffer(ShufflingBufferBase):
     @property
     def can_add(self) -> bool:
         return not self._finished
+
+    @property
+    def free_space(self) -> float:
+        return float("inf")
 
     def can_retrieve(self, n: int) -> bool:
         return self._size >= n or (self._finished and self._size > 0)
@@ -176,7 +185,7 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         return not self._finished and self._size < self._capacity
 
     @property
-    def free_space(self) -> int:
+    def free_space(self) -> float:
         return self._capacity - self._size
 
     def can_retrieve(self, n: int) -> bool:

@@ -230,6 +230,35 @@ def test_no_device_fields_clear_error(num_ds):
     reader.stop(); reader.join()
 
 
+def test_exhausted_loader_raises_stopiteration_repeatably(num_ds):
+    url, _ = num_ds
+    reader = make_reader(url, shuffle_row_groups=False, schema_fields=["idx"])
+    loader = JaxDataLoader(reader, batch_size=32)
+    list(loader)
+    with pytest.raises(StopIteration):
+        next(loader)
+    with pytest.raises(StopIteration):
+        next(loader)  # still StopIteration, not 'producer died'
+    loader.stop(); loader.join()
+
+
+def test_make_jax_loader_narrows_reader_columns(num_ds):
+    url, _ = num_ds
+    with make_jax_loader(url, batch_size=16, fields=["idx"],
+                         shuffle_row_groups=False, num_epochs=1) as loader:
+        assert [f.name for f in loader._reader.schema] == ["idx"]
+        b = next(iter(loader))
+    assert set(b) == {"idx"}
+
+
+def test_pad_rank_mismatch_clear_error_stacked():
+    from petastorm_tpu.jax.loader import _pad_to
+    col = np.zeros((4, 5), np.float32)  # rows rank-1, target rank-2
+    with pytest.raises(PetastormTpuError) as ei:
+        _pad_to(col, (8, 2), 0, np.float32)
+    assert "rank mismatch" in str(ei.value)
+
+
 def test_local_data_slice_single_process(devices):
     mesh = Mesh(np.array(devices).reshape(2, 4), ("data", "seq"))
     sharding = NamedSharding(mesh, P("data", "seq"))
