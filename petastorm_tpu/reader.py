@@ -74,7 +74,8 @@ def make_reader(dataset_url: str,
                 transform_spec: Optional[TransformSpec] = None,
                 storage_options: Optional[dict] = None,
                 filesystem=None,
-                resume_from: Optional[dict] = None) -> "Reader":
+                resume_from: Optional[dict] = None,
+                ngram=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
     Reference: ``make_reader`` (reader.py:59-176).  Yields one namedtuple row per
@@ -88,7 +89,7 @@ def make_reader(dataset_url: str,
                              shard_mode, cache_type, cache_location, cache_size_limit,
                              transform_spec, storage_options, filesystem,
                              batched_output=False, require_stored_schema=True,
-                             resume_from=resume_from)
+                             resume_from=resume_from, ngram=ngram)
 
 
 def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
@@ -111,7 +112,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       transform_spec: Optional[TransformSpec] = None,
                       storage_options: Optional[dict] = None,
                       filesystem=None,
-                      resume_from: Optional[dict] = None) -> "Reader":
+                      resume_from: Optional[dict] = None,
+                      ngram=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
 
@@ -125,7 +127,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              shard_mode, cache_type, cache_location, cache_size_limit,
                              transform_spec, storage_options, filesystem,
                              batched_output=True, require_stored_schema=False,
-                             resume_from=resume_from)
+                             resume_from=resume_from, ngram=ngram)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -135,7 +137,15 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       shard_mode, cache_type, cache_location, cache_size_limit,
                       transform_spec, storage_options, filesystem,
                       batched_output, require_stored_schema,
-                      resume_from: Optional[dict] = None) -> "Reader":
+                      resume_from: Optional[dict] = None, ngram=None) -> "Reader":
+    if ngram is not None and batched_output:
+        raise PetastormTpuError(
+            "NGram is not supported by make_batch_reader (reference parity,"
+            " arrow_reader_worker.py:104); use make_reader")
+    if ngram is not None and schema_fields is not None:
+        raise PetastormTpuError(
+            "schema_fields and ngram are mutually exclusive: the NGram spec"
+            " already defines the fields read at each timestep offset")
     try:
         info = open_dataset(dataset_url, storage_options=storage_options,
                             filesystem=filesystem,
@@ -153,6 +163,15 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     view = full_schema.view(schema_fields) if schema_fields is not None else full_schema
     output_schema = (transform_schema(view, transform_spec)
                      if transform_spec is not None else view)
+    ngram_schema = None
+    if ngram is not None:
+        # ngram defines its own field selection across the post-transform schema
+        ngram_schema = (transform_schema(full_schema, transform_spec)
+                        if transform_spec is not None else full_schema)
+        required = ngram.required_fields(ngram_schema)
+        # transform-created fields are not stored; read only what exists on disk
+        view = full_schema.view([n for n in required if n in full_schema])
+        output_schema = ngram_schema
 
     row_groups = info.row_groups
     # selector filter (reference reader.py:511-530)
@@ -204,7 +223,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    filesystem=filesystem)
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
                                    predicate=worker_predicate,
-                                   transform=transform_spec, cache=cache)
+                                   transform=transform_spec, cache=cache,
+                                   ngram=ngram, ngram_schema=ngram_schema)
 
     executor = make_executor(reader_pool_type, workers_count, results_queue_size)
     start_item = 0
@@ -212,7 +232,7 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         start_item = int(resume_from.get("position", 0))
     return Reader(info=info, schema=output_schema, plan=plan, executor=executor,
                   worker=worker, num_epochs=num_epochs, batched_output=batched_output,
-                  start_item=start_item)
+                  start_item=start_item, ngram=ngram)
 
 
 class Reader:
@@ -224,10 +244,14 @@ class Reader:
 
     def __init__(self, info, schema: Schema, plan: ReadPlan, executor, worker,
                  num_epochs: Optional[int], batched_output: bool,
-                 start_item: int = 0):
+                 start_item: int = 0, ngram=None):
         self.dataset_info = info
         self.schema = schema
         self.batched_output = batched_output
+        self.ngram = ngram
+        if ngram is not None:
+            self._ngram_views = ngram.resolve_schema(schema)
+            self._ngram_types = ngram.make_namedtuple_types(schema)
         self._plan = plan
         self._executor = executor
         self._num_epochs = num_epochs
@@ -261,11 +285,20 @@ class Reader:
         if self._current is None or self._current_pos >= self._current.num_rows:
             self._current = self._next_batch()
             self._current_pos = 0
-        row = self._current.row(self._current_pos)
+        pos = self._current_pos
         self._current_pos += 1
         if (self._current_pos >= self._current.num_rows
                 and self._all_items_consumed()):
             self.last_row_consumed = True
+        if self.ngram is not None:
+            if self.ngram.stack_timesteps:
+                raise PetastormTpuError(
+                    "stack_timesteps NGram readers are columnar-only: use"
+                    " iter_batches() or the jax loader")
+            # one window: {offset: namedtuple} (reference row-path shape)
+            return self.ngram.row(self._ngram_views, self._ngram_types,
+                                  self._current, pos)
+        row = self._current.row(pos)
         return self._namedtuple_type(**{n: row[n] for n in self.schema.fields})
 
     def iter_batches(self):

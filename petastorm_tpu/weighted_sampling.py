@@ -1,0 +1,91 @@
+"""Probability-weighted mixing of multiple readers.
+
+Reference parity: petastorm/weighted_sampling_reader.py (106 LoC) -
+WeightedSamplingReader draws the next element from reader i with probability
+probabilities[i], with schema/ngram/batched compatibility checks
+(weighted_sampling_reader.py:26-92).
+
+Difference: the draw is seeded (reproducible mixing) and ``iter_batches`` mixing
+is supported for the columnar path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+
+
+class WeightedSamplingReader:
+    def __init__(self, readers: Sequence, probabilities: Sequence[float],
+                 seed: Optional[int] = None):
+        if len(readers) != len(probabilities) or not readers:
+            raise PetastormTpuError("readers and probabilities must be same non-zero length")
+        p = np.asarray(probabilities, dtype=np.float64)
+        if (p < 0).any() or p.sum() <= 0:
+            raise PetastormTpuError(f"Invalid probabilities {probabilities}")
+        self._p = p / p.sum()
+        self._readers = list(readers)
+        self._rng = np.random.default_rng(seed)
+
+        first = readers[0]
+        self.batched_output = first.batched_output
+        self.ngram = getattr(first, "ngram", None)
+        self.schema = first.schema
+        for r in readers[1:]:
+            if r.batched_output != self.batched_output:
+                raise PetastormTpuError("All readers must share batched_output mode")
+            if getattr(r, "ngram", None) != self.ngram:
+                raise PetastormTpuError(
+                    "All readers must share an identical NGram spec (same"
+                    " offsets, fields, delta_threshold, timestamp settings)")
+            if list(r.schema.fields) != list(self.schema.fields):
+                raise PetastormTpuError(
+                    f"Schema mismatch: {list(r.schema.fields)} vs"
+                    f" {list(self.schema.fields)}")
+
+    @property
+    def last_row_consumed(self) -> bool:
+        return all(r.last_row_consumed for r in self._readers)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        alive: List[int] = list(range(len(self._readers)))
+        while alive:
+            weights = self._p[alive] / self._p[alive].sum()
+            i = int(self._rng.choice(len(alive), p=weights))
+            try:
+                return next(self._readers[alive[i]])
+            except StopIteration:
+                alive.pop(i)
+        raise StopIteration
+
+    def iter_batches(self):
+        sources = [r.iter_batches() for r in self._readers]
+        alive = list(range(len(sources)))
+        while alive:
+            weights = self._p[alive] / self._p[alive].sum()
+            i = int(self._rng.choice(len(alive), p=weights))
+            try:
+                yield next(sources[alive[i]])
+            except StopIteration:
+                alive.pop(i)
+
+    def stop(self) -> None:
+        for r in self._readers:
+            r.stop()
+
+    def join(self) -> None:
+        for r in self._readers:
+            r.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        self.join()

@@ -48,7 +48,9 @@ class RowGroupDecoderWorker:
                  read_fields: Sequence[str],
                  predicate=None,
                  transform: Optional[TransformSpec] = None,
-                 cache: Optional[CacheBase] = None):
+                 cache: Optional[CacheBase] = None,
+                 ngram=None,
+                 ngram_schema: Optional[Schema] = None):
         self._fs_factory = fs_factory
         self._schema = schema
         self._read_fields = list(read_fields)
@@ -56,6 +58,8 @@ class RowGroupDecoderWorker:
         self._transform = transform
         self._cache = cache or NullCache()
         self._cache_prefix = hashlib.md5(fs_factory.url.encode()).hexdigest()
+        self._ngram = ngram
+        self._ngram_schema = ngram_schema or schema
 
     # -- factory protocol -----------------------------------------------------
 
@@ -81,14 +85,39 @@ class RowGroupDecoderWorker:
     # -- hot path -------------------------------------------------------------
 
     def _process(self, parquet_file, item: WorkItem) -> ColumnBatch:
+        anchor = None
+        row_range = None
+        if self._ngram is not None:
+            lo, hi = item.row_slice()
+            if self._ngram.timestamp_overlap:
+                # row-drop slices: read the slice plus length-1 lookahead rows
+                # and anchor window starts inside the slice (reference
+                # borrowing, py_dict_reader_worker.py:254-274).  Assumes
+                # rowgroups are stored timestamp-sorted, as the reference does.
+                row_range = (lo, min(hi + self._ngram.length - 1,
+                                     item.row_group.num_rows))
+                anchor = (0, hi - lo)
+            else:
+                # non-overlap selection is a GLOBAL greedy property of the
+                # rowgroup; partitions must all see the full group or they
+                # would pick overlapping windows near slice boundaries
+                anchor = (lo, hi)
+            load_item = WorkItem(item.row_group)
+        else:
+            load_item = item
         if self._predicate is None:
-            key = self._cache_key(item)
-            batch = self._cache.get(key, lambda: self._load(parquet_file, item,
-                                                            self._read_fields))
-            return self._apply_transform(batch)
-        # predicates invalidate rowgroup-level caching (reference
-        # py_dict_reader_worker.py:145-150); split-read instead
-        return self._load_with_predicate(parquet_file, item)
+            key = self._cache_key(load_item if row_range is None else item)
+            batch = self._cache.get(key, lambda: self._load(
+                parquet_file, load_item, self._read_fields, row_range=row_range))
+        else:
+            # predicates invalidate rowgroup-level caching (reference
+            # py_dict_reader_worker.py:145-150); split-read instead
+            batch = self._load_with_predicate(parquet_file, load_item, row_range)
+        batch = self._apply_transform(batch)
+        if self._ngram is not None:
+            batch = self._ngram.form_windows(self._ngram_schema, batch,
+                                             anchor_range=anchor)
+        return batch
 
     def _cache_key(self, item: WorkItem) -> str:
         start, stop = item.row_slice()
@@ -104,14 +133,15 @@ class RowGroupDecoderWorker:
         return ColumnBatch(cols, nrows)
 
     def _load(self, parquet_file, item: WorkItem, fields: Sequence[str],
-              mask: Optional[np.ndarray] = None) -> ColumnBatch:
+              mask: Optional[np.ndarray] = None,
+              row_range: Optional[tuple] = None) -> ColumnBatch:
         """Read + slice + (mask) + decode ``fields`` of one rowgroup (no transform)."""
         pf = parquet_file(item.row_group.path)
         file_cols = set(pf.schema_arrow.names)
         stored = [f for f in fields if f in file_cols]
         virtual = [f for f in fields if f not in file_cols]
 
-        start, stop = item.row_slice()
+        start, stop = row_range if row_range is not None else item.row_slice()
         table = pf.read_row_group(item.row_group.row_group, columns=stored)
         if (start, stop) != (0, table.num_rows):
             table = table.slice(start, stop - start)
@@ -143,27 +173,39 @@ class RowGroupDecoderWorker:
                 columns[name] = col
         return ColumnBatch(columns, n)
 
-    def _load_with_predicate(self, parquet_file, item: WorkItem) -> ColumnBatch:
+    def _empty_batch(self) -> ColumnBatch:
+        """Zero-row batch carrying ALL read fields with correct dtypes, so
+        transforms and ngram formation downstream see a consistent shape."""
+        cols = {}
+        for name in self._read_fields:
+            field = self._schema[name]
+            if field.is_fixed_shape and field.dtype.kind not in ("U", "S", "O"):
+                cols[name] = np.empty((0,) + field.shape, dtype=field.dtype)
+            else:
+                cols[name] = np.empty(0, dtype=object)
+        return ColumnBatch(cols, 0)
+
+    def _load_with_predicate(self, parquet_file, item: WorkItem,
+                             row_range: Optional[tuple] = None) -> ColumnBatch:
         pred_fields = list(self._predicate.get_fields())
         missing = [f for f in pred_fields if f not in self._schema]
         if missing:
             raise PetastormTpuError(f"Predicate references unknown fields {missing}")
         # phase 1: predicate columns only (cheap)
-        pred_batch = self._load(parquet_file, item, pred_fields)
+        pred_batch = self._load(parquet_file, item, pred_fields, row_range=row_range)
         mask = np.asarray(self._predicate.do_include_vectorized(pred_batch.columns),
                           dtype=bool)
         if not mask.any():
-            empty = {f: pred_batch.columns[f][:0] for f in self._read_fields
-                     if f in pred_batch.columns}
-            return ColumnBatch(empty, 0)
+            return self._empty_batch()
         # phase 2: remaining columns, arrow-filtered by the mask BEFORE decode
         remaining = [f for f in self._read_fields if f not in pred_fields]
         if remaining:
-            rest = self._load(parquet_file, item, remaining, mask=mask)
+            rest = self._load(parquet_file, item, remaining, mask=mask,
+                              row_range=row_range)
             columns = {**{f: pred_batch.columns[f][mask] for f in pred_fields},
                        **rest.columns}
         else:
             columns = {f: pred_batch.columns[f][mask] for f in pred_fields}
         # keep only requested output fields, in schema order
         columns = {f: columns[f] for f in self._read_fields if f in columns}
-        return self._apply_transform(ColumnBatch(columns, int(mask.sum())))
+        return ColumnBatch(columns, int(mask.sum()))

@@ -1,0 +1,191 @@
+"""NGram tests (reference models: tests/test_ngram.py + test_ngram_end_to_end.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+
+
+def _schema():
+    return Schema("TS", [
+        Field("ts", np.int64),
+        Field("value", np.float32, (2,)),
+        Field("aux", np.int32),
+    ])
+
+
+def _batch(timestamps, schema=None):
+    n = len(timestamps)
+    return ColumnBatch({
+        "ts": np.asarray(timestamps, np.int64),
+        "value": np.stack([np.full(2, t, np.float32) for t in timestamps]),
+        "aux": np.arange(n, dtype=np.int32),
+    }, n)
+
+
+def test_offsets_must_be_consecutive():
+    with pytest.raises(PetastormTpuError):
+        NGram({0: ["ts"], 2: ["ts"]}, 10, "ts")
+
+
+def test_window_starts_delta_threshold():
+    ng = NGram({0: ["value"], 1: ["value"]}, delta_threshold=2, timestamp_field="ts")
+    ts = np.array([0, 1, 2, 10, 11])
+    # windows of 2: (0,1) ok, (1,2) ok, (2,10) delta 8 > 2, (10,11) ok
+    assert ng.window_starts(ts).tolist() == [0, 1, 3]
+
+
+def test_window_starts_requires_sorted():
+    ng = NGram({0: ["value"], 1: ["value"]}, 10, "ts")
+    with pytest.raises(PetastormTpuError):
+        ng.window_starts(np.array([3, 1, 2]))
+
+
+def test_non_overlap():
+    ng = NGram({0: ["value"], 1: ["value"]}, 10, "ts", timestamp_overlap=False)
+    starts = ng.window_starts(np.arange(6))
+    assert starts.tolist() == [0, 2, 4]  # greedy non-overlapping
+
+
+def test_form_windows_columnar():
+    schema = _schema()
+    ng = NGram({-1: ["value"], 0: ["value", "aux"]}, 5, "ts")
+    out = ng.form_windows(schema, _batch([0, 1, 2, 3]))
+    assert out.num_rows == 3
+    np.testing.assert_array_equal(out.columns["-1/value"][:, 0], [0, 1, 2])
+    np.testing.assert_array_equal(out.columns["0/value"][:, 0], [1, 2, 3])
+    np.testing.assert_array_equal(out.columns["0/aux"], [1, 2, 3])
+
+
+def test_form_windows_sorts_unsorted_batch():
+    schema = _schema()
+    ng = NGram({0: ["value"], 1: ["value"]}, 5, "ts")
+    out = ng.form_windows(schema, _batch([3, 1, 0, 2]))
+    assert out.num_rows == 3
+    np.testing.assert_array_equal(out.columns["0/value"][:, 0], [0, 1, 2])
+
+
+def test_anchor_range():
+    ng = NGram({0: ["value"], 1: ["value"]}, 5, "ts")
+    starts = ng.window_starts(np.arange(10), anchor_range=(3, 6))
+    assert starts.tolist() == [3, 4, 5]
+
+
+def test_ngram_end_to_end(tmp_path):
+    schema = _schema()
+    url = str(tmp_path / "ng")
+    rows = [{"ts": 1000 + i if i < 15 else 2000 + i, "value": np.full(2, i, np.float32),
+             "aux": i} for i in range(30)]
+    write_dataset(url, schema, rows, row_group_size_rows=10)
+    ngram = NGram({0: ["value", "ts"], 1: ["value"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    with make_reader(url, ngram=ngram, shuffle_row_groups=False) as reader:
+        windows = list(reader)
+    # rowgroup 0: rows 0-9 contiguous -> 9 windows; rowgroup 1: rows 10-14
+    # contiguous (4), jump at 15, 15-19 contiguous (4); rowgroup 2: 9
+    assert len(windows) == 9 + 8 + 9
+    w = windows[0]
+    assert set(w) == {0, 1}
+    assert w[0]._fields == ("ts", "value") and w[1]._fields == ("value",)
+    assert float(w[1].value[0]) == float(w[0].value[0]) + 1
+
+
+def test_ngram_with_row_drop_partitions_covers_all(tmp_path):
+    schema = _schema()
+    url = str(tmp_path / "ngdrop")
+    rows = [{"ts": i, "value": np.full(2, i, np.float32), "aux": i} for i in range(20)]
+    write_dataset(url, schema, rows, row_group_size_rows=20)
+    ngram = NGram({0: ["value"], 1: ["value"]}, 5, "ts")
+    with make_reader(url, ngram=ngram, shuffle_row_drop_partitions=2,
+                     shuffle_seed=0) as reader:
+        anchors = sorted(float(w[0].value[0]) for w in reader)
+    # every valid window start (0..18) appears exactly once across partitions
+    assert anchors == [float(i) for i in range(19)]
+
+
+def test_ngram_rejected_on_batch_reader(tmp_path):
+    schema = _schema()
+    url = str(tmp_path / "ngbatch")
+    write_dataset(url, schema, [{"ts": 1, "value": np.zeros(2, np.float32), "aux": 0}])
+    with pytest.raises(PetastormTpuError):
+        make_batch_reader(url, ngram=NGram({0: ["value"]}, 1, "ts"))
+
+
+def test_ngram_with_predicate_empty_rowgroup(tmp_path):
+    # predicate masking out a whole rowgroup must not crash window formation
+    from petastorm_tpu.predicates import in_lambda
+
+    schema = _schema()
+    url = str(tmp_path / "ngpred")
+    rows = [{"ts": i, "value": np.full(2, i, np.float32), "aux": i} for i in range(20)]
+    write_dataset(url, schema, rows, row_group_size_rows=10)
+    pred = in_lambda(["aux"], lambda c: c["aux"] < 10, vectorized=True)
+    ngram = NGram({0: ["value"], 1: ["value"]}, 5, "ts")
+    with make_reader(url, ngram=ngram, predicate=pred,
+                     shuffle_row_groups=False) as reader:
+        windows = list(reader)
+    assert len(windows) == 9  # second rowgroup fully masked -> 0 windows, no crash
+
+
+def test_non_overlap_stable_across_drop_partitions(tmp_path):
+    # non-overlap selection must be a global property, not per drop partition
+    schema = _schema()
+    url = str(tmp_path / "ngno")
+    rows = [{"ts": i, "value": np.full(2, i, np.float32), "aux": i} for i in range(20)]
+    write_dataset(url, schema, rows, row_group_size_rows=20)
+    ngram = NGram({0: ["value"], 1: ["value"], 2: ["value"]}, 5, "ts",
+                  timestamp_overlap=False)
+    with make_reader(url, ngram=ngram, shuffle_row_drop_partitions=2,
+                     shuffle_seed=0) as reader:
+        starts = sorted(int(w[0].value[0]) for w in reader)
+    assert starts == [0, 3, 6, 9, 12, 15]  # stride-3, no shared rows anywhere
+
+
+def test_schema_fields_with_ngram_rejected(tmp_path):
+    schema = _schema()
+    url = str(tmp_path / "ngsf")
+    write_dataset(url, schema, [{"ts": 1, "value": np.zeros(2, np.float32), "aux": 0}])
+    with pytest.raises(PetastormTpuError):
+        make_reader(url, schema_fields=["value"], ngram=NGram({0: ["value"]}, 1, "ts"))
+
+
+def test_stack_timesteps_columnar(tmp_path):
+    schema = _schema()
+    url = str(tmp_path / "ngstack")
+    rows = [{"ts": i, "value": np.full(2, i, np.float32), "aux": i} for i in range(12)]
+    write_dataset(url, schema, rows, row_group_size_rows=12)
+    ngram = NGram({0: ["value"], 1: ["value"], 2: ["value"]}, 5, "ts",
+                  stack_timesteps=True)
+    with make_reader(url, ngram=ngram, shuffle_row_groups=False) as reader:
+        b = next(reader.iter_batches())
+    assert set(b.columns) == {"value"}
+    assert b.columns["value"].shape == (10, 3, 2)  # (windows, timesteps, field)
+    np.testing.assert_array_equal(b.columns["value"][0, :, 0], [0, 1, 2])
+
+
+def test_ngram_equality():
+    a = NGram({0: ["v"], 1: ["v"]}, 5, "ts")
+    b = NGram({0: ["v"], 1: ["v"]}, 5, "ts")
+    c = NGram({0: ["v"], 1: ["v"], 2: ["v"]}, 5, "ts")
+    assert a == b and a != c
+
+
+def test_ngram_iter_batches_flat_columns(tmp_path):
+    # the columnar surface a sequence-parallel consumer would use
+    schema = _schema()
+    url = str(tmp_path / "ngflat")
+    rows = [{"ts": i, "value": np.full(2, i, np.float32), "aux": i} for i in range(12)]
+    write_dataset(url, schema, rows, row_group_size_rows=12)
+    ngram = NGram({0: ["value"], 1: ["value"], 2: ["value"]}, 5, "ts")
+    with make_reader(url, ngram=ngram, shuffle_row_groups=False) as reader:
+        batches = list(reader.iter_batches())
+    assert len(batches) == 1
+    b = batches[0]
+    assert set(b.columns) == {"0/value", "1/value", "2/value"}
+    assert b.num_rows == 10

@@ -1,0 +1,100 @@
+"""WeightedSamplingReader + statistical shuffle-quality tests."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.test_util.shuffling_analysis import (analyze_shuffle_quality,
+                                                        rank_correlation)
+from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+
+def _make(url, tag, n=40):
+    schema = Schema("W", [Field("id", np.int64), Field("src", np.dtype("object"))])
+    write_dataset(url, schema, [{"id": i, "src": tag} for i in range(n)],
+                  row_group_size_rows=10)
+
+
+def test_weighted_mixing_ratio(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _make(a, "a", 400)
+    _make(b, "b", 400)
+    ra = make_reader(a, shuffle_row_groups=False, num_epochs=None,
+                     reader_pool_type="serial")
+    rb = make_reader(b, shuffle_row_groups=False, num_epochs=None,
+                     reader_pool_type="serial")
+    mixed = WeightedSamplingReader([ra, rb], [0.8, 0.2], seed=0)
+    srcs = [next(mixed).src for _ in range(500)]
+    mixed.stop(); mixed.join()
+    frac_a = srcs.count("a") / len(srcs)
+    assert 0.72 < frac_a < 0.88  # ~binomial(500, .8)
+
+
+def test_weighted_exhausts_all(tmp_path):
+    a, b = str(tmp_path / "a2"), str(tmp_path / "b2")
+    _make(a, "a", 30)
+    _make(b, "b", 20)
+    with WeightedSamplingReader(
+            [make_reader(a, shuffle_row_groups=False),
+             make_reader(b, shuffle_row_groups=False)], [0.5, 0.5], seed=1) as mixed:
+        rows = list(mixed)
+    assert len(rows) == 50
+    assert {r.src for r in rows} == {"a", "b"}
+
+
+def test_weighted_schema_mismatch(tmp_path):
+    a = str(tmp_path / "a3")
+    _make(a, "a", 10)
+    other = str(tmp_path / "c3")
+    write_dataset(other, Schema("X", [Field("zzz", np.int64)]), [{"zzz": 1}])
+    ra = make_reader(a)
+    rc = make_reader(other)
+    try:
+        with pytest.raises(PetastormTpuError):
+            WeightedSamplingReader([ra, rc], [0.5, 0.5])
+    finally:
+        for r in (ra, rc):
+            r.stop(); r.join()
+
+
+def test_weighted_validates_probabilities(tmp_path):
+    a = str(tmp_path / "a4")
+    _make(a, "a", 10)
+    ra = make_reader(a)
+    try:
+        with pytest.raises(PetastormTpuError):
+            WeightedSamplingReader([ra], [-1.0])
+    finally:
+        ra.stop(); ra.join()
+
+
+# -- shuffle quality ----------------------------------------------------------
+
+def test_rank_correlation_extremes():
+    assert rank_correlation(np.arange(100)) == pytest.approx(1.0)
+    assert rank_correlation(np.arange(100)[::-1]) == pytest.approx(-1.0)
+
+
+@pytest.fixture(scope="module")
+def ordered_ds(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp("sq") / "ordered")
+    schema = Schema("O", [Field("id", np.int64)])
+    write_dataset(url, schema, [{"id": i} for i in range(512)],
+                  row_group_size_rows=16)
+    return url
+
+
+def test_shuffle_quality_improves_with_knobs(ordered_ds):
+    # reference lesson (SURVEY.md section 4): statistical quality, not determinism
+    rho_none = abs(analyze_shuffle_quality(ordered_ds, shuffle_row_groups=False))
+    rho_groups = abs(analyze_shuffle_quality(ordered_ds, shuffle_row_groups=True))
+    rho_full = abs(analyze_shuffle_quality(ordered_ds, shuffle_row_groups=True,
+                                           shuffle_row_drop_partitions=4,
+                                           shuffling_queue_capacity=128))
+    assert rho_none == pytest.approx(1.0)
+    assert rho_groups < 0.5         # rowgroup shuffle decorrelates coarsely
+    assert rho_full < rho_none
+    assert rho_full < 0.2           # buffer + row-drop approaches uniform
