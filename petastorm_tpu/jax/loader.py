@@ -100,7 +100,10 @@ class JaxDataLoader:
         self._transform_fn = transform_fn
         self._host_fields = list(host_fields)
 
-        schema = reader.schema
+        # output_schema describes the columns iter_batches actually yields
+        # (differs from reader.schema for ngram readers)
+        schema = getattr(reader, "output_schema", None) or reader.schema
+        self._schema = schema
         self._fields = list(fields) if fields is not None else [
             f.name for f in schema if f.name not in self._host_fields]
         unknown = [f for f in self._fields + self._host_fields if f not in schema]
@@ -194,7 +197,7 @@ class JaxDataLoader:
             if name in self._pad_shapes:
                 col = _pad_to(col, self._pad_shapes[name],
                               self._pad_value_for(name),
-                              self._reader.schema[name].dtype)
+                              self._schema[name].dtype)
             cols[name] = col
         return ColumnBatch(cols, batch.num_rows)
 

@@ -168,6 +168,38 @@ class NGram:
                         out[f"{o}{NGRAM_KEY_SEP}{name}"] = p
         return ColumnBatch(out, len(starts))
 
+    def output_schema(self, schema: Schema) -> Schema:
+        """Schema of the columnar batches ``form_windows`` emits.
+
+        Non-stacked: one ``'<offset>/<field>'`` entry per (offset, field).
+        Stacked: fields present at every offset become ``(length,) + shape``
+        entries under their plain name (only when statically stackable: fixed
+        shape, non-object dtype - mirroring the runtime check in
+        ``form_windows``); the rest keep flat keys.
+        """
+        from petastorm_tpu.schema import Field
+
+        per_offset = {off: schema.resolve_fields(self._fields[off])
+                      for off in self._offsets}
+        out = []
+        stacked = set()
+        if self.stack_timesteps:
+            for name in per_offset[self._offsets[0]]:
+                f = schema[name]
+                if (all(name in per_offset[o] for o in self._offsets)
+                        and f.is_fixed_shape and f.dtype != np.dtype(object)):
+                    out.append(Field(name, f.dtype, (self.length,) + f.shape,
+                                     nullable=f.nullable))
+                    stacked.add(name)
+        for off in self._offsets:
+            for name in per_offset[off]:
+                if name in stacked:
+                    continue
+                f = schema[name]
+                out.append(Field(f"{off}{NGRAM_KEY_SEP}{name}", f.dtype,
+                                 f.shape, f.codec, f.nullable))
+        return Schema(f"{schema.name}_ngram", out)
+
     def make_namedtuple_types(self, schema: Schema):
         views = self.resolve_schema(schema)
         return {off: view.make_namedtuple_type() for off, view in views.items()}

@@ -146,6 +146,14 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         raise PetastormTpuError(
             "schema_fields and ngram are mutually exclusive: the NGram spec"
             " already defines the fields read at each timestep offset")
+    if (ngram is not None and predicate is not None
+            and shuffle_row_drop_partitions > 1):
+        raise PetastormTpuError(
+            "ngram + predicate + shuffle_row_drop_partitions > 1 is not"
+            " supported: the lookahead rows borrowed across a partition"
+            " boundary are computed before the predicate masks rows, so"
+            " windows spanning masked rows would be silently lost. Use"
+            " shuffle_row_drop_partitions=1.")
     try:
         info = open_dataset(dataset_url, storage_options=storage_options,
                             filesystem=filesystem,
@@ -249,9 +257,13 @@ class Reader:
         self.schema = schema
         self.batched_output = batched_output
         self.ngram = ngram
+        #: schema of the columnar batches iter_batches yields (differs from
+        #: ``schema`` for ngram readers: '<offset>/<field>' / stacked entries)
+        self.output_schema = schema
         if ngram is not None:
             self._ngram_views = ngram.resolve_schema(schema)
             self._ngram_types = ngram.make_namedtuple_types(schema)
+            self.output_schema = ngram.output_schema(schema)
         self._plan = plan
         self._executor = executor
         self._num_epochs = num_epochs

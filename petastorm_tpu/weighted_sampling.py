@@ -29,11 +29,15 @@ class WeightedSamplingReader:
         self._p = p / p.sum()
         self._readers = list(readers)
         self._rng = np.random.default_rng(seed)
+        # readers not yet exhausted by __next__; persists across calls so dead
+        # readers are not re-drawn/re-polled on every remaining row
+        self._alive: List[int] = list(range(len(self._readers)))
 
         first = readers[0]
         self.batched_output = first.batched_output
         self.ngram = getattr(first, "ngram", None)
         self.schema = first.schema
+        self.output_schema = getattr(first, "output_schema", first.schema)
         for r in readers[1:]:
             if r.batched_output != self.batched_output:
                 raise PetastormTpuError("All readers must share batched_output mode")
@@ -54,14 +58,13 @@ class WeightedSamplingReader:
         return self
 
     def __next__(self):
-        alive: List[int] = list(range(len(self._readers)))
-        while alive:
-            weights = self._p[alive] / self._p[alive].sum()
-            i = int(self._rng.choice(len(alive), p=weights))
+        while self._alive:
+            weights = self._p[self._alive] / self._p[self._alive].sum()
+            i = int(self._rng.choice(len(self._alive), p=weights))
             try:
-                return next(self._readers[alive[i]])
+                return next(self._readers[self._alive[i]])
             except StopIteration:
-                alive.pop(i)
+                self._alive.pop(i)
         raise StopIteration
 
     def iter_batches(self):

@@ -106,21 +106,28 @@ class RowGroupDecoderWorker:
         else:
             load_item = item
         if self._predicate is None:
-            key = self._cache_key(load_item if row_range is None else item)
+            # key covers the rows ACTUALLY loaded (incl. ngram lookahead), so
+            # readers with different ngram lengths never share an entry
+            span = row_range if row_range is not None else load_item.row_slice()
+            key = self._cache_key(load_item, span)
             batch = self._cache.get(key, lambda: self._load(
                 parquet_file, load_item, self._read_fields, row_range=row_range))
         else:
             # predicates invalidate rowgroup-level caching (reference
             # py_dict_reader_worker.py:145-150); split-read instead
             batch = self._load_with_predicate(parquet_file, load_item, row_range)
+        if batch.num_rows == 0:
+            # fully-masked rowgroup: transforms/ngram must not see 0-row columns
+            # (a transform may np.stack/reduce over rows)
+            return batch
         batch = self._apply_transform(batch)
         if self._ngram is not None:
             batch = self._ngram.form_windows(self._ngram_schema, batch,
                                              anchor_range=anchor)
         return batch
 
-    def _cache_key(self, item: WorkItem) -> str:
-        start, stop = item.row_slice()
+    def _cache_key(self, item: WorkItem, span: tuple) -> str:
+        start, stop = span
         fields_tag = hashlib.md5(",".join(self._read_fields).encode()).hexdigest()[:8]
         return (f"{self._cache_prefix}:{item.row_group.path}:{item.row_group.row_group}"
                 f":{start}:{stop}:{fields_tag}")

@@ -189,3 +189,62 @@ def test_ngram_iter_batches_flat_columns(tmp_path):
     b = batches[0]
     assert set(b.columns) == {"0/value", "1/value", "2/value"}
     assert b.num_rows == 10
+
+
+def test_ngram_predicate_with_row_drop_rejected(tmp_path):
+    # windows spanning predicate-masked rows across partition boundaries would
+    # be silently lost; the combination must be an explicit error
+    from petastorm_tpu.predicates import in_lambda
+
+    schema = _schema()
+    url = str(tmp_path / "ngpreddrop")
+    write_dataset(url, schema, [{"ts": i, "value": np.full(2, i, np.float32),
+                                 "aux": i} for i in range(10)])
+    pred = in_lambda(["aux"], lambda c: c["aux"] >= 0, vectorized=True)
+    with pytest.raises(PetastormTpuError, match="row_drop_partitions"):
+        make_reader(url, ngram=NGram({0: ["value"], 1: ["value"]}, 5, "ts"),
+                    predicate=pred, shuffle_row_drop_partitions=2)
+
+
+def test_ngram_cache_keys_include_lookahead_span(tmp_path):
+    # two readers with different ngram lengths sharing one disk cache must not
+    # serve each other's (differently-sized) lookahead batches
+    url = str(tmp_path / "ngcache")
+    cache_dir = str(tmp_path / "cache")
+    rows = [{"ts": i, "value": np.full(2, i, np.float32), "aux": i}
+            for i in range(20)]
+    write_dataset(url, _schema(), rows, row_group_size_rows=20)
+
+    def count(k):
+        ngram = NGram({o: ["value"] for o in range(k)}, 5, "ts")
+        with make_reader(url, ngram=ngram, shuffle_row_drop_partitions=2,
+                         shuffle_seed=0, cache_type="local-disk",
+                         cache_location=cache_dir) as reader:
+            return len(list(reader))
+
+    assert count(2) == 19   # populates cache with (slice + 1-row lookahead)
+    assert count(3) == 18   # must NOT be served k=2's cached spans
+    assert count(2) == 19   # cache still valid for k=2
+
+
+def test_ngram_output_schema_and_jax_loader(tmp_path):
+    from petastorm_tpu.jax import JaxDataLoader
+
+    schema = _schema()
+    url = str(tmp_path / "ngjax")
+    rows = [{"ts": i, "value": np.full(2, i, np.float32), "aux": i}
+            for i in range(20)]
+    write_dataset(url, schema, rows, row_group_size_rows=20)
+    ngram = NGram({0: ["value", "ts"], 1: ["value"]}, 5, "ts",
+                  stack_timesteps=True)
+    with make_reader(url, ngram=ngram, shuffle_row_groups=False) as reader:
+        out_names = [f.name for f in reader.output_schema]
+        assert out_names == ["value", "0/ts"]
+        assert reader.output_schema["value"].shape == (2, 2)
+        with JaxDataLoader(reader, batch_size=4) as loader:
+            batch = next(iter(loader))
+    assert batch["value"].shape == (4, 2, 2)
+    assert batch["0/ts"].shape == (4,)
+    # window at start s: value[:, 0] == s, value[:, 1] == s + 1
+    assert (np.asarray(batch["value"])[:, 1, 0]
+            == np.asarray(batch["value"])[:, 0, 0] + 1).all()

@@ -1,0 +1,137 @@
+"""Torch delivery layer tests (reference: tests/test_pytorch_dataloader.py)."""
+
+import decimal
+
+import numpy as np
+import pytest
+import torch
+
+from petastorm_tpu.codecs import NdarrayCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.pytorch import (BatchedDataLoader, DataLoader,
+                                   decimal_friendly_collate)
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.schema import Field, Schema
+
+NUM_ROWS = 40
+
+
+@pytest.fixture(scope="module")
+def torch_dataset(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp("torch_ds") / "ds")
+    schema = Schema("TorchSchema", [
+        Field("id", np.int64),
+        Field("val_u16", np.uint16),
+        Field("val_u32", np.uint32),
+        Field("vec", np.float32, (3,), NdarrayCodec()),
+    ])
+    rows = [{"id": i, "val_u16": i * 2, "val_u32": i * 3,
+             "vec": np.full(3, i, np.float32)} for i in range(NUM_ROWS)]
+    write_dataset(url, schema, rows, row_group_size_rows=8)
+    return url
+
+
+def _collect(loader):
+    batches = list(loader)
+    ids = torch.cat([b["id"] for b in batches]).tolist()
+    return batches, ids
+
+
+def test_round_trip_values_and_batching(torch_dataset):
+    with make_reader(torch_dataset, shuffle_row_groups=False,
+                     reader_pool_type="serial", num_epochs=1) as r:
+        with DataLoader(r, batch_size=8) as loader:
+            batches, ids = _collect(loader)
+    assert ids == list(range(NUM_ROWS))
+    assert all(b["id"].shape[0] == 8 for b in batches)
+    first = batches[0]
+    assert first["vec"].shape == (8, 3)
+    assert torch.equal(first["vec"][3], torch.full((3,), 3.0))
+
+
+def test_dtype_promotions(torch_dataset):
+    with make_reader(torch_dataset, num_epochs=1) as r:
+        with DataLoader(r, batch_size=4) as loader:
+            batch = next(iter(loader))
+    assert batch["val_u16"].dtype == torch.int32
+    assert batch["val_u32"].dtype == torch.int64
+
+
+def test_partial_final_batch(torch_dataset):
+    with make_reader(torch_dataset, shuffle_row_groups=False,
+                     reader_pool_type="serial", num_epochs=1) as r:
+        with DataLoader(r, batch_size=7) as loader:
+            batches, ids = _collect(loader)
+    assert sorted(ids) == list(range(NUM_ROWS))
+    assert [len(b["id"]) for b in batches] == [7, 7, 7, 7, 7, 5]
+
+
+def test_shuffling_changes_order_and_is_seeded(torch_dataset):
+    def read(seed):
+        with make_reader(torch_dataset, shuffle_row_groups=False,
+                         reader_pool_type="serial", num_epochs=1) as r:
+            with DataLoader(r, batch_size=8, shuffling_queue_capacity=20,
+                            seed=seed) as loader:
+                return _collect(loader)[1]
+
+    a, b, c = read(7), read(7), read(8)
+    assert sorted(a) == list(range(NUM_ROWS))
+    assert a != list(range(NUM_ROWS))
+    assert a == b
+    assert a != c
+
+
+def test_batched_loader_transform_fn(torch_dataset):
+    with make_reader(torch_dataset, num_epochs=1) as r:
+        with BatchedDataLoader(
+                r, batch_size=8,
+                transform_fn=lambda b: {"id_f": b["id"].float() * 2}) as loader:
+            batch = next(iter(loader))
+    assert batch["id_f"].dtype == torch.float32
+
+
+def test_error_latch_and_reiteration_guard(torch_dataset):
+    with make_reader(torch_dataset, num_epochs=1) as r:
+        loader = DataLoader(r, batch_size=4,
+                            collate_fn=lambda b: 1 / 0)  # raises in emit
+        with pytest.raises(ZeroDivisionError):
+            next(iter(loader))
+        with pytest.raises(RuntimeError, match="previous iteration failed"):
+            iter(loader).__next__()
+        r.stop(), r.join()
+
+
+def test_string_fields_rejected(tmp_path):
+    url = str(tmp_path / "str_ds")
+    schema = Schema("S", [Field("id", np.int64),
+                          Field("name", np.dtype("object"))])
+    write_dataset(url, schema,
+                  [{"id": i, "name": f"n{i}"} for i in range(10)],
+                  row_group_size_rows=5)
+    with make_reader(url, num_epochs=1) as r:
+        with DataLoader(r, batch_size=2) as loader:
+            with pytest.raises(TypeError, match="string"):
+                next(iter(loader))
+
+
+def test_variable_shape_becomes_list(tmp_path):
+    url = str(tmp_path / "var_ds")
+    schema = Schema("V", [Field("id", np.int64),
+                          Field("pts", np.float32, (None, 2), NdarrayCodec())])
+    rows = [{"id": i, "pts": np.ones((i + 1, 2), np.float32)}
+            for i in range(6)]
+    write_dataset(url, schema, rows, row_group_size_rows=3)
+    with make_reader(url, shuffle_row_groups=False,
+                     reader_pool_type="serial", num_epochs=1) as r:
+        with DataLoader(r, batch_size=3) as loader:
+            batch = next(iter(loader))
+    assert isinstance(batch["pts"], list)
+    assert batch["pts"][2].shape == (3, 2)
+
+
+def test_decimal_friendly_collate():
+    rows = [{"d": decimal.Decimal("1.5"), "x": torch.tensor(1)},
+            {"d": decimal.Decimal("2.5"), "x": torch.tensor(2)}]
+    out = decimal_friendly_collate(rows)
+    assert torch.equal(out["d"], torch.tensor([1.5, 2.5], dtype=torch.float64))
+    assert out["x"].tolist() == [1, 2]
