@@ -24,6 +24,7 @@ __all__ = [
     "ScalarCodec", "NdarrayCodec", "CompressedNdarrayCodec", "CompressedImageCodec",
     "PetastormTpuError", "NoDataAvailableError",
     "make_reader", "make_batch_reader", "materialize_dataset",
+    "make_converter",
 ]
 
 
@@ -48,3 +49,7 @@ def make_batch_reader(*args, **kwargs):
 
 def materialize_dataset(*args, **kwargs):
     return _lazy("petastorm_tpu.etl.writer", "materialize_dataset")(*args, **kwargs)
+
+
+def make_converter(*args, **kwargs):
+    return _lazy("petastorm_tpu.converter", "make_converter")(*args, **kwargs)

@@ -46,7 +46,8 @@ from petastorm_tpu.batch import ColumnBatch
 from petastorm_tpu.dtypes import jax_feed_dtype
 from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.parallel.mesh import local_data_slice, sharding_for_batch
-from petastorm_tpu.shuffle import NoopShufflingBuffer, RandomShufflingBuffer
+from petastorm_tpu.shuffle import (NoopShufflingBuffer, RandomShufflingBuffer,
+                                   iter_batched)
 
 logger = logging.getLogger(__name__)
 
@@ -208,45 +209,20 @@ class JaxDataLoader:
 
     def _produce(self) -> None:
         try:
-            buffer = self._make_buffer()
             local_bs = self._local_rows
-            source = self._reader.iter_batches()
-            exhausted = False
-            while not self._stop_event.is_set():
-                # fill until a batch is retrievable (or source exhausted)
-                while not exhausted and not buffer.can_retrieve(local_bs):
-                    try:
-                        raw = next(source)
-                    except StopIteration:
-                        exhausted = True
-                        buffer.finish()
-                        break
-                    batch = self._prepare(raw)
-                    # add in slices that respect buffer capacity (free_space is
-                    # inf for unbounded buffers)
-                    pos = 0
-                    while pos < batch.num_rows and not self._stop_event.is_set():
-                        free = buffer.free_space
-                        if free <= 0:
-                            if buffer.can_retrieve(local_bs):
-                                self._emit(buffer.retrieve(local_bs))
-                                continue
-                            raise PetastormTpuError(
-                                "Shuffling buffer deadlock: capacity cannot"
-                                " hold min_after + one batch; raise"
-                                " shuffling_queue_capacity")
-                        take = int(min(free, batch.num_rows - pos))
-                        buffer.add(batch.slice_rows(pos, pos + take))
-                        pos += take
-                while buffer.can_retrieve(local_bs) and not self._stop_event.is_set():
-                    out = buffer.retrieve(local_bs)
-                    if out.num_rows < local_bs:
-                        if not self._drop_last:
-                            self._emit(out)
-                        break
-                    self._emit(out)
-                if exhausted and buffer.size == 0:
+
+            def prepared():
+                for raw in self._reader.iter_batches():
+                    if self._stop_event.is_set():
+                        return
+                    yield self._prepare(raw)
+
+            for out in iter_batched(prepared(), self._make_buffer(), local_bs):
+                if self._stop_event.is_set():
                     break
+                if out.num_rows < local_bs and self._drop_last:
+                    continue  # partial tail batch dropped
+                self._emit(out)
             self._push(_Done())
         except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
             self._push(_Error(exc))

@@ -92,6 +92,16 @@ class NGram:
             names.append(self.timestamp_field)
         return names
 
+    @staticmethod
+    def _stackable(field) -> bool:
+        """Static test for whether a field's decoded columns can stack into one
+        (n, k, ...) array.  Must be decidable from the schema alone so
+        ``output_schema`` and ``form_windows`` always agree: fixed shape,
+        non-object dtype, and non-nullable (a null cell turns the decoded
+        column into an object array at runtime)."""
+        return (field.is_fixed_shape and field.dtype != np.dtype(object)
+                and not field.nullable)
+
     # -- window formation -----------------------------------------------------
 
     def window_starts(self, timestamps: np.ndarray,
@@ -156,16 +166,15 @@ class NGram:
                 out[f"{off}{NGRAM_KEY_SEP}{name}"] = batch.columns[name][idx]
         if self.stack_timesteps:
             # fields present at EVERY offset collapse to one (n, k, ...) array -
-            # the layout a context-parallel consumer shards on its 'seq' axis
+            # the layout a context-parallel consumer shards on its 'seq' axis.
+            # The stackability test is the schema-static one, so the emitted
+            # columns always match ``output_schema``.
             common = [n for n in per_offset_fields[self._offsets[0]]
-                      if all(n in per_offset_fields[o] for o in self._offsets)]
+                      if all(n in per_offset_fields[o] for o in self._offsets)
+                      and self._stackable(schema[n])]
             for name in common:
                 parts = [out.pop(f"{o}{NGRAM_KEY_SEP}{name}") for o in self._offsets]
-                if all(p.dtype != object for p in parts):
-                    out[name] = np.stack(parts, axis=1)
-                else:  # variable-shape fields cannot stack; keep flat keys
-                    for o, p in zip(self._offsets, parts):
-                        out[f"{o}{NGRAM_KEY_SEP}{name}"] = p
+                out[name] = np.stack(parts, axis=1)
         return ColumnBatch(out, len(starts))
 
     def output_schema(self, schema: Schema) -> Schema:
@@ -187,7 +196,7 @@ class NGram:
             for name in per_offset[self._offsets[0]]:
                 f = schema[name]
                 if (all(name in per_offset[o] for o in self._offsets)
-                        and f.is_fixed_shape and f.dtype != np.dtype(object)):
+                        and self._stackable(f)):
                     out.append(Field(name, f.dtype, (self.length,) + f.shape,
                                      nullable=f.nullable))
                     stacked.add(name)

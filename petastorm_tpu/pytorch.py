@@ -25,7 +25,8 @@ import torch
 
 from petastorm_tpu.batch import ColumnBatch
 from petastorm_tpu.errors import PetastormTpuError
-from petastorm_tpu.shuffle import NoopShufflingBuffer, RandomShufflingBuffer
+from petastorm_tpu.shuffle import (NoopShufflingBuffer, RandomShufflingBuffer,
+                                   iter_batched)
 
 # numpy dtypes torch cannot represent -> widened dtype (reference pytorch.py:39-56)
 _TORCH_PROMOTIONS = {
@@ -156,36 +157,9 @@ class DataLoader(LoaderBase):
         return batch
 
     def _iter_impl(self):
-        buffer = self._make_buffer()
         source = self.reader.iter_batches()
-        exhausted = False
-        pending: Optional[ColumnBatch] = None  # chunk not yet fully buffered
-        while True:
-            while buffer.can_retrieve(self.batch_size):
-                # after finish() this also drains the partial tail batch
-                yield self._emit(buffer.retrieve(self.batch_size))
-            if exhausted:
-                return
-            if pending is None:
-                try:
-                    pending = next(source)
-                except StopIteration:
-                    exhausted = True
-                    buffer.finish()
-                    continue
-            if pending.num_rows == 0:
-                pending = None
-                continue
-            room = int(min(buffer.free_space, pending.num_rows))
-            if room > 0:
-                buffer.add(pending.slice_rows(0, room))
-                pending = pending.slice_rows(room, pending.num_rows)
-                if pending.num_rows == 0:
-                    pending = None
-            else:
-                # buffer full: full buffer is always above the decorrelation
-                # floor (floor = capacity//2 < capacity), so this cannot loop
-                yield self._emit(buffer.retrieve(self.batch_size))
+        for batch in iter_batched(source, self._make_buffer(), self.batch_size):
+            yield self._emit(batch)
 
     def _emit(self, batch: ColumnBatch) -> Dict:
         out = {name: _column_to_torch(name, col)

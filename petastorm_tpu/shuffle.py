@@ -26,6 +26,46 @@ from petastorm_tpu.batch import ColumnBatch
 from petastorm_tpu.errors import PetastormTpuError
 
 
+def iter_batched(source, buffer: "ShufflingBufferBase", batch_size: int):
+    """Pump ColumnBatches from ``source`` through a shuffling buffer, yielding
+    batches of exactly ``batch_size`` rows (smaller ones only as the stream's
+    tail drains after the source is exhausted).
+
+    The single fill/retrieve/finish/drain engine shared by the torch and jax
+    loaders - the invariants (bounded adds within free_space, retrieval above
+    the decorrelation floor, tail drain after ``finish()``) live here once.
+    """
+    pending = None  # chunk not yet fully added to the buffer
+    exhausted = False
+    while True:
+        while buffer.can_retrieve(batch_size):
+            # after finish() this also drains the (possibly partial) tail
+            yield buffer.retrieve(batch_size)
+        if exhausted:
+            return
+        if pending is None:
+            try:
+                pending = next(source)
+            except StopIteration:
+                exhausted = True
+                buffer.finish()
+                continue
+        if pending.num_rows == 0:
+            pending = None
+            continue
+        room = buffer.free_space
+        if room <= 0:
+            # full yet not retrievable: capacity < min_after + batch_size
+            raise PetastormTpuError(
+                "Shuffling buffer deadlock: capacity cannot hold"
+                " min_after_retrieve + one batch; raise the buffer capacity or"
+                " lower min_after_retrieve/batch_size")
+        take = int(min(room, pending.num_rows))
+        buffer.add(pending.slice_rows(0, take))
+        pending = (pending.slice_rows(take, pending.num_rows)
+                   if take < pending.num_rows else None)
+
+
 class ShufflingBufferBase:
     def add(self, batch: ColumnBatch) -> None:
         raise NotImplementedError

@@ -1,0 +1,99 @@
+"""TensorFlow delivery layer (optional: requires tensorflow to be installed).
+
+Reference parity: petastorm/tf_utils.py (433 LoC). The reference carries two
+APIs: TF1 graph-mode ``tf_tensors`` (tf.py_func + RandomShuffleQueue,
+tf_utils.py:270-319) and ``make_petastorm_dataset`` (tf.data.Dataset
+.from_generator, tf_utils.py:329-399). Only the tf.data path is provided here -
+graph-mode queues are dead API in TF2, and on TPU the first-class consumer is
+the jax loader (SURVEY.md section 2.14: the TF C++ runtime boundary is replaced
+by the JAX ingest loop itself).
+
+TensorFlow is NOT a dependency of petastorm_tpu; importing this module without
+it installed raises ImportError with guidance.
+"""
+
+from __future__ import annotations
+
+import datetime
+from decimal import Decimal
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+
+try:
+    import tensorflow as tf
+except ImportError as _exc:
+    raise ImportError(
+        "petastorm_tpu.tf requires tensorflow, which is not installed. The"
+        " TPU-native consumers are petastorm_tpu.jax (JaxDataLoader) and"
+        " petastorm_tpu.pytorch; install tensorflow only if you need tf.data"
+        " interop.") from _exc
+
+
+def _tf_dtype(numpy_dtype: np.dtype) -> "tf.DType":
+    """numpy -> tf dtype incl. the reference's promotions (tf_utils.py:27-44):
+    uint16 -> int32, uint32 -> int64, str/Decimal -> string, datetime64 -> int64."""
+    numpy_dtype = np.dtype(numpy_dtype)
+    if numpy_dtype == np.uint16:
+        return tf.int32
+    if numpy_dtype == np.uint32:
+        return tf.int64
+    if numpy_dtype.kind in ("U", "S", "O"):
+        return tf.string
+    if numpy_dtype.kind == "M":
+        return tf.int64
+    return tf.as_dtype(numpy_dtype)
+
+
+def _sanitize_value(value):
+    """Row value -> something tf can ingest (reference tf_utils.py:58-97)."""
+    if isinstance(value, Decimal):
+        return str(value)
+    if isinstance(value, (datetime.date, datetime.datetime)):
+        # TZ-explicit epoch nanoseconds (naive datetimes are treated as UTC,
+        # deterministically across hosts)
+        return np.datetime64(value).astype("datetime64[ns]").astype(np.int64)
+    if isinstance(value, np.ndarray) and value.dtype == np.uint16:
+        return value.astype(np.int32)
+    if isinstance(value, np.ndarray) and value.dtype == np.uint32:
+        return value.astype(np.int64)
+    if isinstance(value, np.ndarray) and value.dtype.kind == "M":
+        return value.astype("datetime64[ns]").astype(np.int64)
+    return value
+
+
+def make_petastorm_dataset(reader) -> "tf.data.Dataset":
+    """``tf.data.Dataset`` over a Reader (reference tf_utils.py:329-399).
+
+    Row readers yield one element per row; batch readers yield one element per
+    rowgroup (unbatch/rebatch downstream, as the reference's converter does,
+    spark_dataset_converter.py:320-336).  NGram readers are not supported on
+    the tf path (use the jax loader's sequence delivery instead).
+    """
+    if getattr(reader, "ngram", None) is not None:
+        raise PetastormTpuError(
+            "NGram readers are not supported by make_petastorm_dataset; use"
+            " the jax loader (sequence-sharded delivery) instead")
+    schema = reader.schema
+    fields = [f.name for f in schema]
+    batched = getattr(reader, "batched_output", False)
+
+    def _spec(f):
+        shape = tuple(None if d is None else d for d in f.shape)
+        if f.dtype.kind == "O" and not shape:
+            shape = None  # object cells can hold arrays of unknown rank
+        if batched:
+            shape = (None,) + shape if shape is not None else None
+        return tf.TensorSpec(shape=shape, dtype=_tf_dtype(f.dtype))
+
+    signature = tuple(_spec(schema[f]) for f in fields)
+
+    def _generator():
+        for item in reader:
+            yield tuple(_sanitize_value(getattr(item, f)) for f in fields)
+
+    dataset = tf.data.Dataset.from_generator(_generator,
+                                             output_signature=signature)
+    named = schema.make_namedtuple_type()
+    return dataset.map(lambda *row: named(*row))

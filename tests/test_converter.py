@@ -1,0 +1,173 @@
+"""Converter tests (reference: tests/test_spark_dataset_converter.py, JVM-free)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+import torch
+
+from petastorm_tpu.converter import (CACHE_DIR_ENV_VAR, _registered_converters,
+                                     make_converter)
+from petastorm_tpu.errors import PetastormTpuError
+
+
+def _df(n=64):
+    return pd.DataFrame({
+        "id": np.arange(n, dtype=np.int64),
+        "x": np.linspace(0, 1, n).astype(np.float64),
+        "label": (np.arange(n) % 3).astype(np.int32),
+    })
+
+
+def test_requires_cache_dir(monkeypatch):
+    monkeypatch.delenv(CACHE_DIR_ENV_VAR, raising=False)
+    with pytest.raises(PetastormTpuError, match="cache"):
+        make_converter(_df())
+
+
+def test_materialize_and_read_back(tmp_path):
+    conv = make_converter(_df(), cache_dir_url=str(tmp_path / "cache"))
+    try:
+        assert len(conv) == 64
+        with conv.make_reader(reader_pool_type="serial",
+                              shuffle_row_groups=False, num_epochs=1) as r:
+            rows = list(r)
+        assert len(rows) == 64
+        assert [row.id for row in rows] == list(range(64))
+    finally:
+        conv.delete()
+    assert not os.path.exists(conv.cache_url)
+
+
+def test_float64_downcast_default_and_opt_out(tmp_path):
+    conv32 = make_converter(_df(), cache_dir_url=str(tmp_path / "c32"))
+    conv64 = make_converter(_df(), cache_dir_url=str(tmp_path / "c64"),
+                            dtype=None)
+    try:
+        assert conv32.schema["x"].dtype == np.float32
+        assert conv64.schema["x"].dtype == np.float64
+    finally:
+        conv32.delete(), conv64.delete()
+
+
+def test_dedup_by_content(tmp_path):
+    cache = str(tmp_path / "cache")
+    a = make_converter(_df(), cache_dir_url=cache)
+    b = make_converter(_df(), cache_dir_url=cache)        # same content
+    c = make_converter(_df(32), cache_dir_url=cache)      # different content
+    d = make_converter(_df(), cache_dir_url=cache, row_group_size_mb=1)
+    try:
+        assert a is b  # shared handle: delete() on one cannot orphan the other
+        assert a.cache_url != c.cache_url
+        assert a.cache_url != d.cache_url  # params are part of the fingerprint
+    finally:
+        for conv in (a, b, c, d):
+            conv.delete()
+    # a fresh conversion after delete() re-materializes rather than reusing a
+    # dead handle
+    e = make_converter(_df(), cache_dir_url=cache)
+    try:
+        assert e is not a
+        with e.make_reader(num_epochs=1) as r:
+            assert len(list(r)) == 64
+    finally:
+        e.delete()
+
+
+def test_env_var_cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(CACHE_DIR_ENV_VAR, str(tmp_path / "envcache"))
+    conv = make_converter(_df())
+    try:
+        assert str(tmp_path / "envcache") in conv.cache_url
+    finally:
+        conv.delete()
+
+
+def test_arrow_table_input(tmp_path):
+    table = pa.table({"id": np.arange(10, dtype=np.int64),
+                      "y": np.ones(10, np.float32)})
+    conv = make_converter(table, cache_dir_url=str(tmp_path / "cache"))
+    try:
+        with conv.make_reader(num_epochs=1) as r:
+            assert len(list(r)) == 10
+    finally:
+        conv.delete()
+
+
+def test_unsupported_input_rejected(tmp_path):
+    with pytest.raises(PetastormTpuError, match="Unsupported input"):
+        make_converter([1, 2, 3], cache_dir_url=str(tmp_path / "cache"))
+
+
+def test_make_torch_dataloader(tmp_path):
+    conv = make_converter(_df(), cache_dir_url=str(tmp_path / "cache"))
+    try:
+        with conv.make_torch_dataloader(
+                batch_size=16,
+                reader_kwargs={"num_epochs": 1}) as loader:
+            batches = list(loader)
+        assert sum(len(b["id"]) for b in batches) == 64
+        assert isinstance(batches[0]["x"], torch.Tensor)
+    finally:
+        conv.delete()
+
+
+def test_make_jax_loader(tmp_path):
+    import jax
+
+    conv = make_converter(_df(), cache_dir_url=str(tmp_path / "cache"))
+    try:
+        with conv.make_jax_loader(
+                batch_size=16,
+                reader_kwargs={"num_epochs": 1}) as loader:
+            batch = next(iter(loader))
+        assert isinstance(batch["x"], jax.Array)
+        assert batch["x"].shape == (16,)
+    finally:
+        conv.delete()
+
+
+def test_rank_mismatch_warns(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    monkeypatch.setenv("HOROVOD_SIZE", "4")
+    conv = make_converter(_df(2000), cache_dir_url=str(tmp_path / "cache"),
+                          row_group_size_mb=0.001)
+    try:
+        with pytest.warns(UserWarning, match="disagrees"):
+            with conv.make_reader(cur_shard=0, shard_count=4,
+                                  num_epochs=1) as r:
+                next(iter(r))
+        with pytest.warns(UserWarning, match="ALL the data"):
+            with conv.make_reader(num_epochs=1) as r:
+                next(iter(r))
+    finally:
+        conv.delete()
+
+
+def test_atexit_registration(tmp_path):
+    conv = make_converter(_df(), cache_dir_url=str(tmp_path / "cache"))
+    assert conv in _registered_converters
+    conv.delete()
+    assert conv not in _registered_converters
+    keep = make_converter(_df(), cache_dir_url=str(tmp_path / "cache2"),
+                          delete_at_exit=False)
+    assert keep not in _registered_converters
+    # delete() on a non-owning converter must not remove the files
+    keep.delete()
+    assert os.path.exists(keep.cache_url)
+
+
+def test_make_tf_dataset(tmp_path):
+    conv = make_converter(_df(), cache_dir_url=str(tmp_path / "cache"))
+    try:
+        cm = conv.make_tf_dataset(
+            reader_kwargs={"num_epochs": 1, "reader_pool_type": "serial",
+                           "shuffle_row_groups": False})
+        with cm as dataset:
+            ids = [int(item.id) for item in dataset.as_numpy_iterator()]
+        assert ids == list(range(64))
+        assert cm._reader._stopped  # reader released on exit
+    finally:
+        conv.delete()

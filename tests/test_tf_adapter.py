@@ -1,0 +1,56 @@
+"""TF delivery layer tests (reference: tests/test_tf_utils.py, tf.data path)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from petastorm_tpu.codecs import NdarrayCodec  # noqa: E402
+from petastorm_tpu.errors import PetastormTpuError  # noqa: E402
+from petastorm_tpu.etl.writer import write_dataset  # noqa: E402
+from petastorm_tpu.ngram import NGram  # noqa: E402
+from petastorm_tpu.reader import make_reader  # noqa: E402
+from petastorm_tpu.tf import make_petastorm_dataset  # noqa: E402
+from petastorm_tpu.schema import Field, Schema  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tf_dataset_url(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp("tf_ds") / "ds")
+    schema = Schema("TfSchema", [
+        Field("id", np.int64),
+        Field("u16", np.uint16),
+        Field("name", np.dtype("object")),
+        Field("vec", np.float32, (3,), NdarrayCodec()),
+    ])
+    rows = [{"id": i, "u16": i * 2, "name": f"row_{i}",
+             "vec": np.full(3, i, np.float32)} for i in range(20)]
+    write_dataset(url, schema, rows, row_group_size_rows=5)
+    return url
+
+
+def test_round_trip_with_promotions_and_strings(tf_dataset_url):
+    with make_reader(tf_dataset_url, reader_pool_type="serial",
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader)
+        items = list(ds.as_numpy_iterator())
+    assert len(items) == 20
+    assert [int(x.id) for x in items] == list(range(20))
+    assert items[3].u16 == 6 and items[3].u16.dtype == np.int32
+    assert items[3].name == b"row_3"
+    np.testing.assert_array_equal(items[3].vec, np.full(3, 3, np.float32))
+
+
+def test_tf_data_pipeline_ops(tf_dataset_url):
+    with make_reader(tf_dataset_url, reader_pool_type="serial",
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader)
+        total = ds.map(lambda row: row.id).reduce(np.int64(0), lambda a, b: a + b)
+        assert int(total) == sum(range(20))
+
+
+def test_ngram_rejected(tf_dataset_url):
+    ngram = NGram({0: ["vec"], 1: ["vec"]}, 1, "id")
+    with make_reader(tf_dataset_url, ngram=ngram, num_epochs=1) as reader:
+        with pytest.raises(PetastormTpuError, match="NGram"):
+            make_petastorm_dataset(reader)
