@@ -1,0 +1,14 @@
+"""Benchmark harness (L7).
+
+Reference parity: petastorm/benchmark/ - ``reader_throughput`` warmup+measure
+cycles reporting samples/sec, RSS, CPU% (throughput.py:113-174), fresh-process
+re-exec for accurate RSS (throughput.py:69-91), argparse CLI (cli.py), and a
+loader-only microbench without parquet (dummy_reader.py:25-85).
+"""
+
+from petastorm_tpu.benchmark.throughput import (BenchmarkResult, WorkerPoolType,
+                                                jax_loader_throughput,
+                                                reader_throughput)
+
+__all__ = ["BenchmarkResult", "WorkerPoolType", "reader_throughput",
+           "jax_loader_throughput"]

@@ -1,0 +1,76 @@
+"""``petastorm-tpu-throughput`` CLI.
+
+Reference parity: petastorm/benchmark/cli.py:30-112 (flags for dataset url,
+field regexes, warmup/measure cycles, pool type/size) plus the fresh-process
+isolation mode the reference buries in throughput.py:69-91; extended with
+``--method jax`` for the device feed path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-throughput",
+        description="Measure reader / device-loader throughput on a dataset")
+    parser.add_argument("dataset_url", help="file:// or fsspec URL of the dataset")
+    parser.add_argument("-f", "--field-regex", nargs="+", default=None,
+                        help="only read fields matching these regexes")
+    parser.add_argument("-n", "--warmup-cycles", type=int, default=200)
+    parser.add_argument("-m", "--measure-cycles", type=int, default=1000)
+    parser.add_argument("-p", "--pool-type", default="thread",
+                        choices=("thread", "process", "serial"))
+    parser.add_argument("-w", "--workers-count", type=int, default=3)
+    parser.add_argument("--method", default="row", choices=("row", "batch", "jax"),
+                        help="row=make_reader, batch=make_batch_reader, "
+                             "jax=device feed via JaxDataLoader")
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="device batch size (--method jax only)")
+    parser.add_argument("--no-shuffle", action="store_true",
+                        help="disable rowgroup shuffling")
+    parser.add_argument("--json", action="store_true",
+                        help="print one JSON line instead of human-readable text")
+    parser.add_argument("--isolated", action="store_true",
+                        help="re-run in a fresh interpreter for clean RSS")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.isolated:
+        from petastorm_tpu.benchmark.throughput import run_isolated
+        forwarded = [a for a in (argv if argv is not None else sys.argv[1:])
+                     if a not in ("--isolated", "--json")]
+        result = run_isolated(forwarded)
+    elif args.method == "jax":
+        from petastorm_tpu.benchmark.throughput import jax_loader_throughput
+        result = jax_loader_throughput(
+            args.dataset_url, batch_size=args.batch_size,
+            warmup_batches=max(args.warmup_cycles // 25, 2),
+            measure_batches=max(args.measure_cycles // 25, 8),
+            pool_type=args.pool_type, workers_count=args.workers_count,
+            field_regex=args.field_regex)
+    else:
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+        result = reader_throughput(
+            args.dataset_url, field_regex=args.field_regex,
+            warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
+            pool_type=args.pool_type, workers_count=args.workers_count,
+            read_method=args.method, shuffle_row_groups=not args.no_shuffle)
+
+    if args.json:
+        print(result.to_json())
+    else:
+        print(f"{result.samples_per_sec:.2f} samples/sec "
+              f"({result.samples} samples in {result.wall_s:.2f}s), "
+              f"RSS {result.rss_mb:.1f} MB, CPU {result.cpu_percent:.1f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

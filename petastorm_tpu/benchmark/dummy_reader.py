@@ -1,0 +1,108 @@
+"""Loader-only microbenchmark: no parquet, no IO - just the delivery layer.
+
+Reference parity: petastorm/benchmark/dummy_reader.py:25-85 - a synthetic
+reader feeding the loaders so their overhead (shuffle buffer, collate, device
+transfer) can be measured in isolation across batch sizes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.test_util.reader_mock import ReaderMock
+
+#: feature sizes roughly matching the reference microbench payload
+#: (dummy_reader.py:30-38: one flat float feature vector + int label)
+BENCH_SCHEMA = Schema("LoaderBench", [
+    Field("feature", np.float32, (64,)),
+    Field("label", np.int64),
+])
+
+
+def _measure(loader, warmup_batches: int, measure_batches: int,
+             block=None) -> float:
+    it = iter(loader)
+
+    def consume(n: int) -> int:
+        rows = 0
+        for _ in range(n):
+            batch = next(it)
+            if block is not None:
+                block(batch)
+            first = batch[next(iter(batch))] if isinstance(batch, dict) else batch[0]
+            rows += int(first.shape[0])
+        return rows
+
+    consume(warmup_batches)
+    t0 = time.perf_counter()
+    rows = consume(measure_batches)
+    return rows / (time.perf_counter() - t0)
+
+
+def loader_microbench(batch_sizes: Sequence[int] = (10, 100, 1000, 10000),
+                      warmup_batches: int = 5,
+                      measure_batches: int = 50,
+                      shuffling_queue_capacity: int = 0,
+                      kinds: Sequence[str] = ("torch", "torch_batched", "jax"),
+                      ) -> List[Dict]:
+    """samples/sec of each delivery loader at each batch size.
+
+    Reference: benchmark/dummy_reader.py:47-82 (DataLoader vs BatchedDataLoader
+    sweep); extended with the jax device loader, the path TPU consumers use.
+    """
+    results: List[Dict] = []
+    for batch_size in batch_sizes:
+        for kind in kinds:
+            reader = ReaderMock(BENCH_SCHEMA, batch_size=batch_size,
+                                num_batches=None)
+            if kind == "torch":
+                from petastorm_tpu.pytorch import DataLoader
+                loader = DataLoader(reader, batch_size=batch_size,
+                                    shuffling_queue_capacity=shuffling_queue_capacity)
+                rate = _measure(loader, warmup_batches, measure_batches)
+            elif kind == "torch_batched":
+                from petastorm_tpu.pytorch import BatchedDataLoader
+                loader = BatchedDataLoader(
+                    reader, batch_size=batch_size,
+                    shuffling_queue_capacity=shuffling_queue_capacity)
+                rate = _measure(loader, warmup_batches, measure_batches)
+            elif kind == "jax":
+                import jax
+
+                from petastorm_tpu.jax import JaxDataLoader
+                with JaxDataLoader(reader, batch_size=batch_size) as loader:
+                    rate = _measure(loader, warmup_batches, measure_batches,
+                                    block=jax.block_until_ready)
+            else:
+                raise ValueError(f"unknown loader kind {kind!r}")
+            reader.stop()
+            results.append({"loader": kind, "batch_size": batch_size,
+                            "samples_per_sec": rate})
+    return results
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Microbenchmark delivery loaders over a synthetic reader")
+    parser.add_argument("--batch-sizes", type=int, nargs="+",
+                        default=[10, 100, 1000, 10000])
+    parser.add_argument("--kinds", nargs="+",
+                        default=["torch", "torch_batched", "jax"])
+    parser.add_argument("--measure-batches", type=int, default=50)
+    parser.add_argument("--shuffling-queue-capacity", type=int, default=0)
+    args = parser.parse_args()
+    for r in loader_microbench(batch_sizes=args.batch_sizes, kinds=args.kinds,
+                               measure_batches=args.measure_batches,
+                               shuffling_queue_capacity=args.shuffling_queue_capacity):
+        print(f"{r['loader']:>14}  batch={r['batch_size']:<6} "
+              f"{r['samples_per_sec']:>12.1f} samples/sec")
+
+
+if __name__ == "__main__":
+    main()

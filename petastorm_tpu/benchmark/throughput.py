@@ -1,0 +1,171 @@
+"""Reader/loader throughput measurement.
+
+Reference parity: petastorm/benchmark/throughput.py - warmup then measured
+cycles (throughput.py:113-174), samples/sec + RSS + CPU% metrics
+(throughput.py:39,84-88), and an isolated fresh-process mode for clean RSS
+numbers (throughput.py:69-91, which re-execs itself).
+
+TPU-first additions: ``jax_loader_throughput`` measures the actual device feed
+path (host parquet -> ColumnBatch -> sharded ``jax.Array``), which is the
+number that matters for keeping a TPU busy; samples/sec alone (the reference's
+only metric) ignores transfer overlap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+WorkerPoolType = ("thread", "process", "serial")
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    """What one measurement run produced.
+
+    Reference: the three reported metrics at benchmark/throughput.py:84-88.
+    """
+    samples_per_sec: float
+    wall_s: float
+    samples: int
+    rss_mb: float
+    cpu_percent: float
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def _rss_mb() -> float:
+    """Resident set size of this process, in MB (linux /proc)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    import resource
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+class _CpuClock:
+    """CPU%% over a wall interval = (user+sys delta) / wall delta * 100."""
+
+    def start(self) -> None:
+        t = os.times()
+        self._cpu0, self._wall0 = t.user + t.system, time.perf_counter()
+
+    def stop(self) -> float:
+        t = os.times()
+        wall = time.perf_counter() - self._wall0
+        return 100.0 * (t.user + t.system - self._cpu0) / max(wall, 1e-9)
+
+
+def reader_throughput(dataset_url: str,
+                      field_regex: Optional[Sequence[str]] = None,
+                      warmup_cycles: int = 200,
+                      measure_cycles: int = 1000,
+                      pool_type: str = "thread",
+                      workers_count: int = 3,
+                      read_method: str = "row",
+                      shuffle_row_groups: bool = True,
+                      transform_spec=None,
+                      storage_options: Optional[dict] = None) -> BenchmarkResult:
+    """Measure raw reader throughput in samples/sec.
+
+    ``read_method='row'`` counts one sample per ``next()`` (make_reader);
+    ``'batch'`` iterates make_batch_reader and counts rows per columnar batch.
+    Reference: ``reader_throughput`` (benchmark/throughput.py:113-174).
+    """
+    from petastorm_tpu.reader import make_batch_reader, make_reader
+
+    if read_method not in ("row", "batch"):
+        raise ValueError(f"read_method must be 'row' or 'batch', got {read_method!r}")
+    factory = make_reader if read_method == "row" else make_batch_reader
+    clock = _CpuClock()
+    with factory(dataset_url, schema_fields=list(field_regex) if field_regex else None,
+                 reader_pool_type=pool_type, workers_count=workers_count,
+                 shuffle_row_groups=shuffle_row_groups, num_epochs=None,
+                 transform_spec=transform_spec,
+                 storage_options=storage_options) as reader:
+        it = iter(reader)
+
+        def consume(cycles: int) -> int:
+            n = 0
+            for _ in range(cycles):
+                item = next(it)
+                n += len(item[0]) if read_method == "batch" else 1
+            return n
+
+        consume(warmup_cycles)
+        clock.start()
+        t0 = time.perf_counter()
+        samples = consume(measure_cycles)
+        wall = time.perf_counter() - t0
+        cpu = clock.stop()
+    return BenchmarkResult(samples_per_sec=samples / wall, wall_s=wall,
+                           samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu)
+
+
+def jax_loader_throughput(dataset_url: str,
+                          batch_size: int = 32,
+                          warmup_batches: int = 8,
+                          measure_batches: int = 64,
+                          pool_type: str = "thread",
+                          workers_count: int = 3,
+                          field_regex: Optional[Sequence[str]] = None,
+                          storage_options: Optional[dict] = None) -> BenchmarkResult:
+    """Measure the device feed path: batches landing as committed ``jax.Array``.
+
+    Blocks on every batch (``block_until_ready``) so the number reflects
+    host decode + transfer, i.e. the ceiling on how fast this loader can feed
+    a training step.
+    """
+    import jax
+
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    clock = _CpuClock()
+    reader = make_batch_reader(
+        dataset_url, schema_fields=list(field_regex) if field_regex else None,
+        reader_pool_type=pool_type, workers_count=workers_count,
+        num_epochs=None, storage_options=storage_options)
+    with JaxDataLoader(reader, batch_size=batch_size) as loader:
+        it = iter(loader)
+
+        def consume(n_batches: int) -> int:
+            n = 0
+            for _ in range(n_batches):
+                batch = next(it)
+                jax.block_until_ready(batch)
+                first = next(iter(batch.values()))
+                n += int(first.shape[0])
+            return n
+
+        consume(warmup_batches)
+        clock.start()
+        t0 = time.perf_counter()
+        samples = consume(measure_batches)
+        wall = time.perf_counter() - t0
+        cpu = clock.stop()
+    return BenchmarkResult(samples_per_sec=samples / wall, wall_s=wall,
+                           samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu)
+
+
+def run_isolated(cli_args: List[str]) -> BenchmarkResult:
+    """Run the benchmark CLI in a fresh interpreter and parse its JSON line.
+
+    Reference: throughput.py:69-91 re-execs for an RSS untainted by the parent
+    (dataset-generation, test fixtures, jax runtime...).
+    """
+    out = subprocess.run(
+        [sys.executable, "-m", "petastorm_tpu.benchmark.cli", "--json", *cli_args],
+        capture_output=True, text=True, check=True)
+    line = out.stdout.strip().splitlines()[-1]
+    return BenchmarkResult(**json.loads(line))

@@ -106,12 +106,19 @@ def _to_arrow_table(data, dtype: Optional[str]) -> pa.Table:
 
 
 def _fingerprint(table: pa.Table, params: Dict) -> str:
-    """Content hash: schema + write params + every column buffer."""
+    """Content hash: schema + write params + every column buffer.
+
+    Zero-copy slices share the parent's untrimmed buffers and differ only in
+    array offset/length, so those are hashed too - otherwise every slice of a
+    table would collide with the full table.
+    """
     h = hashlib.sha256()
     h.update(str(sorted(params.items())).encode())
     h.update(table.schema.serialize().to_pybytes())
+    h.update(str(table.num_rows).encode())
     for batch in table.to_batches():
         for col in batch.columns:
+            h.update(f"{col.offset}:{len(col)};".encode())
             for buf in col.buffers():
                 if buf is not None:
                     h.update(buf)
@@ -207,13 +214,19 @@ class DatasetConverter:
     """
 
     def __init__(self, cache_url: str, file_urls: List[str], dataset_size: int,
-                 schema: Schema, _owns_cache: bool = True):
+                 schema: Schema, _owns_cache: bool = True,
+                 storage_options: Optional[dict] = None):
         self.cache_url = cache_url
         self.file_urls = list(file_urls)
         self.dataset_size = dataset_size
         self.schema = schema
+        self.storage_options = storage_options
         self._owns_cache = _owns_cache
         self._deleted = False
+
+    def _reader(self, kwargs: Dict):
+        kwargs.setdefault("storage_options", self.storage_options)
+        return make_reader(self.cache_url, **kwargs)
 
     def __len__(self) -> int:
         return self.dataset_size
@@ -223,7 +236,7 @@ class DatasetConverter:
     def make_reader(self, **kwargs):
         """A petastorm_tpu Reader over the cached dataset."""
         _check_shard_rank_env(kwargs.get("cur_shard"), kwargs.get("shard_count"))
-        return make_reader(self.cache_url, **kwargs)
+        return self._reader(dict(kwargs))
 
     def make_jax_loader(self, batch_size: int, mesh=None, shardings=None,
                         reader_kwargs: Optional[Dict] = None, **loader_kwargs):
@@ -234,9 +247,15 @@ class DatasetConverter:
         reader_kwargs = dict(reader_kwargs or {})
         _check_shard_rank_env(reader_kwargs.get("cur_shard"),
                               reader_kwargs.get("shard_count"))
-        reader = make_reader(self.cache_url, **reader_kwargs)
-        return JaxDataLoader(reader, batch_size=batch_size, mesh=mesh,
-                             shardings=shardings, **loader_kwargs)
+        reader = self._reader(reader_kwargs)
+        try:
+            return JaxDataLoader(reader, batch_size=batch_size, mesh=mesh,
+                                 shardings=shardings, **loader_kwargs)
+        except Exception:
+            # otherwise the reader's executor threads/ventilator poll forever
+            reader.stop()
+            reader.join()
+            raise
 
     def make_torch_dataloader(self, batch_size: int = 32,
                               shuffling_queue_capacity: int = 0,
@@ -249,10 +268,15 @@ class DatasetConverter:
         reader_kwargs = dict(reader_kwargs or {})
         _check_shard_rank_env(reader_kwargs.get("cur_shard"),
                               reader_kwargs.get("shard_count"))
-        reader = make_reader(self.cache_url, **reader_kwargs)
-        return BatchedDataLoader(
-            reader, batch_size=batch_size,
-            shuffling_queue_capacity=shuffling_queue_capacity, **loader_kwargs)
+        reader = self._reader(reader_kwargs)
+        try:
+            return BatchedDataLoader(
+                reader, batch_size=batch_size,
+                shuffling_queue_capacity=shuffling_queue_capacity, **loader_kwargs)
+        except Exception:
+            reader.stop()
+            reader.join()
+            raise
 
     def make_tf_dataset(self, reader_kwargs: Optional[Dict] = None):
         """Context manager yielding a ``tf.data.Dataset`` over the cached
@@ -263,8 +287,13 @@ class DatasetConverter:
         reader_kwargs = dict(reader_kwargs or {})
         _check_shard_rank_env(reader_kwargs.get("cur_shard"),
                               reader_kwargs.get("shard_count"))
-        reader = make_reader(self.cache_url, **reader_kwargs)
-        return _TfDatasetContextManager(reader, make_petastorm_dataset)
+        reader = self._reader(reader_kwargs)
+        try:
+            return _TfDatasetContextManager(reader, make_petastorm_dataset)
+        except Exception:
+            reader.stop()
+            reader.join()
+            raise
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -273,7 +302,7 @@ class DatasetConverter:
         if self._deleted or not self._owns_cache:
             self._deleted = True
             return
-        fs, root = get_filesystem_and_path(self.cache_url)
+        fs, root = get_filesystem_and_path(self.cache_url, self.storage_options)
         try:
             fs.delete_dir(root)
         except FileNotFoundError:
@@ -308,8 +337,11 @@ def make_converter(data,
     cache_dir_url = normalize_dir_url(cache_dir_url)
 
     table = _to_arrow_table(data, dtype)
-    params = {"codec": compression_codec or "none",
-              "rg_mb": row_group_size_mb, "v": 1}
+    # "snappy" is what the write below actually uses when codec is None; the
+    # params dict must record the same value or an explicit codec='snappy'
+    # call would materialize a second byte-identical cache entry
+    compression_codec = compression_codec or "snappy"
+    params = {"codec": compression_codec, "rg_mb": row_group_size_mb, "v": 2}
     tag = _fingerprint(table, params)
     ds_url = posixpath.join(cache_dir_url, f"converted-{tag}")
 
@@ -319,7 +351,17 @@ def make_converter(data,
     live = _converters_by_url.get(ds_url)
     if live is not None and not live._deleted:
         # same content converted earlier in this process: share the handle, so
-        # one delete() cannot destroy the dataset under another reference
+        # one delete() cannot destroy the dataset under another reference.
+        # Persistence wins on disagreement: if any caller asked to keep the
+        # cache (delete_at_exit=False), un-register the exit cleanup.
+        if not delete_at_exit and live._owns_cache:
+            live._owns_cache = False
+            if live in _registered_converters:
+                _registered_converters.remove(live)
+        elif delete_at_exit and not live._owns_cache:
+            warnings.warn(
+                f"Cache {ds_url} was already created with delete_at_exit=False;"
+                " it will be kept despite this call's delete_at_exit=True.")
         return live
 
     existing = fs.get_file_info(root)
@@ -331,7 +373,8 @@ def make_converter(data,
         if files:
             logger.info("Reusing cached converted dataset %s", ds_url)
             conv = DatasetConverter(ds_url, files, table.num_rows, schema,
-                                    _owns_cache=delete_at_exit)
+                                    _owns_cache=delete_at_exit,
+                                    storage_options=storage_options)
             _converters_by_url[ds_url] = conv
             if delete_at_exit:
                 _registered_converters.append(conv)
@@ -352,7 +395,7 @@ def make_converter(data,
         {SCHEMA_METADATA_KEY: schema.to_json().encode()})
     pq.write_table(stamped, data_path, filesystem=fs,
                    row_group_size=rows_per_group,
-                   compression=compression_codec or "snappy")
+                   compression=compression_codec)
     try:
         fs.move(tmp_root, root)
     except OSError:
@@ -364,7 +407,8 @@ def make_converter(data,
     _wait_files_available(fs, files)
     _advise_on_file_sizes(fs, files)
     conv = DatasetConverter(ds_url, files, table.num_rows, schema,
-                            _owns_cache=delete_at_exit)
+                            _owns_cache=delete_at_exit,
+                            storage_options=storage_options)
     _converters_by_url[ds_url] = conv
     if delete_at_exit:
         _registered_converters.append(conv)

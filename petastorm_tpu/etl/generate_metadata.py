@@ -1,0 +1,71 @@
+"""``petastorm-tpu-generate-metadata``: (re)stamp dataset metadata.
+
+Reference parity: petastorm/etl/petastorm_generate_metadata.py (161 LoC,
+console script at setup.py:94) - regenerate ``_common_metadata`` (schema +
+per-file rowgroup counts) for a dataset whose metadata is missing or stale,
+e.g. after files were added/rewritten by an external engine.
+
+The schema source is, in order: an explicit ``--schema-from`` dataset, the
+schema JSON embedded in the data files themselves, or (with ``--infer``)
+inference from the arrow schema (scalar columns only, like make_batch_reader).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def generate_metadata(dataset_url: str,
+                      schema_from: Optional[str] = None,
+                      infer: bool = False,
+                      storage_options: Optional[dict] = None) -> None:
+    from petastorm_tpu.etl.metadata import open_dataset
+    from petastorm_tpu.etl.writer import stamp_dataset_metadata
+
+    schema = None
+    if schema_from is not None:
+        from petastorm_tpu.etl.metadata import infer_or_load_schema
+        schema = infer_or_load_schema(
+            open_dataset(schema_from, storage_options=storage_options,
+                         require_stored_schema=True))
+    elif infer:
+        from petastorm_tpu.etl.metadata import infer_or_load_schema
+        schema = infer_or_load_schema(
+            open_dataset(dataset_url, storage_options=storage_options,
+                         require_stored_schema=False))
+    # schema=None -> stamp_dataset_metadata reads the schema JSON from file KV
+    stamp_dataset_metadata(dataset_url, schema=schema,
+                           storage_options=storage_options)
+    logger.info("Stamped metadata for %s", dataset_url)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-generate-metadata",
+        description="Regenerate _common_metadata (schema + rowgroup counts)"
+                    " for a dataset")
+    parser.add_argument("dataset_url")
+    parser.add_argument("--schema-from", default=None,
+                        help="borrow the stored schema from another dataset URL")
+    parser.add_argument("--infer", action="store_true",
+                        help="infer the schema from the parquet arrow schema"
+                             " when no stored schema exists")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    generate_metadata(args.dataset_url, schema_from=args.schema_from,
+                      infer=args.infer)
+    print(f"metadata stamped: {args.dataset_url}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

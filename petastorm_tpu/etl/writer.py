@@ -68,13 +68,22 @@ def write_dataset(url: str,
                   file_prefix: str = "part",
                   filesystem: Optional[pafs.FileSystem] = None,
                   storage_options: Optional[dict] = None,
-                  stamp_metadata: bool = True) -> List[str]:
+                  stamp_metadata: bool = True,
+                  mode: str = "error") -> List[str]:
     """Encode + write rows as a petastorm_tpu parquet dataset; returns file paths.
 
     ``partition_by`` names scalar fields materialized as hive ``key=value``
     directories (values must be str/int/bool-convertible); partitioned fields are
     not duplicated inside the files, matching parquet convention.
+
+    ``mode``: what to do when ``url`` already holds data files - ``"error"``
+    (default; silently mixing old and new rows is almost never intended),
+    ``"overwrite"`` (delete existing contents first), or ``"append"`` (add new
+    part files; the metadata stamp is refreshed to cover old + new).
     """
+    if mode not in ("error", "overwrite", "append"):
+        raise ValueError(f"mode must be 'error', 'overwrite' or 'append',"
+                         f" got {mode!r}")
     if row_group_size_mb is None and row_group_size_rows is None:
         row_group_size_mb = DEFAULT_ROW_GROUP_SIZE_MB
     for pcol in partition_by:
@@ -84,6 +93,17 @@ def write_dataset(url: str,
             raise SchemaError(f"partition_by field {pcol!r} must be scalar")
 
     fs, root = get_filesystem_and_path(url, storage_options, filesystem)
+    if mode != "append" and fs.get_file_info(root).type == pafs.FileType.Directory:
+        existing = [f.path for f in fs.get_file_info(
+                        pafs.FileSelector(root, recursive=True))
+                    if f.type == pafs.FileType.File and _is_data_file(f.path)]
+        if existing and mode == "error":
+            raise SchemaError(
+                f"Dataset path {url!r} already contains {len(existing)} data"
+                " file(s); pass mode='overwrite' to replace or mode='append'"
+                " to add to it")
+        if existing:
+            fs.delete_dir_contents(root)
     fs.create_dir(root, recursive=True)
 
     storage = schema.as_arrow_schema()

@@ -1,0 +1,4 @@
+"""Operator CLIs (L7): dataset copy, metadata regeneration.
+
+Reference parity: petastorm/tools/ and the console scripts in setup.py:90-96.
+"""

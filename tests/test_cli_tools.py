@@ -1,0 +1,200 @@
+"""L7 tests: benchmark harness, copy-dataset / generate-metadata CLIs, reader mock.
+
+Reference parity: tests/test_benchmark.py (smoke), tests around
+petastorm_generate_metadata, tests/test_copy_dataset.py behavior.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.benchmark.cli import main as throughput_main
+from petastorm_tpu.benchmark.dummy_reader import loader_microbench
+from petastorm_tpu.benchmark.throughput import (jax_loader_throughput,
+                                                reader_throughput)
+from petastorm_tpu.codecs import NdarrayCodec
+from petastorm_tpu.etl.generate_metadata import main as genmeta_main
+from petastorm_tpu.etl.metadata import open_dataset
+from petastorm_tpu.reader import make_batch_reader, make_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.test_util.reader_mock import ReaderMock
+from petastorm_tpu.test_util.synthetic import create_test_dataset
+from petastorm_tpu.tools.copy_dataset import copy_dataset
+from petastorm_tpu.tools.copy_dataset import main as copy_main
+
+SMALL_SCHEMA = Schema("Small", [
+    Field("id", np.int64),
+    Field("value", np.float32, (3,), NdarrayCodec()),
+    Field("opt", np.float64, nullable=True),
+])
+
+
+def _small_rows(n):
+    rng = np.random.default_rng(7)
+    return [{"id": i, "value": rng.standard_normal(3).astype(np.float32),
+             "opt": None if i % 3 == 0 else float(i)} for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def small_ds(tmp_path_factory):
+    from petastorm_tpu.etl.writer import write_dataset
+    url = str(tmp_path_factory.mktemp("cli") / "small")
+    rows = _small_rows(30)
+    write_dataset(url, SMALL_SCHEMA, rows, row_group_size_rows=5)
+    return url, rows
+
+
+def test_reader_throughput_row(small_ds):
+    url, _ = small_ds
+    res = reader_throughput(url, warmup_cycles=5, measure_cycles=20,
+                            workers_count=2)
+    assert res.samples == 20
+    assert res.samples_per_sec > 0
+    assert res.rss_mb > 0
+
+
+def test_reader_throughput_batch(small_ds):
+    url, _ = small_ds
+    res = reader_throughput(url, warmup_cycles=1, measure_cycles=4,
+                            read_method="batch", workers_count=2)
+    assert res.samples >= 4  # rows, counted per columnar batch
+    assert res.samples_per_sec > 0
+
+
+def test_jax_loader_throughput(small_ds):
+    url, _ = small_ds
+    res = jax_loader_throughput(url, batch_size=8, warmup_batches=1,
+                                measure_batches=3, workers_count=2,
+                                field_regex=["id", "value"])
+    assert res.samples == 3 * 8
+    assert res.samples_per_sec > 0
+
+
+def test_throughput_cli_json(small_ds, capsys):
+    url, _ = small_ds
+    rc = throughput_main([url, "-n", "2", "-m", "10", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["samples"] == 10
+
+
+def test_loader_microbench_smoke():
+    results = loader_microbench(batch_sizes=(8,), warmup_batches=1,
+                                measure_batches=3,
+                                kinds=("torch", "torch_batched", "jax"))
+    assert len(results) == 3
+    assert all(r["samples_per_sec"] > 0 for r in results)
+
+
+# -- copy-dataset -------------------------------------------------------------
+
+def test_copy_dataset_full(small_ds, tmp_path):
+    url, rows = small_ds
+    target = str(tmp_path / "copy")
+    n = copy_dataset(url, target)
+    assert n == len(rows)
+    with make_reader(target, shuffle_row_groups=False) as r:
+        got = sorted(row.id for row in r)
+    assert got == [row["id"] for row in rows]
+
+
+def test_copy_dataset_field_subset(small_ds, tmp_path):
+    url, _ = small_ds
+    target = str(tmp_path / "subset")
+    copy_dataset(url, target, field_regex=["id"])
+    info = open_dataset(target, require_stored_schema=True)
+    from petastorm_tpu.etl.metadata import infer_or_load_schema
+    assert [f.name for f in infer_or_load_schema(info)] == ["id"]
+
+
+def test_copy_dataset_not_null(small_ds, tmp_path):
+    url, rows = small_ds
+    target = str(tmp_path / "notnull")
+    n = copy_dataset(url, target, not_null_fields=["opt"])
+    expected = [r for r in rows if r["opt"] is not None]
+    assert n == len(expected)
+    with make_reader(target, shuffle_row_groups=False) as r:
+        assert all(row.opt is not None for row in r)
+
+
+def test_copy_dataset_overwrite_guard(small_ds, tmp_path):
+    url, _ = small_ds
+    target = str(tmp_path / "guard")
+    copy_dataset(url, target)
+    with pytest.raises(ValueError, match="not empty"):
+        copy_dataset(url, target)
+    # --overwrite replaces
+    n = copy_dataset(url, target, overwrite_output=True)
+    assert n == 30
+
+
+def test_copy_dataset_cli(small_ds, tmp_path, capsys):
+    url, _ = small_ds
+    target = str(tmp_path / "cli_copy")
+    rc = copy_main([url, target, "--field-regex", "id", "value"])
+    assert rc == 0
+    assert "copied 30 rows" in capsys.readouterr().out
+
+
+# -- generate-metadata --------------------------------------------------------
+
+def test_generate_metadata_restores_deleted(small_ds, tmp_path):
+    url, rows = small_ds
+    target = str(tmp_path / "regen")
+    copy_dataset(url, target)
+    meta = os.path.join(target, "_common_metadata")
+    os.remove(meta)
+    # schema travels inside the data files, so regeneration needs no args
+    rc = genmeta_main([target])
+    assert rc == 0
+    assert os.path.exists(meta)
+    with make_reader(target, shuffle_row_groups=False) as r:
+        assert sorted(row.id for row in r) == [row["id"] for row in rows]
+
+
+def test_generate_metadata_infer_plain_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    pq.write_table(pa.table({"x": [1, 2, 3], "y": [0.5, 1.5, 2.5]}),
+                   plain / "data.parquet")
+    rc = genmeta_main([str(plain), "--infer"])
+    assert rc == 0
+    with make_batch_reader(str(plain), shuffle_row_groups=False) as r:
+        batch = next(iter(r))
+        assert list(batch.x) == [1, 2, 3]
+
+
+def test_generate_metadata_schema_from(small_ds, tmp_path):
+    import pyarrow.parquet as pq
+    url, _ = small_ds
+    # a bare-file copy (no metadata at all): borrow schema from the original
+    import pyarrow.fs as pafs
+    import shutil
+    target = tmp_path / "borrowed"
+    target.mkdir()
+    for f in os.listdir(url):
+        if f.endswith(".parquet"):
+            shutil.copy(os.path.join(url, f), target / f)
+    rc = genmeta_main([str(target), "--schema-from", url])
+    assert rc == 0
+    with make_reader(str(target), shuffle_row_groups=False) as r:
+        assert len(list(r)) == 30
+
+
+# -- reader mock --------------------------------------------------------------
+
+def test_reader_mock_rows_and_batches():
+    mock = ReaderMock(SMALL_SCHEMA.view(["id", "value"]), batch_size=4,
+                      num_batches=3)
+    rows = list(mock)
+    assert len(rows) == 12
+    assert rows[0].value.shape == (3,)
+    assert mock.last_row_consumed
+    mock.reset()
+    batches = list(mock.iter_batches())
+    assert len(batches) == 3
+    assert batches[0].num_rows == 4

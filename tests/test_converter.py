@@ -171,3 +171,51 @@ def test_make_tf_dataset(tmp_path):
         assert cm._reader._stopped  # reader released on exit
     finally:
         conv.delete()
+
+
+def test_slices_get_distinct_fingerprints(tmp_path):
+    """Zero-copy slices share buffers; the fingerprint must still distinguish
+    them (regression: slice(0,50) and slice(50,50) collided, returning the
+    wrong cached dataset)."""
+    t = pa.table({"x": np.arange(100, dtype=np.int64)})
+    c1 = make_converter(t.slice(0, 50), str(tmp_path), dtype=None)
+    c2 = make_converter(t.slice(50, 50), str(tmp_path), dtype=None)
+    c3 = make_converter(t, str(tmp_path), dtype=None)
+    assert len({c1.cache_url, c2.cache_url, c3.cache_url}) == 3
+    with c2.make_reader(shuffle_row_groups=False) as r:
+        assert sorted(row.x for row in r) == list(range(50, 100))
+
+
+def test_dedup_persistence_wins(tmp_path):
+    """A later delete_at_exit=False on the same content un-registers cleanup."""
+    conv1 = make_converter(_df(), str(tmp_path))
+    assert conv1 in _registered_converters
+    conv2 = make_converter(_df(), str(tmp_path), delete_at_exit=False)
+    assert conv2 is conv1
+    assert conv1 not in _registered_converters
+    assert not conv1._owns_cache
+    # asking to delete again warns but keeps the persistent choice
+    with pytest.warns(UserWarning, match="delete_at_exit=False"):
+        make_converter(_df(), str(tmp_path), delete_at_exit=True)
+    assert conv1 not in _registered_converters
+
+
+def test_explicit_snappy_reuses_default_cache(tmp_path):
+    c1 = make_converter(_df(), str(tmp_path))
+    c2 = make_converter(_df(), str(tmp_path), compression_codec="snappy")
+    assert c2 is c1
+
+
+def test_loader_factory_failure_does_not_leak_reader(tmp_path):
+    import threading
+
+    conv = make_converter(_df(), str(tmp_path))
+    before = threading.active_count()
+    with pytest.raises(Exception):
+        conv.make_jax_loader(batch_size=0)
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        import time
+        time.sleep(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before

@@ -252,3 +252,27 @@ def test_sanitize_bool_exact():
         sanitize_value(2, np.dtype("bool"))
     with pytest.raises(SchemaError):
         sanitize_value(2 ** 70, np.dtype("int64"))
+
+
+def test_write_dataset_mode_guard(tmp_path):
+    """Writing into a non-empty dataset dir errors by default; overwrite and
+    append are explicit (regression: silent append mixed old+new rows)."""
+    import pytest
+
+    from petastorm_tpu.errors import SchemaError
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    schema = Schema("ModeGuard", [Field("id", np.int64)])
+    url = str(tmp_path / "ds")
+    write_dataset(url, schema, [{"id": i} for i in range(5)])
+    with pytest.raises(SchemaError, match="already contains"):
+        write_dataset(url, schema, [{"id": 99}])
+    write_dataset(url, schema, [{"id": i} for i in range(10, 15)],
+                  mode="overwrite")
+    with make_reader(url, shuffle_row_groups=False) as r:
+        assert sorted(row.id for row in r) == list(range(10, 15))
+    write_dataset(url, schema, [{"id": 20}], mode="append")
+    with make_reader(url, shuffle_row_groups=False) as r:
+        assert sorted(row.id for row in r) == [10, 11, 12, 13, 14, 20]
