@@ -54,7 +54,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             warmup_batches=max(args.warmup_cycles // 25, 2),
             measure_batches=max(args.measure_cycles // 25, 8),
             pool_type=args.pool_type, workers_count=args.workers_count,
-            field_regex=args.field_regex)
+            field_regex=args.field_regex,
+            shuffle_row_groups=not args.no_shuffle)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(

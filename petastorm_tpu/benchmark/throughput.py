@@ -119,6 +119,7 @@ def jax_loader_throughput(dataset_url: str,
                           pool_type: str = "thread",
                           workers_count: int = 3,
                           field_regex: Optional[Sequence[str]] = None,
+                          shuffle_row_groups: bool = True,
                           storage_options: Optional[dict] = None) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
@@ -135,8 +136,16 @@ def jax_loader_throughput(dataset_url: str,
     reader = make_batch_reader(
         dataset_url, schema_fields=list(field_regex) if field_regex else None,
         reader_pool_type=pool_type, workers_count=workers_count,
+        shuffle_row_groups=shuffle_row_groups,
         num_epochs=None, storage_options=storage_options)
-    with JaxDataLoader(reader, batch_size=batch_size) as loader:
+    try:
+        loader = JaxDataLoader(reader, batch_size=batch_size)
+    except Exception:
+        # the reader's executor threads would poll forever otherwise
+        reader.stop()
+        reader.join()
+        raise
+    with loader:
         it = iter(loader)
 
         def consume(n_batches: int) -> int:

@@ -1,0 +1,198 @@
+"""Native (C++) runtime components.
+
+``SharedArena`` is a process-shared memory allocator used as the data plane of
+the process executor - the TPU-host replacement for the reference's ZeroMQ
+transport (petastorm/workers_pool/process_pool.py:52-74).  Workers copy column
+payloads into the arena once; the consumer wraps them as numpy arrays with zero
+additional copies and frees the block when the arrays are garbage collected.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+from multiprocessing import resource_tracker, shared_memory
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        from petastorm_tpu.native.build import build
+
+        path = build()
+        if path is None:
+            _lib_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.psa_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.psa_init.restype = ctypes.c_int
+        lib.psa_check.argtypes = [ctypes.c_void_p]
+        lib.psa_check.restype = ctypes.c_int
+        lib.psa_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.psa_alloc.restype = ctypes.c_int64
+        lib.psa_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.psa_free.restype = ctypes.c_int
+        lib.psa_free_bytes.argtypes = [ctypes.c_void_p]
+        lib.psa_free_bytes.restype = ctypes.c_uint64
+        lib.psa_largest_free.argtypes = [ctypes.c_void_p]
+        lib.psa_largest_free.restype = ctypes.c_uint64
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    """True if the native library is (or can be) built on this machine."""
+    import sys
+
+    if sys.version_info < (3, 12):
+        # zero-copy leases rely on the PEP 688 buffer protocol (__buffer__),
+        # which np.frombuffer only honors from 3.12
+        return False
+    return _load_lib() is not None
+
+
+class SharedArena:
+    """One shared-memory segment + the C allocator over it.
+
+    The creator (consumer process) calls ``SharedArena.create``; workers attach
+    by name with ``SharedArena.attach``.  Python's SharedMemory handles segment
+    lifetime; the C library handles allocation inside it.
+    """
+
+    def __init__(self, shm: shared_memory.SharedMemory, owner: bool):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native shm_arena library unavailable")
+        self._lib = lib
+        self._shm = shm
+        self._owner = owner
+        self._closed = False    # allocation disabled (close requested)
+        self._unmapped = False  # segment actually unmapped
+        self._base = ctypes.addressof(ctypes.c_char.from_buffer(shm.buf))
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @classmethod
+    def create(cls, size_bytes: int, name: Optional[str] = None) -> "SharedArena":
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size_bytes)
+        arena = cls(shm, owner=True)
+        rc = arena._lib.psa_init(arena._base, shm.size)
+        if rc != 0:
+            shm.close()
+            shm.unlink()
+            raise RuntimeError(f"psa_init failed: {rc}")
+        return arena
+
+    @classmethod
+    def attach(cls, name: str) -> "SharedArena":
+        # python<3.13 registers even *attached* segments with the resource
+        # tracker, which would unlink the creator's segment when this process
+        # exits (and sending unregister instead races other attachers into
+        # KeyErrors inside the shared tracker).  Suppress the registration
+        # during the constructor call - the creator's own registration is the
+        # only one that should exist.
+        orig_register = resource_tracker.register
+
+        def _no_shm_register(rname, rtype):
+            if rtype != "shared_memory":
+                orig_register(rname, rtype)
+
+        resource_tracker.register = _no_shm_register
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=False)
+        finally:
+            resource_tracker.register = orig_register
+        arena = cls(shm, owner=False)
+        if not arena._lib.psa_check(arena._base):
+            raise RuntimeError(f"shared arena {name!r} is not initialized")
+        return arena
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    @property
+    def size(self) -> int:
+        return self._shm.size
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Unmap (and unlink, if owner) the segment.  If zero-copy batch views
+        are still alive the close is deferred: allocation is disabled
+        immediately, and a later close()/__del__ retries the unmap."""
+        if self._unmapped:
+            return
+        # ctypes.from_buffer holds an export on shm.buf; drop it before close
+        self._base = None
+        self._closed = True  # no new allocs/frees; leases skip free from now on
+        import gc
+
+        gc.collect()
+        try:
+            self._shm.close()
+        except BufferError:
+            # numpy views into the segment are still alive somewhere; keep the
+            # mapping open and retry on the next close()/__del__
+            logger.debug("arena %s still has live views; deferring close",
+                         self._shm.name)
+            return
+        self._unmapped = True
+        if self._owner:
+            self._owner = False  # unlink exactly once
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def __del__(self):  # best-effort; explicit close() is the supported path
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- allocation -----------------------------------------------------------
+
+    def alloc(self, size: int) -> Optional[int]:
+        """Payload offset, or None when the arena is currently full."""
+        if self._closed:
+            raise RuntimeError("arena is closed")
+        off = self._lib.psa_alloc(self._base, size)
+        if off == -2:
+            raise RuntimeError("shared arena corrupted")
+        return None if off < 0 else int(off)
+
+    def free(self, offset: int) -> None:
+        if self._closed:  # teardown already reclaimed everything
+            return
+        rc = self._lib.psa_free(self._base, offset)
+        if rc != 0:
+            raise RuntimeError(f"psa_free({offset}) failed: {rc}")
+
+    def free_bytes(self) -> int:
+        if self._closed:
+            return 0
+        return int(self._lib.psa_free_bytes(self._base))
+
+    def largest_free(self) -> int:
+        if self._closed:
+            return 0
+        return int(self._lib.psa_largest_free(self._base))
+
+    def view(self, offset: int, size: int) -> memoryview:
+        """Writable view of a payload region (no ownership transfer)."""
+        if self._closed:
+            raise RuntimeError("arena is closed")
+        return self._shm.buf[offset:offset + size]

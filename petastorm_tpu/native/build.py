@@ -1,0 +1,54 @@
+"""On-demand native build: compiles shm_arena.cpp into a cached .so.
+
+No pip/pybind11 in this environment, so the binding is a plain C ABI loaded
+via ctypes; g++ is invoked directly the first time the library is needed and
+the result is cached next to the source, keyed by a source hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "shm_arena.cpp")
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+
+
+def _source_tag() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def lib_path() -> str:
+    return os.path.join(_LIB_DIR, f"libshm_arena-{_source_tag()}.so")
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile (if needed) and return the .so path, or None if no toolchain."""
+    path = lib_path()
+    if os.path.exists(path) and not force:
+        return path
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    # build to a temp name then rename: concurrent builders race benignly
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError:
+        logger.warning("g++ not found; native shm transport unavailable")
+        os.unlink(tmp)
+        return None
+    except subprocess.CalledProcessError as exc:
+        logger.warning("native build failed:\n%s", exc.stderr)
+        os.unlink(tmp)
+        return None
+    os.replace(tmp, path)
+    return path

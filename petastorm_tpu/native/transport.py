@@ -1,0 +1,164 @@
+"""ColumnBatch transport over the shared-memory arena.
+
+The process-pool data plane: workers encode each result batch into the arena
+(one copy, producer side); the consumer decodes by wrapping numpy arrays
+directly over shared memory (zero copies) and the block is freed automatically
+when the last array from the batch is garbage collected.
+
+Reference parity: the pluggable serializer + zmq multipart scheme
+(petastorm/workers_pool/process_pool.py:317-321,254-273 and
+reader_impl/arrow_table_serializer.py) - here the 'payload part' is a shm
+block and the 'control part' is a small picklable descriptor.
+
+Fallbacks keep the executor correct without the fast path: object-dtype
+columns (strings, variable-shape rows) and batches that cannot fit the arena
+travel inside the descriptor via the queue's normal pickling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.native import SharedArena
+
+logger = logging.getLogger(__name__)
+
+_ALIGN = 64
+_ALLOC_RETRY_S = 0.01
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+@dataclasses.dataclass
+class ShmBatchRef:
+    """Queue-picklable descriptor of a batch whose raw columns live in shm."""
+    offset: int
+    total_bytes: int
+    num_rows: int
+    #: name -> ("shm", dtype_str, shape, rel_offset) | ("inline", ndarray/list)
+    columns: Dict[str, Tuple]
+
+
+class _Lease:
+    """Owns one arena block; numpy arrays built over it keep it alive (PEP 688
+    buffer protocol) and the block is freed when the last array dies."""
+
+    def __init__(self, arena: SharedArena, offset: int, size: int):
+        self._arena = arena
+        self._offset = offset
+        self._mv = arena.view(offset, size)
+
+    def __buffer__(self, flags):
+        return memoryview(self._mv)
+
+    def __del__(self):
+        try:
+            self._mv.release()
+            if not self._arena._closed:  # noqa: SLF001 - arena teardown races gc
+                self._arena.free(self._offset)
+        except Exception:  # noqa: BLE001 - never raise from gc
+            pass
+
+
+def encode_batch(arena: SharedArena, batch: Any,
+                 stop_check=None, max_wait_s: float = 10.0) -> Any:
+    """Encode a batch for the queue; raw columns go through the arena.
+
+    Returns a ShmBatchRef, or the original value when it is not a ColumnBatch
+    or nothing can use shm (the fallback keeps behavior identical, just
+    slower).  Blocks while the arena is full, up to ``max_wait_s`` (then falls
+    back to queue pickling so a stalled consumer can never deadlock workers);
+    ``stop_check()`` (optional) aborts the wait early.
+    """
+    if not isinstance(batch, ColumnBatch):
+        return batch
+    shm_cols = {}
+    meta: Dict[str, Tuple] = {}
+    total = 0
+    for name, col in batch.columns.items():
+        if isinstance(col, np.ndarray) and col.dtype != object and col.nbytes > 0:
+            col = np.ascontiguousarray(col)
+            meta[name] = ("shm", str(col.dtype), col.shape, total)
+            shm_cols[name] = col
+            total += _align(col.nbytes)
+        else:
+            meta[name] = ("inline", col)
+    if not shm_cols:
+        return batch
+    if total > arena.size // 2:
+        # a single batch this large would serialize the whole pipeline behind
+        # one block; ship it the slow way instead of deadlocking the arena
+        logger.warning("batch of %d bytes exceeds half the shm arena (%d);"
+                       " falling back to queue pickling", total, arena.size)
+        return batch
+
+    offset = arena.alloc(total)
+    deadline = time.monotonic() + max_wait_s
+    while offset is None:
+        if stop_check is not None and stop_check():
+            return batch
+        if time.monotonic() > deadline:
+            logger.warning("shm arena full for %.0fs; shipping batch via queue"
+                           " pickling", max_wait_s)
+            return batch
+        time.sleep(_ALLOC_RETRY_S)
+        offset = arena.alloc(total)
+
+    view = arena.view(offset, total)
+    for name, col in shm_cols.items():
+        _, _, _, rel = meta[name]
+        dst = np.frombuffer(view, dtype=col.dtype, count=col.size,
+                            offset=rel).reshape(col.shape)
+        np.copyto(dst, col)
+    del dst, view  # drop buffer exports so a later arena.close() can unmap
+    return ShmBatchRef(offset=offset, total_bytes=total, num_rows=batch.num_rows,
+                       columns=meta)
+
+
+def decode_batch(arena: SharedArena, ref: Any) -> Any:
+    """Rebuild a ColumnBatch; shm columns are zero-copy views into the arena.
+    Non-ShmBatchRef values (fallback batches, arbitrary worker results) pass
+    through unchanged."""
+    if not isinstance(ref, ShmBatchRef):
+        return ref
+    lease = _Lease(arena, ref.offset, ref.total_bytes)
+    cols: Dict[str, np.ndarray] = {}
+    for name, entry in ref.columns.items():
+        if entry[0] == "shm":
+            _, dtype_str, shape, rel = entry
+            dtype = np.dtype(dtype_str)
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            cols[name] = np.frombuffer(lease, dtype=dtype, count=count,
+                                       offset=rel).reshape(shape)
+        else:
+            cols[name] = entry[1]
+    return ColumnBatch(cols, ref.num_rows)
+
+
+class ShmResultEncoder:
+    """Worker-side wrapper: ``fn(item)`` results are arena-encoded.
+
+    Picklable (spawn): holds only the arena name and the inner factory; the
+    arena attach and library load happen lazily in the worker process.
+    """
+
+    def __init__(self, worker_factory, arena_name: str):
+        self._worker_factory = worker_factory
+        self._arena_name = arena_name
+
+    def __call__(self):
+        fn = self._worker_factory()
+        arena = SharedArena.attach(self._arena_name)
+
+        def wrapped(item):
+            return encode_batch(arena, fn(item))
+
+        return wrapped

@@ -259,9 +259,14 @@ class _ProcessExecutor(ExecutorBase):
 
     _STOP_SENTINEL_VALUE = "__petastorm_tpu_stop__"
 
+    #: default shared-memory arena size for the native data plane
+    DEFAULT_SHM_BYTES = 256 * 2**20
+
     def __init__(self, workers_count: int = 3,
                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
-                 in_queue_size: Optional[int] = None):
+                 in_queue_size: Optional[int] = None,
+                 use_shm: Optional[bool] = None,
+                 shm_size_bytes: int = DEFAULT_SHM_BYTES):
         super().__init__()
         import multiprocessing as mp
 
@@ -271,10 +276,23 @@ class _ProcessExecutor(ExecutorBase):
         self._out_queue = self._ctx.Queue(results_queue_size)
         self._stop_event = self._ctx.Event()
         self._procs = []
+        self._arena = None
+        self._shm_size_bytes = shm_size_bytes
+        if use_shm is None:  # auto: use the native transport when it builds
+            from petastorm_tpu.native import is_available
+
+            use_shm = is_available()
+        self._use_shm = use_shm
 
     def start(self, worker_factory: WorkerFactory) -> None:
         if self._procs:
             raise PetastormTpuError("Executor already started")
+        if self._use_shm:
+            from petastorm_tpu.native import SharedArena
+            from petastorm_tpu.native.transport import ShmResultEncoder
+
+            self._arena = SharedArena.create(self._shm_size_bytes)
+            worker_factory = ShmResultEncoder(worker_factory, self._arena.name)
         for i in range(self._workers_count):
             p = self._ctx.Process(
                 target=_process_worker_main,
@@ -312,6 +330,10 @@ class _ProcessExecutor(ExecutorBase):
         if isinstance(result, _Failure):
             self.stop()
             raise WorkerError(f"Worker failed:\n{result.formatted}")
+        if self._arena is not None:
+            from petastorm_tpu.native.transport import decode_batch
+
+            result = decode_batch(self._arena, result)
         self._consumed += 1
         return result
 
@@ -328,11 +350,19 @@ class _ProcessExecutor(ExecutorBase):
                 p.terminate()
         for q in (self._in_queue, self._out_queue):
             q.cancel_join_thread()
+        if self._arena is not None:
+            # consumer-side batches may still hold zero-copy views; close()
+            # defers the unmap until they are collected
+            self._arena.close()
 
     @property
     def diagnostics(self) -> dict:
-        return {**super().diagnostics, "workers_count": self._workers_count,
-                "workers_alive": sum(p.is_alive() for p in self._procs)}
+        diag = {**super().diagnostics, "workers_count": self._workers_count,
+                "workers_alive": sum(p.is_alive() for p in self._procs),
+                "shm_transport": self._arena is not None}
+        if self._arena is not None:
+            diag["shm_free_bytes"] = self._arena.free_bytes()
+        return diag
 
 
 def make_executor(kind: str = "thread", workers_count: int = 3,

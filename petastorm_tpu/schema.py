@@ -114,6 +114,14 @@ class Schema:
             self._fields[f.name] = f
         self._namedtuple = None
 
+    def __getstate__(self):
+        # the cached namedtuple type is created dynamically and cannot be
+        # pickled (process-pool workers receive schemas by pickle); rebuild
+        # it lazily on the other side
+        state = self.__dict__.copy()
+        state["_namedtuple"] = None
+        return state
+
     # -- basic access ---------------------------------------------------------
 
     @property

@@ -36,19 +36,6 @@ def copy_dataset(source_url: str,
     field is null (copy_dataset.py:51-54).
     """
     from petastorm_tpu.etl.writer import write_dataset
-    from petastorm_tpu.fs import get_filesystem_and_path
-
-    fs, root = get_filesystem_and_path(target_url, storage_options)
-    from pyarrow import fs as pafs
-    info = fs.get_file_info(root)
-    if info.type != pafs.FileType.NotFound:
-        existing = [f for f in fs.get_file_info(pafs.FileSelector(root))
-                    if f.type == pafs.FileType.File]
-        if existing and not overwrite_output:
-            raise ValueError(f"Target {target_url!r} is not empty; pass"
-                             " overwrite_output=True (--overwrite) to replace it")
-        if existing:
-            fs.delete_dir_contents(root)
 
     predicate = None
     if not_null_fields:
@@ -73,7 +60,8 @@ def copy_dataset(source_url: str,
         write_dataset(target_url, schema, rows(),
                       row_group_size_mb=row_group_size_mb,
                       rows_per_file=rows_per_file,
-                      storage_options=storage_options)
+                      storage_options=storage_options,
+                      mode="overwrite" if overwrite_output else "error")
     logger.info("Copied %d rows from %s to %s", count, source_url, target_url)
     return count
 

@@ -120,10 +120,11 @@ def test_copy_dataset_not_null(small_ds, tmp_path):
 
 
 def test_copy_dataset_overwrite_guard(small_ds, tmp_path):
+    from petastorm_tpu.errors import SchemaError
     url, _ = small_ds
     target = str(tmp_path / "guard")
     copy_dataset(url, target)
-    with pytest.raises(ValueError, match="not empty"):
+    with pytest.raises(SchemaError, match="already contains"):
         copy_dataset(url, target)
     # --overwrite replaces
     n = copy_dataset(url, target, overwrite_output=True)

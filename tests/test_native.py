@@ -1,0 +1,201 @@
+"""Native shared-memory transport tests: allocator, transport, process pool.
+
+Reference parity: workers_pool/tests/test_workers_pool.py exercises the zmq
+data plane in both copy modes; here the native arena replaces zmq.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.batch import ColumnBatch
+
+native = pytest.importorskip("petastorm_tpu.native")
+if not native.is_available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+from petastorm_tpu.native import SharedArena  # noqa: E402
+from petastorm_tpu.native.transport import (ShmBatchRef, decode_batch,  # noqa: E402
+                                            encode_batch)
+
+
+@pytest.fixture()
+def arena():
+    a = SharedArena.create(4 * 2**20)
+    yield a
+    a.close()
+
+
+# -- allocator ----------------------------------------------------------------
+
+def test_alloc_free_roundtrip(arena):
+    free0 = arena.free_bytes()
+    off = arena.alloc(1000)
+    assert off is not None and off % 64 == 0
+    assert arena.free_bytes() < free0
+    arena.free(off)
+    assert arena.free_bytes() == free0
+
+
+def test_out_of_order_free_coalesces(arena):
+    free0 = arena.free_bytes()
+    offs = [arena.alloc(100_000) for _ in range(8)]
+    assert all(o is not None for o in offs)
+    # free in scrambled order; afterwards the arena must be one block again
+    for i in (3, 0, 7, 1, 5, 2, 6, 4):
+        arena.free(offs[i])
+    assert arena.free_bytes() == free0
+    assert arena.largest_free() == free0
+
+
+def test_alloc_exhaustion_returns_none(arena):
+    off = arena.alloc(arena.size * 2)
+    assert off is None
+    # fill, then fail, then free and succeed
+    big = arena.alloc(arena.largest_free())
+    assert big is not None
+    assert arena.alloc(1024) is None
+    arena.free(big)
+    assert arena.alloc(1024) is not None
+
+
+def test_double_free_rejected(arena):
+    off = arena.alloc(64)
+    arena.free(off)
+    with pytest.raises(RuntimeError):
+        arena.free(off)
+
+
+def test_attach_shares_state(arena):
+    other = SharedArena.attach(arena.name)
+    off = other.alloc(4096)
+    assert off is not None
+    view = other.view(off, 4096)
+    view[:5] = b"hello"
+    del view
+    assert bytes(arena.view(off, 5)) == b"hello"
+    arena.free(off)
+    other.close()
+
+
+# -- transport ----------------------------------------------------------------
+
+def _batch(n=10):
+    rng = np.random.default_rng(0)
+    return ColumnBatch({
+        "x": rng.standard_normal((n, 4)).astype(np.float32),
+        "i": np.arange(n, dtype=np.int64),
+        "s": np.asarray([f"row{k}" for k in range(n)], dtype=object),
+    }, n)
+
+
+def test_encode_decode_roundtrip(arena):
+    src = _batch()
+    ref = encode_batch(arena, src)
+    assert isinstance(ref, ShmBatchRef)
+    assert ref.columns["s"][0] == "inline"  # object dtype falls back
+    out = decode_batch(arena, ref)
+    np.testing.assert_array_equal(out.columns["x"], src.columns["x"])
+    np.testing.assert_array_equal(out.columns["i"], src.columns["i"])
+    assert list(out.columns["s"]) == list(src.columns["s"])
+
+
+def test_decode_is_zero_copy_and_frees_on_gc(arena):
+    free0 = arena.free_bytes()
+    out = decode_batch(arena, encode_batch(arena, _batch()))
+    assert arena.free_bytes() < free0          # block held by the live batch
+    base = out.columns["x"].base
+    while base is not None and not hasattr(base, "_arena"):
+        base = getattr(base, "base", None) or getattr(base, "obj", None)
+    assert base is not None                    # arrays really view the arena
+    del out, base
+    import gc
+    gc.collect()
+    assert arena.free_bytes() == free0         # lease freed the block
+
+
+def test_oversized_batch_falls_back(arena):
+    n = arena.size // 8  # one float64 column > size/2
+    big = ColumnBatch({"x": np.zeros(n, dtype=np.float64)}, n)
+    ref = encode_batch(arena, big)
+    assert isinstance(ref, ColumnBatch)        # shipped by pickling, not shm
+
+
+def test_full_arena_times_out_to_fallback(arena):
+    hold = arena.alloc(arena.largest_free())   # wedge the arena full
+    ref = encode_batch(arena, _batch(), max_wait_s=0.2)
+    assert isinstance(ref, ColumnBatch)
+    arena.free(hold)
+
+
+def test_non_batch_results_pass_through(arena):
+    assert encode_batch(arena, 42) == 42
+    assert decode_batch(arena, "anything") == "anything"
+
+
+# -- process executor over shm ------------------------------------------------
+
+def test_process_executor_shm_end_to_end(tmp_path):
+    """Full reader path over the process pool with the native data plane."""
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    url = str(tmp_path / "ds")
+    schema = Schema("Shm", [Field("id", np.int64),
+                            Field("vec", np.float32, (8,))])
+    rng = np.random.default_rng(5)
+    rows = [{"id": i, "vec": rng.standard_normal(8).astype(np.float32)}
+            for i in range(64)]
+    write_dataset(url, schema, rows, row_group_size_rows=8)
+
+    with make_reader(url, reader_pool_type="process", workers_count=2,
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        assert reader.diagnostics.get("shm_transport") is True
+        got = sorted(row.id for row in reader)
+    assert got == list(range(64))
+
+
+def test_process_executor_shm_disabled_still_works():
+    from petastorm_tpu.pool import _ProcessExecutor
+    from petastorm_tpu.test_util.stub_workers import MultiplierWorker
+
+    ex = _ProcessExecutor(workers_count=1, use_shm=False)
+    try:
+        ex.start(MultiplierWorker(3))
+        ex.put(7)
+        assert ex.get(timeout=30) == 21
+        assert ex.diagnostics["shm_transport"] is False
+    finally:
+        ex.stop()
+        ex.join()
+
+
+def test_diagnostics_safe_after_join():
+    """Regression: free_bytes() on a closed arena dereferenced NULL (SIGSEGV)."""
+    from petastorm_tpu.pool import _ProcessExecutor
+    from petastorm_tpu.test_util.stub_workers import MultiplierWorker
+
+    ex = _ProcessExecutor(workers_count=1, use_shm=True)
+    ex.start(MultiplierWorker(2))
+    ex.put(3)
+    assert ex.get(timeout=30) == 6
+    ex.stop()
+    ex.join()
+    diag = ex.diagnostics
+    assert diag["shm_transport"] is True
+    assert diag["shm_free_bytes"] == 0  # closed arena reports 0, not a crash
+
+
+def test_arena_close_deferred_then_retried():
+    """close() with live views defers; a later close() retries the unmap."""
+    arena = SharedArena.create(2**20)
+    out = decode_batch(arena, encode_batch(arena, _batch()))
+    arena.close()
+    assert arena.closed
+    with pytest.raises(RuntimeError):
+        arena.alloc(64)
+    del out
+    import gc
+    gc.collect()
+    arena.close()  # second attempt actually unmaps now
+    assert arena._unmapped
