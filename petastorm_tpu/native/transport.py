@@ -85,7 +85,8 @@ def encode_batch(arena: SharedArena, batch: Any,
     total = 0
     for name, col in batch.columns.items():
         if isinstance(col, np.ndarray) and col.dtype != object and col.nbytes > 0:
-            col = np.ascontiguousarray(col)
+            # np.copyto below handles strided sources directly - no
+            # ascontiguousarray (that would be a second full copy)
             meta[name] = ("shm", str(col.dtype), col.shape, total)
             shm_cols[name] = col
             total += _align(col.nbytes)
@@ -143,6 +144,23 @@ def decode_batch(arena: SharedArena, ref: Any) -> Any:
     return ColumnBatch(cols, ref.num_rows)
 
 
+class _ShmEncodingFn:
+    """The worker's process function; ``stop_event`` is bound by the worker
+    main loop so a shutdown aborts any wait on a full arena immediately."""
+
+    def __init__(self, fn, arena: SharedArena):
+        self._fn = fn
+        self._arena = arena
+        self.stop_event = None  # bound by _process_worker_main when available
+
+    def _stopped(self) -> bool:
+        return self.stop_event is not None and self.stop_event.is_set()
+
+    def __call__(self, item):
+        return encode_batch(self._arena, self._fn(item),
+                            stop_check=self._stopped)
+
+
 class ShmResultEncoder:
     """Worker-side wrapper: ``fn(item)`` results are arena-encoded.
 
@@ -155,10 +173,5 @@ class ShmResultEncoder:
         self._arena_name = arena_name
 
     def __call__(self):
-        fn = self._worker_factory()
-        arena = SharedArena.attach(self._arena_name)
-
-        def wrapped(item):
-            return encode_batch(arena, fn(item))
-
-        return wrapped
+        return _ShmEncodingFn(self._worker_factory(),
+                              SharedArena.attach(self._arena_name))

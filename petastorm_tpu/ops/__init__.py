@@ -9,5 +9,7 @@ normalization happens on-chip"), keeping the host->device transfer at 1 byte/pix
 """
 
 from petastorm_tpu.ops.normalize import normalize_images
+from petastorm_tpu.ops.ring_attention import (ring_attention,
+                                              ring_attention_sharded)
 
-__all__ = ["normalize_images"]
+__all__ = ["normalize_images", "ring_attention", "ring_attention_sharded"]

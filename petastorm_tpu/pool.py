@@ -233,6 +233,8 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event):
     except BaseException as exc:  # noqa: BLE001
         out_queue.put(_Failure(exc))
         return
+    if hasattr(fn, "stop_event"):  # shm encoder: abort full-arena waits on stop
+        fn.stop_event = stop_event
     while not stop_event.is_set():
         try:
             item = in_queue.get(timeout=_POLL_S)
