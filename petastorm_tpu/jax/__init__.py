@@ -6,6 +6,11 @@ global ``jax.Array``s with a caller-chosen ``NamedSharding``, with host-side
 shuffle/batch/pad and a device-transfer prefetch queue in between.
 """
 
+from petastorm_tpu.jax.checkpoint import (make_checkpoint_manager,
+                                          restore_checkpoint,
+                                          resume_reader_kwargs,
+                                          save_checkpoint)
 from petastorm_tpu.jax.loader import JaxDataLoader, make_jax_loader
 
-__all__ = ["JaxDataLoader", "make_jax_loader"]
+__all__ = ["JaxDataLoader", "make_jax_loader", "make_checkpoint_manager",
+           "save_checkpoint", "restore_checkpoint", "resume_reader_kwargs"]

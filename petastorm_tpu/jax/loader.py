@@ -137,6 +137,7 @@ class JaxDataLoader:
         self._started = False
         self._finished = False
         self._failure: Optional[BaseException] = None
+        self._delivered_batches = 0
         #: per-(field, trailing-shape) cache of (sharding, local slice) - static
         #: for the loader's lifetime, rebuilt per batch otherwise
         self._placement_cache: Dict[Tuple[str, Tuple[int, ...]],
@@ -319,7 +320,27 @@ class JaxDataLoader:
         if isinstance(value, _Error):
             self._failure = value.exc
             raise value.exc
+        self._delivered_batches += 1
         return value
+
+    # -- checkpoint/resume (reference gap: SURVEY.md section 5) ---------------
+
+    def state_dict(self) -> Dict:
+        """Data-position cursor to pair with a training checkpoint.
+
+        ``reader`` is the underlying work-item cursor (pass back via
+        ``make_reader(..., resume_from=...)`` / ``resume_reader_kwargs``);
+        ``delivered_batches`` counts device batches handed to the consumer.
+        Mid-epoch the reader cursor can run ahead of deliveries by the
+        in-flight window (see petastorm_tpu.jax.checkpoint module docs).
+        """
+        if not hasattr(self._reader, "state_dict"):
+            raise PetastormTpuError(
+                f"Reader {type(self._reader).__name__} does not support"
+                " state_dict(); checkpoint/resume needs a petastorm_tpu Reader")
+        return {"reader": self._reader.state_dict(),
+                "delivered_batches": self._delivered_batches,
+                "global_batch": self._global_batch}
 
     # -- lifecycle ------------------------------------------------------------
 
