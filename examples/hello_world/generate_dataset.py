@@ -1,0 +1,45 @@
+"""Generate the hello-world dataset: id + PNG image + variable 4-D array.
+
+Reference parity: examples/hello_world/petastorm_dataset/
+generate_petastorm_dataset.py (HelloWorldSchema, 10 rows) - but Spark-free:
+``write_dataset`` encodes and stamps metadata directly through pyarrow.
+"""
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.schema import Field, Schema
+
+HelloWorldSchema = Schema("HelloWorld", [
+    Field("id", np.int32, (), ScalarCodec()),
+    Field("image1", np.uint8, (128, 256, 3), CompressedImageCodec("png")),
+    Field("array_4d", np.uint8, (None, 128, 30, None), NdarrayCodec()),
+])
+
+
+def row_generator(i: int, rng: np.random.Generator) -> dict:
+    return {
+        "id": i,
+        "image1": rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
+        "array_4d": rng.integers(0, 255, (4, 128, 30, 3), dtype=np.uint8),
+    }
+
+
+def generate_hello_world_dataset(output_url: str, rows_count: int = 10,
+                                 seed: int = 1) -> None:
+    rng = np.random.default_rng(seed)
+    write_dataset(output_url, HelloWorldSchema,
+                  (row_generator(i, rng) for i in range(rows_count)),
+                  row_group_size_mb=256, mode="overwrite")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("output_url", nargs="?", default="/tmp/hello_world_dataset")
+    parser.add_argument("--rows", type=int, default=10)
+    args = parser.parse_args()
+    generate_hello_world_dataset(args.output_url, args.rows)
+    print(f"wrote {args.rows} rows to {args.output_url}")

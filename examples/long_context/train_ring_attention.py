@@ -1,0 +1,94 @@
+"""Long-context training with context parallelism: the loader delivers
+sequence-sharded token batches (P('data', 'seq')) and ring attention consumes
+them without any device ever holding the full sequence.
+
+No reference analog exists (SURVEY.md section 2.14: petastorm has no sequence
+parallelism); this is the TPU-build's long-context feed contract end-to-end.
+Run on a pod with the seq axis sized to your context length; defaults are
+smoke-test sized (works on the virtual CPU mesh too:
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8).
+"""
+
+import argparse
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.jax import JaxDataLoader
+from petastorm_tpu.ops import ring_attention
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.schema import Field, Schema
+
+
+def generate_dataset(url: str, rows: int, seq_len: int, vocab: int,
+                     seed: int = 0) -> None:
+    rng = np.random.default_rng(seed)
+    schema = Schema("LongSeq", [Field("tokens", np.int32, (seq_len,))])
+    write_dataset(url, schema,
+                  ({"tokens": rng.integers(0, vocab, seq_len).astype(np.int32)}
+                   for _ in range(rows)),
+                  row_group_size_rows=max(rows // 4, 1), mode="overwrite")
+
+
+def train(dataset_url: str, steps: int, global_batch: int, seq_len: int,
+          vocab: int, heads: int = 4, head_dim: int = 16,
+          data_par: int = 2):
+    n_dev = len(jax.devices())
+    seq_par = max(n_dev // data_par, 1)
+    mesh = Mesh(np.asarray(jax.devices()[:data_par * seq_par])
+                .reshape(data_par, seq_par), ("data", "seq"))
+    d_model = heads * head_dim
+    k0 = jax.random.PRNGKey(0)
+    params = {
+        "embed": jax.random.normal(k0, (vocab, d_model), jnp.float32) * 0.02,
+        "out": jax.random.normal(k0, (d_model, vocab), jnp.float32) * 0.02,
+    }
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, tokens):
+        b, s = tokens.shape
+        x = p["embed"][tokens]
+        x = x.reshape(b, s, heads, head_dim).transpose(0, 2, 1, 3)
+        o = ring_attention(x, x, x, mesh=mesh, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d_model)
+        logits = o[:, :-1] @ p["out"]
+        targets = jax.nn.one_hot(tokens[:, 1:], vocab)
+        return -(jax.nn.log_softmax(logits) * targets).sum(-1).mean()
+
+    @jax.jit
+    def train_step(p, o, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    reader = make_reader(dataset_url, num_epochs=None)
+    losses = []
+    with mesh, JaxDataLoader(reader, batch_size=global_batch, mesh=mesh,
+                             shardings={"tokens": P("data", "seq")}) as loader:
+        it = iter(loader)
+        for _ in range(steps):
+            batch = next(it)
+            params, opt_state, loss = train_step(params, opt_state,
+                                                 batch["tokens"])
+            losses.append(float(loss))
+    print(f"mesh {dict(mesh.shape)}: loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+    return losses
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=64)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--vocab", type=int, default=256)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--global-batch", type=int, default=8)
+    args = parser.parse_args()
+    url = tempfile.mkdtemp(prefix="longctx_tpu_") + "/seqs"
+    generate_dataset(url, args.rows, args.seq_len, args.vocab)
+    train(url, args.steps, args.global_batch, args.seq_len, args.vocab)

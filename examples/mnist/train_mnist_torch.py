@@ -1,0 +1,56 @@
+"""MNIST-style training through the PyTorch delivery layer.
+
+Reference parity: examples/mnist/pytorch_example.py - kept for users migrating
+torch training loops; the JAX example (train_mnist_jax.py) is the TPU path.
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from petastorm_tpu.pytorch import BatchedDataLoader
+from petastorm_tpu.reader import make_reader
+
+
+def train(dataset_url: str, epochs: int = 1, batch_size: int = 32,
+          lr: float = 1e-3) -> float:
+    model = torch.nn.Sequential(
+        torch.nn.Flatten(), torch.nn.Linear(28 * 28, 128), torch.nn.ReLU(),
+        torch.nn.Linear(128, 10))
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    acc = 0.0
+    for epoch in range(epochs):
+        reader = make_reader(dataset_url, num_epochs=1,
+                             schema_fields=["image", "digit"],
+                             shuffle_seed=epoch)
+        accs = []
+        with BatchedDataLoader(reader, batch_size=batch_size,
+                               shuffling_queue_capacity=256) as loader:
+            for batch in loader:
+                x = batch["image"].float() / 255.0
+                y = batch["digit"]
+                opt.zero_grad()
+                logits = model(x)
+                loss = F.cross_entropy(logits, y)
+                loss.backward()
+                opt.step()
+                accs.append((logits.argmax(-1) == y).float().mean().item())
+        acc = float(np.mean(accs))
+        print(f"epoch {epoch}: acc {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    from examples.mnist.train_mnist_jax import generate_dataset
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-url", default=None)
+    parser.add_argument("--rows", type=int, default=2048)
+    parser.add_argument("--epochs", type=int, default=1)
+    args = parser.parse_args()
+    url = args.dataset_url or tempfile.mkdtemp(prefix="mnist_tpu_") + "/mnist"
+    generate_dataset(url, args.rows)
+    print(f"final train accuracy: {train(url, epochs=args.epochs):.3f}")

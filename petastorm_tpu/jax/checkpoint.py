@@ -8,11 +8,14 @@ cursor with the model/optimizer state inside ONE orbax checkpoint so training
 jobs resume both compute and data position together.
 
 Semantics inherited from the reader cursor (petastorm_tpu/reader.py docstring):
-exact at epoch boundaries; mid-epoch the cursor counts *completed* work items,
-which can run ahead of what the loader delivered by the in-flight window
-(executor queues + loader prefetch + shuffling buffer).  For strictly-no-skip
-resumption, checkpoint at epoch boundaries or use ``shuffling_queue_capacity=0``
-with small prefetch and accept the bounded skip.
+the cursor counts *completed* work items, which can run ahead of what the
+loader delivered by the in-flight window (executor queues + loader prefetch +
+shuffling buffer) - including across a delivered-epoch boundary when
+``num_epochs > 1`` (the reader prefetches into the next epoch).  The cursor is
+strictly exact only when the reader is fully exhausted (a completed
+``num_epochs=1`` run); everywhere else resume skips at most the in-flight
+window.  To bound that window tightly, use ``shuffling_queue_capacity=0``,
+``prefetch=1`` and a small results queue.
 """
 
 from __future__ import annotations
@@ -30,8 +33,14 @@ def make_checkpoint_manager(directory: str, max_to_keep: Optional[int] = 3,
                             **options_kwargs):
     """An ``orbax.checkpoint.CheckpointManager`` configured for composite
     (train-state + loader-state) checkpoints."""
+    import os
+
     import orbax.checkpoint as ocp
 
+    # orbax requires absolute paths but only errors later, mid-save (possibly
+    # async, after real training time); normalize up front instead
+    if "://" not in str(directory):
+        directory = os.path.abspath(directory)
     options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                            **options_kwargs)
     return ocp.CheckpointManager(directory, options=options)

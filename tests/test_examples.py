@@ -1,0 +1,64 @@
+"""Example smoke tests (reference: examples/mnist/tests, examples/imagenet/tests)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_hello_world_roundtrip(tmp_path, capsys):
+    from examples.hello_world.generate_dataset import generate_hello_world_dataset
+    from examples.hello_world.read_dataset import (columnar_hello_world,
+                                                   jax_hello_world,
+                                                   python_hello_world)
+
+    url = str(tmp_path / "hw")
+    generate_hello_world_dataset(url, rows_count=6)
+    python_hello_world(url)
+    columnar_hello_world(url)
+    jax_hello_world(url)
+    out = capsys.readouterr().out
+    assert out.count("row id=") == 6
+    assert "device batch: image1 (4, 128, 256, 3)" in out
+
+
+def test_mnist_jax_learns(tmp_path):
+    from examples.mnist.train_mnist_jax import generate_dataset, train
+
+    url = str(tmp_path / "mnist")
+    generate_dataset(url, rows=512)
+    acc = train(url, epochs=2, batch_size=64, shuffling_queue_capacity=128)
+    assert acc > 0.5  # synthetic digits are separable; random = 0.1
+
+
+def test_mnist_torch_smoke(tmp_path):
+    from examples.mnist.train_mnist_jax import generate_dataset
+    from examples.mnist.train_mnist_torch import train
+
+    url = str(tmp_path / "mnist_t")
+    generate_dataset(url, rows=256)
+    acc = train(url, epochs=1, batch_size=64)
+    assert acc > 0.2
+
+
+def test_imagenet_resnet_smoke(tmp_path):
+    from examples.imagenet.train_resnet_tpu import generate_dataset, train
+
+    url = str(tmp_path / "imagenet")
+    generate_dataset(url, rows=16, side=64)
+    rate = train(url, steps=2, global_batch=8, side=64, num_classes=10)
+    assert rate > 0
+
+
+def test_long_context_smoke(tmp_path):
+    from examples.long_context.train_ring_attention import (generate_dataset,
+                                                            train)
+
+    url = str(tmp_path / "seqs")
+    generate_dataset(url, rows=16, seq_len=32, vocab=64)
+    losses = train(url, steps=3, global_batch=4, seq_len=32, vocab=64,
+                   heads=2, head_dim=8, data_par=2)
+    assert all(np.isfinite(v) for v in losses)
