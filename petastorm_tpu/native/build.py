@@ -1,8 +1,8 @@
-"""On-demand native build: compiles shm_arena.cpp into a cached .so.
+"""On-demand native build: compiles the C++ sources into cached .so files.
 
-No pip/pybind11 in this environment, so the binding is a plain C ABI loaded
-via ctypes; g++ is invoked directly the first time the library is needed and
-the result is cached next to the source, keyed by a source hash.
+No pip/pybind11 in this environment, so bindings are a plain C ABI loaded via
+ctypes; g++ is invoked directly the first time a library is needed and the
+result is cached next to the source, keyed by a source hash.
 """
 
 from __future__ import annotations
@@ -16,22 +16,31 @@ from typing import Optional
 
 logger = logging.getLogger(__name__)
 
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "shm_arena.cpp")
-_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_DIR = os.path.join(_DIR, "_lib")
+
+#: name -> (source file, extra link flags)
+_LIBS = {
+    "shm_arena": ("shm_arena.cpp", []),
+    "image_decode": ("image_decode.cpp", ["-lpng16", "-ljpeg"]),
+}
 
 
-def _source_tag() -> str:
-    with open(_SRC, "rb") as f:
+def _source_tag(src: str) -> str:
+    with open(src, "rb") as f:
         return hashlib.sha256(f.read()).hexdigest()[:16]
 
 
-def lib_path() -> str:
-    return os.path.join(_LIB_DIR, f"libshm_arena-{_source_tag()}.so")
+def lib_path(name: str = "shm_arena") -> str:
+    src, _ = _LIBS[name]
+    return os.path.join(_LIB_DIR, f"lib{name}-{_source_tag(os.path.join(_DIR, src))}.so")
 
 
-def build(force: bool = False) -> Optional[str]:
+def build(name: str = "shm_arena", force: bool = False) -> Optional[str]:
     """Compile (if needed) and return the .so path, or None if no toolchain."""
-    path = lib_path()
+    src, link_flags = _LIBS[name]
+    src = os.path.join(_DIR, src)
+    path = lib_path(name)
     if os.path.exists(path) and not force:
         return path
     os.makedirs(_LIB_DIR, exist_ok=True)
@@ -39,15 +48,15 @@ def build(force: bool = False) -> Optional[str]:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
     os.close(fd)
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", tmp]
+           src, "-o", tmp] + link_flags
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except FileNotFoundError:
-        logger.warning("g++ not found; native shm transport unavailable")
+        logger.warning("g++ not found; native %s unavailable", name)
         os.unlink(tmp)
         return None
     except subprocess.CalledProcessError as exc:
-        logger.warning("native build failed:\n%s", exc.stderr)
+        logger.warning("native build of %s failed:\n%s", name, exc.stderr)
         os.unlink(tmp)
         return None
     os.replace(tmp, path)

@@ -1,0 +1,165 @@
+// Native batched PNG/JPEG decode for the hot ingest path.
+//
+// Why this exists: every Python-side decoder available here (cv2.imdecode,
+// PIL) holds the GIL for the whole decode, so the thread-pool ingest plane
+// serializes on image decode (the dominant cost of the reference's
+// CompressedImageCodec path, petastorm/codecs.py:92-101).  This shim decodes a
+// whole column of encoded cells in one C call — ctypes releases the GIL for
+// the call, and the batch can additionally fan out over an internal thread
+// pool — writing straight into a preallocated contiguous numpy buffer (the
+// exact layout ColumnBatch wants, no per-cell Python objects at all).
+//
+// C ABI only (no pybind11 in this image); loaded via ctypes (native/image.py).
+// Output is always interleaved row-major uint8, RGB channel order for 3-channel
+// images (stored streams are standard RGB files; reference parity with
+// petastorm/codecs.py:96-101).
+
+#include <atomic>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// PNG via the libpng 1.6 "simplified" API: handles bit-depth/palette/alpha
+// conversion to the requested format in one call.
+// ---------------------------------------------------------------------------
+int decode_png(const uint8_t* src, size_t len, uint8_t* out, int height,
+               int width, int channels) {
+  png_image image;
+  std::memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&image, src, len)) return -2;
+  if ((int)image.width != width || (int)image.height != height) {
+    png_image_free(&image);
+    return -3;
+  }
+  image.format = (channels == 3)   ? PNG_FORMAT_RGB
+                 : (channels == 1) ? PNG_FORMAT_GRAY
+                 : (channels == 4) ? PNG_FORMAT_RGBA
+                                   : 0;
+  if (image.format == 0 && channels != 1) {
+    png_image_free(&image);
+    return -4;
+  }
+  if (!png_image_finish_read(&image, nullptr, out,
+                             width * channels /* row_stride */, nullptr)) {
+    png_image_free(&image);
+    return -5;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// JPEG via libjpeg with setjmp error trap (libjpeg's error model).
+// ---------------------------------------------------------------------------
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jb, 1);
+}
+
+int decode_jpeg(const uint8_t* src, size_t len, uint8_t* out, int height,
+                int width, int channels) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(src), len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -3;
+  }
+  cinfo.out_color_space = (channels == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if ((int)cinfo.output_width != width || (int)cinfo.output_height != height ||
+      (int)cinfo.output_components != channels) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -4;
+  }
+  const size_t stride = (size_t)width * channels;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + (size_t)cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+int decode_one(const uint8_t* src, size_t len, uint8_t* out, int height,
+               int width, int channels) {
+  if (len >= 8 && src[0] == 0x89 && src[1] == 'P' && src[2] == 'N' &&
+      src[3] == 'G')
+    return decode_png(src, len, out, height, width, channels);
+  if (len >= 2 && src[0] == 0xFF && src[1] == 0xD8)
+    return decode_jpeg(src, len, out, height, width, channels);
+  return -1;  // unknown magic
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode n images into out (contiguous, one image every `stride` bytes).
+// srcs[i] = pointer to encoded stream i of length lens[i].  All images must
+// decode to exactly (height, width, channels) uint8.  nthreads <= 1 decodes
+// inline; otherwise an internal thread pool splits the batch.
+// Returns 0 on success, or (1 + index) of the first failing image.
+int pst_decode_image_batch(const uint8_t* const* srcs, const uint64_t* lens,
+                           int n, uint8_t* out, uint64_t stride, int height,
+                           int width, int channels, int nthreads) {
+  std::atomic<int> failed{0};  // 1 + index of first failure, 0 = ok
+  auto run = [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      if (failed.load(std::memory_order_relaxed)) return;
+      int rc = decode_one(srcs[i], (size_t)lens[i], out + (uint64_t)i * stride,
+                          height, width, channels);
+      if (rc != 0) {
+        int expected = 0;
+        failed.compare_exchange_strong(expected, 1 + i);
+        return;
+      }
+    }
+  };
+  if (nthreads <= 1 || n <= 1) {
+    run(0, n);
+  } else {
+    int workers = nthreads < n ? nthreads : n;
+    std::vector<std::thread> threads;
+    threads.reserve(workers);
+    int chunk = (n + workers - 1) / workers;
+    for (int w = 0; w < workers; ++w) {
+      int lo = w * chunk;
+      int hi = lo + chunk < n ? lo + chunk : n;
+      if (lo >= hi) break;
+      threads.emplace_back(run, lo, hi);
+    }
+    for (auto& t : threads) t.join();
+  }
+  return failed.load();
+}
+
+// Single-image probe used by tests and the per-cell fallback.
+int pst_decode_image(const uint8_t* src, uint64_t len, uint8_t* out, int height,
+                     int width, int channels) {
+  return decode_one(src, (size_t)len, out, height, width, channels);
+}
+
+}  // extern "C"

@@ -65,17 +65,21 @@ class RowGroupDecoderWorker:
 
     def __call__(self):
         fs = self._fs_factory()
-        open_files: Dict[str, pq.ParquetFile] = {}
+        # path -> (ParquetFile, column-name set); the column set is cached
+        # because schema_arrow reconstruction is measurable on the per-item
+        # hot path
+        open_files: Dict[str, tuple] = {}
 
-        def _parquet_file(path: str) -> pq.ParquetFile:
-            pf = open_files.get(path)
-            if pf is None:
+        def _parquet_file(path: str) -> tuple:
+            entry = open_files.get(path)
+            if entry is None:
                 if len(open_files) >= _MAX_OPEN_FILES:
                     oldest = next(iter(open_files))
-                    open_files.pop(oldest).close()
+                    open_files.pop(oldest)[0].close()
                 pf = pq.ParquetFile(fs.open_input_file(path))
-                open_files[path] = pf
-            return pf
+                entry = (pf, set(pf.schema_arrow.names))
+                open_files[path] = entry
+            return entry
 
         def process(item: WorkItem) -> ColumnBatch:
             return self._process(_parquet_file, item)
@@ -143,13 +147,15 @@ class RowGroupDecoderWorker:
               mask: Optional[np.ndarray] = None,
               row_range: Optional[tuple] = None) -> ColumnBatch:
         """Read + slice + (mask) + decode ``fields`` of one rowgroup (no transform)."""
-        pf = parquet_file(item.row_group.path)
-        file_cols = set(pf.schema_arrow.names)
+        pf, file_cols = parquet_file(item.row_group.path)
         stored = [f for f in fields if f in file_cols]
         virtual = [f for f in fields if f not in file_cols]
 
         start, stop = row_range if row_range is not None else item.row_slice()
-        table = pf.read_row_group(item.row_group.row_group, columns=stored)
+        # worker-level parallelism comes from the executor pool; pyarrow's
+        # internal thread fan-out per read only adds handoff overhead here
+        table = pf.read_row_group(item.row_group.row_group, columns=stored,
+                                  use_threads=False)
         if (start, stop) != (0, table.num_rows):
             table = table.slice(start, stop - start)
         if mask is not None:

@@ -199,3 +199,184 @@ def test_arena_close_deferred_then_retried():
     gc.collect()
     arena.close()  # second attempt actually unmaps now
     assert arena._unmapped
+
+
+# -- native batched image decode ----------------------------------------------
+
+class TestNativeImageDecode:
+    """native/image.py: batched libpng/libjpeg decode of arrow binary columns."""
+
+    @pytest.fixture(autouse=True)
+    def _need_lib(self):
+        from petastorm_tpu.native import image as native_image
+
+        if not native_image.available():
+            pytest.skip("native image decoder unavailable")
+
+    def _encode_png(self, img):
+        import io as _io
+
+        from PIL import Image
+
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        return buf.getvalue()
+
+    def test_png_batch_matches_source(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(7)
+        imgs = [rng.integers(0, 255, (32, 48, 3), dtype=np.uint8) for _ in range(5)]
+        col = pa.array([self._encode_png(i) for i in imgs], type=pa.binary())
+        out = np.empty((5, 32, 48, 3), np.uint8)
+        assert decode_column_native(col, out)
+        for i in range(5):
+            np.testing.assert_array_equal(out[i], imgs[i])
+
+    def test_grayscale_and_internal_threads(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(3)
+        imgs = [rng.integers(0, 255, (16, 24), dtype=np.uint8) for _ in range(8)]
+        col = pa.array([self._encode_png(i) for i in imgs], type=pa.binary())
+        out = np.empty((8, 16, 24), np.uint8)
+        assert decode_column_native(col, out, nthreads=4)
+        for i in range(8):
+            np.testing.assert_array_equal(out[i], imgs[i])
+
+    def test_sliced_column_respects_offset(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(5)
+        imgs = [rng.integers(0, 255, (8, 8, 3), dtype=np.uint8) for _ in range(6)]
+        col = pa.array([self._encode_png(i) for i in imgs], type=pa.binary())
+        out = np.empty((3, 8, 8, 3), np.uint8)
+        assert decode_column_native(col.slice(2, 3), out)
+        for i in range(3):
+            np.testing.assert_array_equal(out[i], imgs[2 + i])
+
+    def test_corrupt_stream_raises(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.errors import CodecError
+        from petastorm_tpu.native.image import decode_column_native
+
+        col = pa.array([b"\x89PNG but not really"], type=pa.binary())
+        with pytest.raises(CodecError, match="cell 0"):
+            decode_column_native(col, np.empty((1, 8, 8, 3), np.uint8))
+
+    def test_shape_mismatch_raises(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.errors import CodecError
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(1)
+        img = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+        col = pa.array([self._encode_png(img)], type=pa.binary())
+        with pytest.raises(CodecError):
+            decode_column_native(col, np.empty((1, 8, 8, 3), np.uint8))
+
+    def test_null_cells_fall_back(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.native.image import decode_column_native
+
+        col = pa.array([None], type=pa.binary())
+        assert not decode_column_native(col, np.empty((1, 8, 8, 3), np.uint8))
+
+    def test_codec_uses_native_path(self, monkeypatch):
+        """CompressedImageCodec.decode_column routes through the native decoder."""
+        import pyarrow as pa
+
+        from petastorm_tpu.codecs import CompressedImageCodec
+        from petastorm_tpu.native import image as native_image
+        from petastorm_tpu.schema import Field
+
+        calls = []
+        orig = native_image.decode_column_native
+
+        def spy(column, out, nthreads=1):
+            calls.append(len(column))
+            return orig(column, out, nthreads=nthreads)
+
+        monkeypatch.setattr(native_image, "decode_column_native", spy)
+        codec = CompressedImageCodec("png")
+        field = Field("img", np.uint8, (16, 16, 3), codec)
+        rng = np.random.default_rng(2)
+        imgs = [rng.integers(0, 255, (16, 16, 3), dtype=np.uint8) for _ in range(4)]
+        col = pa.array([codec.encode(field, i) for i in imgs], type=pa.binary())
+        out = codec.decode_column(field, col)
+        assert calls == [4]
+        for i in range(4):
+            np.testing.assert_array_equal(out[i], imgs[i])
+
+    def _encode_jpeg(self, img, quality=90):
+        import io as _io
+
+        from PIL import Image
+
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+        return buf.getvalue()
+
+    def test_jpeg_batch_matches_cv2(self):
+        cv2 = pytest.importorskip("cv2")
+        import pyarrow as pa
+
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(11)
+        imgs = [rng.integers(0, 255, (32, 48, 3), dtype=np.uint8) for _ in range(4)]
+        enc = [self._encode_jpeg(i) for i in imgs]
+        col = pa.array(enc, type=pa.binary())
+        out = np.empty((4, 32, 48, 3), np.uint8)
+        assert decode_column_native(col, out)
+        for i in range(4):
+            ref = cv2.cvtColor(
+                cv2.imdecode(np.frombuffer(enc[i], np.uint8), cv2.IMREAD_COLOR),
+                cv2.COLOR_BGR2RGB)
+            np.testing.assert_array_equal(out[i], ref)
+
+    def test_jpeg_grayscale(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.native.image import decode_column_native
+
+        grad = np.tile(np.linspace(0, 255, 24, dtype=np.uint8), (16, 1))
+        col = pa.array([self._encode_jpeg(grad)], type=pa.binary())
+        out = np.empty((1, 16, 24), np.uint8)
+        assert decode_column_native(col, out)
+        assert np.abs(out[0].astype(int) - grad.astype(int)).mean() < 3
+
+    def test_jpeg_dimension_mismatch_raises(self):
+        import pyarrow as pa
+
+        from petastorm_tpu.errors import CodecError
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(13)
+        img = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+        col = pa.array([self._encode_jpeg(img)], type=pa.binary())
+        with pytest.raises(CodecError):
+            decode_column_native(col, np.empty((1, 8, 8, 3), np.uint8))
+
+    def test_truncated_jpeg_raises_not_crashes(self):
+        """setjmp error trap: a truncated stream must error cleanly."""
+        import pyarrow as pa
+
+        from petastorm_tpu.errors import CodecError
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(17)
+        img = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+        enc = self._encode_jpeg(img)
+        col = pa.array([enc[:len(enc) // 4]], type=pa.binary())
+        with pytest.raises(CodecError):
+            decode_column_native(col, np.empty((1, 16, 16, 3), np.uint8))
