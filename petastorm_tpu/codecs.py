@@ -38,6 +38,27 @@ from petastorm_tpu.errors import CodecError
 
 _CODEC_REGISTRY: Dict[str, Type["Codec"]] = {}
 
+_DECODE_THREADS: Optional[int] = None
+
+
+def _decode_threads() -> int:
+    """PETASTORM_TPU_DECODE_THREADS: internal decode fan-out for serial consumers
+    (e.g. the jax loader path) on multicore hosts; pool workers keep 1.  Parsed
+    once; malformed values warn and fall back to 1."""
+    global _DECODE_THREADS
+    if _DECODE_THREADS is None:
+        import logging
+        import os
+
+        raw = os.environ.get("PETASTORM_TPU_DECODE_THREADS", "1")
+        try:
+            _DECODE_THREADS = max(1, int(raw))
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "Ignoring malformed PETASTORM_TPU_DECODE_THREADS=%r; using 1", raw)
+            _DECODE_THREADS = 1
+    return _DECODE_THREADS
+
 
 def register_codec(cls: Type["Codec"]) -> Type["Codec"]:
     _CODEC_REGISTRY[cls.codec_name] = cls
@@ -381,10 +402,14 @@ class CompressedImageCodec(Codec):
         return self._pil_encode(value)
 
     def decode(self, field, value: bytes) -> np.ndarray:
+        # (h, w, 1) fields are grayscale streams; decode single-channel so the
+        # result honors the declared shape (and matches the native batched path)
+        single_channel = len(field.shape) == 3 and field.shape[2] == 1
         cv2 = self._cv2()
         if cv2 is not None:
             flags = cv2.IMREAD_UNCHANGED if field.dtype == np.dtype("uint16") else (
-                cv2.IMREAD_COLOR if len(field.shape) == 3 else cv2.IMREAD_GRAYSCALE
+                cv2.IMREAD_COLOR if len(field.shape) == 3 and not single_channel
+                else cv2.IMREAD_GRAYSCALE
             )
             img = cv2.imdecode(np.frombuffer(value, dtype=np.uint8), flags)
             if img is None:
@@ -393,8 +418,11 @@ class CompressedImageCodec(Codec):
                 # cvtColor instead of img[..., ::-1]: SIMD, contiguous output,
                 # and releases the GIL so thread-pool decode scales
                 img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
-            return np.ascontiguousarray(img.astype(field.dtype, copy=False))
-        return self._pil_decode(field, value)
+        else:
+            img = self._pil_decode(field, value)
+        if single_channel and img.ndim == 2:
+            img = img[..., None]
+        return np.ascontiguousarray(img.astype(field.dtype, copy=False))
 
     def decode_column(self, field, column: pa.Array) -> np.ndarray:
         # Hot path: batched native decode (libpng/libjpeg, GIL released) into a
@@ -404,16 +432,13 @@ class CompressedImageCodec(Codec):
                 and column.null_count == 0
                 and (len(field.shape) == 2
                      or (len(field.shape) == 3 and field.shape[2] in (1, 3)))):
-            import os
-
             from petastorm_tpu.native import image as native_image
 
-            # internal fan-out for serial consumers (e.g. the jax loader path)
-            # on multicore hosts; pool workers keep the default of 1
-            nthreads = int(os.environ.get("PETASTORM_TPU_DECODE_THREADS", "1"))
-            out = np.empty((len(column),) + field.shape, dtype=np.uint8)
-            if native_image.decode_column_native(column, out, nthreads=nthreads):
-                return out
+            if native_image.available():
+                out = np.empty((len(column),) + field.shape, dtype=np.uint8)
+                if native_image.decode_column_native(column, out,
+                                                     nthreads=_decode_threads()):
+                    return out
         return super().decode_column(field, column)
 
     def raw_column(self, column: pa.Array) -> np.ndarray:

@@ -230,6 +230,11 @@ def get_row_group_indexes(info: DatasetInfo) -> Dict[str, RowGroupIndexer]:
     """Load stored indexes (reference: rowgroup_indexing.py:138-160)."""
     raw = info.kv_metadata.get(ROWGROUP_INDEX_METADATA_KEY)
     if not raw:
+        from petastorm_tpu import interop
+
+        legacy = info.kv_metadata.get(interop.LEGACY_INDEX_KEY)
+        if legacy:
+            return interop.load_legacy_indexes(legacy)
         return {}
     payload = json.loads(raw)
     out = {}

@@ -136,6 +136,33 @@ def _footer_row_groups(fs: pafs.FileSystem, path: str) -> List[int]:
         return [md.row_group(i).num_rows for i in range(md.num_row_groups)]
 
 
+def _check_legacy_row_group_counts(kv_metadata: Dict[bytes, bytes], root: str,
+                                   per_file: Dict[str, List[int]]) -> None:
+    """Cross-check footer-derived counts against a legacy petastorm
+    ``dataset-toolkit.num_row_groups_per_file.v1`` payload (``{relpath: count}``,
+    reference dataset_metadata.py:209-242).  The legacy key stores only rowgroup
+    *counts* (not per-rowgroup row counts), so it cannot replace footer reads
+    here - but a mismatch means the metadata is stale (files rewritten after
+    ``materialize_dataset``), which the reference would silently mis-plan on."""
+    from petastorm_tpu.interop import LEGACY_ROW_GROUPS_KEY
+
+    raw = kv_metadata.get(LEGACY_ROW_GROUPS_KEY)
+    if not raw:
+        return
+    try:
+        legacy_counts = json.loads(raw)
+    except ValueError:
+        logger.warning("Corrupt legacy %s payload; ignoring", LEGACY_ROW_GROUPS_KEY)
+        return
+    for f, rg_rows in per_file.items():
+        rel = posixpath.relpath(f, root)
+        if rel in legacy_counts and legacy_counts[rel] != len(rg_rows):
+            logger.warning(
+                "Legacy petastorm metadata is stale for %s: recorded %d rowgroups,"
+                " file has %d (dataset rewritten after materialize?)",
+                rel, legacy_counts[rel], len(rg_rows))
+
+
 def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
                     kv_metadata: Dict[bytes, bytes]) -> List[RowGroupRef]:
     """Enumerate rowgroups for path-sorted ``files``.
@@ -168,6 +195,7 @@ def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
         with ThreadPoolExecutor(max_workers=_FOOTER_READ_THREADS) as pool:
             results = list(pool.map(lambda p: _footer_row_groups(fs, p), files))
         per_file = dict(zip(files, results))
+        _check_legacy_row_group_counts(kv_metadata, root, per_file)
 
     refs: List[RowGroupRef] = []
     for f in files:
@@ -226,6 +254,25 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
         if SCHEMA_METADATA_KEY in file_kv:
             stored_schema = Schema.from_json(file_kv[SCHEMA_METADATA_KEY])
             kv = {**file_kv, **kv}
+    if stored_schema is None:
+        # dataset written by the original Petastorm library: pickled Unischema
+        # under dataset-toolkit.unischema.v1 (reference dataset_metadata.py:35-36)
+        from petastorm_tpu import interop
+
+        legacy_blob = kv.get(interop.LEGACY_UNISCHEMA_KEY)
+        if legacy_blob:
+            # an undecodable blob (e.g. user-defined codec subclass outside the
+            # interop whitelist) must not break schema-inference consumers like
+            # make_batch_reader; they read these datasets fine without it
+            try:
+                stored_schema = interop.load_legacy_schema(legacy_blob)
+                logger.info("Loaded legacy petastorm unischema %r from %s",
+                            stored_schema.name, url_or_urls)
+            except Exception as exc:
+                logger.warning(
+                    "Dataset at %s has a legacy petastorm unischema that could"
+                    " not be converted (%s); falling back to arrow schema"
+                    " inference", url_or_urls, exc)
     if require_stored_schema and stored_schema is None:
         raise MetadataError(
             f"Dataset at {url_or_urls!r} has no petastorm-tpu schema metadata. It was"

@@ -11,43 +11,30 @@ from __future__ import annotations
 
 import ctypes
 import logging
-import threading
 from multiprocessing import resource_tracker, shared_memory
 from typing import Optional
 
 logger = logging.getLogger(__name__)
 
-_lib_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_lib_failed = False
+def _configure_arena(lib: ctypes.CDLL) -> None:
+    lib.psa_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.psa_init.restype = ctypes.c_int
+    lib.psa_check.argtypes = [ctypes.c_void_p]
+    lib.psa_check.restype = ctypes.c_int
+    lib.psa_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.psa_alloc.restype = ctypes.c_int64
+    lib.psa_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.psa_free.restype = ctypes.c_int
+    lib.psa_free_bytes.argtypes = [ctypes.c_void_p]
+    lib.psa_free_bytes.restype = ctypes.c_uint64
+    lib.psa_largest_free.argtypes = [ctypes.c_void_p]
+    lib.psa_largest_free.restype = ctypes.c_uint64
 
 
 def _load_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_failed
-    with _lib_lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        from petastorm_tpu.native.build import build
+    from petastorm_tpu.native.build import load_library
 
-        path = build()
-        if path is None:
-            _lib_failed = True
-            return None
-        lib = ctypes.CDLL(path)
-        lib.psa_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.psa_init.restype = ctypes.c_int
-        lib.psa_check.argtypes = [ctypes.c_void_p]
-        lib.psa_check.restype = ctypes.c_int
-        lib.psa_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.psa_alloc.restype = ctypes.c_int64
-        lib.psa_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.psa_free.restype = ctypes.c_int
-        lib.psa_free_bytes.argtypes = [ctypes.c_void_p]
-        lib.psa_free_bytes.restype = ctypes.c_uint64
-        lib.psa_largest_free.argtypes = [ctypes.c_void_p]
-        lib.psa_largest_free.restype = ctypes.c_uint64
-        _lib = lib
-        return _lib
+    return load_library("shm_arena", _configure_arena)
 
 
 def is_available() -> bool:

@@ -12,6 +12,7 @@ import logging
 import os
 import subprocess
 import tempfile
+import threading
 from typing import Optional
 
 logger = logging.getLogger(__name__)
@@ -34,6 +35,33 @@ def _source_tag(src: str) -> str:
 def lib_path(name: str = "shm_arena") -> str:
     src, _ = _LIBS[name]
     return os.path.join(_LIB_DIR, f"lib{name}-{_source_tag(os.path.join(_DIR, src))}.so")
+
+
+_load_lock = threading.Lock()
+_loaded: dict = {}
+
+
+def load_library(name: str, configure) -> Optional["ctypes.CDLL"]:
+    """Build (if needed) + ``ctypes.CDLL``-load + one-time ``configure(lib)``,
+    cached per name; returns None (and remembers the failure) when the
+    toolchain is missing or the .so fails to load.  Shared by every native
+    binding so availability/error behavior stays consistent."""
+    import ctypes
+
+    with _load_lock:
+        if name in _loaded:
+            return _loaded[name]
+        path = build(name)
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+                configure(lib)
+            except OSError as exc:
+                logger.warning("loading native %s failed: %s", name, exc)
+                lib = None
+        _loaded[name] = lib
+        return lib
 
 
 def build(name: str = "shm_arena", force: bool = False) -> Optional[str]:

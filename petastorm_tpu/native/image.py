@@ -14,54 +14,34 @@ from __future__ import annotations
 
 import ctypes
 import logging
-import threading
 from typing import Optional
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
 
-_lock = threading.Lock()
-_lib = None
-_lib_failed = False
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.pst_decode_image_batch.restype = ctypes.c_int
+    lib.pst_decode_image_batch.argtypes = [
+        ctypes.c_void_p,  # const uint8_t* const* srcs (uint64 array)
+        ctypes.c_void_p,  # const uint64_t* lens
+        ctypes.c_int,     # n
+        ctypes.c_void_p,  # uint8_t* out
+        ctypes.c_uint64,  # stride
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c
+        ctypes.c_int,     # nthreads
+    ]
+    lib.pst_decode_image.restype = ctypes.c_int
+    lib.pst_decode_image.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_failed
-    if _lib is not None or _lib_failed:
-        return _lib
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        from petastorm_tpu.native import build
+    from petastorm_tpu.native import build
 
-        path = build.build("image_decode")
-        if path is None:
-            _lib_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError as exc:
-            logger.warning("loading native image decoder failed: %s", exc)
-            _lib_failed = True
-            return None
-        lib.pst_decode_image_batch.restype = ctypes.c_int
-        lib.pst_decode_image_batch.argtypes = [
-            ctypes.c_void_p,  # const uint8_t* const* srcs (uint64 array)
-            ctypes.c_void_p,  # const uint64_t* lens
-            ctypes.c_int,     # n
-            ctypes.c_void_p,  # uint8_t* out
-            ctypes.c_uint64,  # stride
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c
-            ctypes.c_int,     # nthreads
-        ]
-        lib.pst_decode_image.restype = ctypes.c_int
-        lib.pst_decode_image.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ]
-        _lib = lib
-        return _lib
+    return build.load_library("image_decode", _configure)
 
 
 def available() -> bool:

@@ -30,6 +30,68 @@ namespace {
 // PNG via the libpng 1.6 "simplified" API: handles bit-depth/palette/alpha
 // conversion to the requested format in one call.
 // ---------------------------------------------------------------------------
+// Color-source -> grayscale-target PNG decode via the full libpng API with
+// png_set_rgb_to_gray(0.299, 0.587, 0.114) - the exact call OpenCV's PNG
+// reader makes for IMREAD_GRAYSCALE - so native and cv2 fallback paths yield
+// bit-identical tensors.  (The simplified API's PNG_FORMAT_GRAY uses libpng's
+// default BT.709 + gamma handling, which differs by up to ~50/255.)
+struct PngMemSrc {
+  const uint8_t* data;
+  size_t len;
+  size_t pos;
+};
+
+void png_mem_read(png_structp png, png_bytep dst, png_size_t n) {
+  PngMemSrc* s = static_cast<PngMemSrc*>(png_get_io_ptr(png));
+  if (s->pos + n > s->len) {
+    png_error(png, "read past end");
+    return;
+  }
+  std::memcpy(dst, s->data + s->pos, n);
+  s->pos += n;
+}
+
+int decode_png_gray_cv2(const uint8_t* src, size_t len, uint8_t* out,
+                        int height, int width) {
+  png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr,
+                                           nullptr, nullptr);
+  if (!png) return -2;
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_read_struct(&png, nullptr, nullptr);
+    return -2;
+  }
+  // fully built before setjmp: longjmp must not skip over mutations of
+  // non-volatile locals
+  std::vector<png_bytep> rows(height);
+  for (int y = 0; y < height; ++y) rows[y] = out + (size_t)y * width;
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return -5;
+  }
+  PngMemSrc mem{src, len, 0};
+  png_set_read_fn(png, &mem, png_mem_read);
+  png_read_info(png, info);
+  if ((int)png_get_image_width(png, info) != width ||
+      (int)png_get_image_height(png, info) != height) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return -3;
+  }
+  png_set_expand(png);    // palette->rgb, low-bit gray->8, tRNS->alpha
+  png_set_strip_16(png);  // 16-bit->8-bit
+  png_set_strip_alpha(png);
+  // (red, green) weights; blue is implicitly 1 - red - green = 0.114
+  png_set_rgb_to_gray(png, PNG_ERROR_ACTION_NONE, 0.299, 0.587);
+  png_read_update_info(png, info);
+  if (png_get_rowbytes(png, info) != (size_t)width) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return -4;
+  }
+  png_read_image(png, rows.data());
+  png_destroy_read_struct(&png, &info, nullptr);
+  return 0;
+}
+
 int decode_png(const uint8_t* src, size_t len, uint8_t* out, int height,
                int width, int channels) {
   png_image image;
@@ -39,6 +101,12 @@ int decode_png(const uint8_t* src, size_t len, uint8_t* out, int height,
   if ((int)image.width != width || (int)image.height != height) {
     png_image_free(&image);
     return -3;
+  }
+  // After begin_read, image.format describes the file's native format.
+  const bool src_color = (image.format & PNG_FORMAT_FLAG_COLOR) != 0;
+  if (channels == 1 && src_color) {
+    png_image_free(&image);
+    return decode_png_gray_cv2(src, len, out, height, width);
   }
   image.format = (channels == 3)   ? PNG_FORMAT_RGB
                  : (channels == 1) ? PNG_FORMAT_GRAY

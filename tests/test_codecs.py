@@ -108,6 +108,35 @@ def test_png_uint16_grayscale(rng):
     np.testing.assert_array_equal(out, value)
 
 
+def test_png_single_channel_shape_honored(rng):
+    # (h, w, 1) fields must decode to 1 channel in BOTH the per-cell path and
+    # the native batched path - not gray-replicated RGB
+    f = Field("im", np.uint8, (10, 7, 1), CompressedImageCodec("png"))
+    value = rng.integers(0, 255, (10, 7, 1), dtype=np.uint8)
+    codec = CompressedImageCodec("png")
+    out = codec.decode(f, codec.encode(f, value))
+    assert out.shape == (10, 7, 1)
+    np.testing.assert_array_equal(out, value)
+    import pyarrow as pa
+
+    col = pa.array([codec.encode(f, value)] * 3, type=pa.binary())
+    batched = codec.decode_column(f, col)
+    assert batched.shape == (3, 10, 7, 1)
+    np.testing.assert_array_equal(batched[0], value)
+
+
+def test_decode_threads_env_malformed(monkeypatch):
+    import petastorm_tpu.codecs as codecs_mod
+
+    monkeypatch.setattr(codecs_mod, "_DECODE_THREADS", None)
+    monkeypatch.setenv("PETASTORM_TPU_DECODE_THREADS", "auto")
+    assert codecs_mod._decode_threads() == 1
+    monkeypatch.setattr(codecs_mod, "_DECODE_THREADS", None)
+    monkeypatch.setenv("PETASTORM_TPU_DECODE_THREADS", "4")
+    assert codecs_mod._decode_threads() == 4
+    monkeypatch.setattr(codecs_mod, "_DECODE_THREADS", None)
+
+
 def test_jpeg_lossy_close(rng):
     f = Field("im", np.uint8, (32, 32, 3), CompressedImageCodec("jpeg", quality=95))
     value = np.full((32, 32, 3), 128, dtype=np.uint8)

@@ -1,0 +1,322 @@
+"""Legacy-Petastorm interop tests.
+
+A "legacy writer" is simulated with throwaway fake ``petastorm``/``pyspark``
+modules whose classes have the exact module paths + attribute layouts the
+reference pickles (unischema.py:51-85,179-197; codecs.py:54-63,192-197;
+rowgroup_indexers.py:28-31,83-86), so ``pickle.dumps`` produces byte streams
+indistinguishable from a real reference-written ``_common_metadata``.
+Reference test model: petastorm/tests/test_reading_legacy_datasets.py.
+"""
+
+import io
+import pickle
+import sys
+import types
+from collections import OrderedDict, defaultdict
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import interop
+from petastorm_tpu.codecs import CompressedImageCodec as OurImageCodec
+from petastorm_tpu.codecs import NdarrayCodec as OurNdarrayCodec
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.etl import get_row_group_indexes, open_dataset
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.schema import Field
+from petastorm_tpu.selectors import SingleIndexSelector
+
+
+# ---------------------------------------------------------------------------
+# Fake legacy-petastorm modules (pickle-layout-identical to the reference)
+# ---------------------------------------------------------------------------
+
+def _install_fake_petastorm():
+    from collections import namedtuple as _nt
+
+    uni = types.ModuleType("petastorm.unischema")
+
+    class UnischemaField(_nt("UnischemaField",
+                             ["name", "numpy_dtype", "shape", "codec", "nullable"])):
+        pass
+
+    UnischemaField.__new__.__defaults__ = (None, False)
+    UnischemaField.__module__ = "petastorm.unischema"
+    UnischemaField.__qualname__ = "UnischemaField"
+
+    class Unischema(object):
+        def __init__(self, name, fields):
+            self._name = name
+            self._fields = OrderedDict((f.name, f) for f in fields)
+            for f in fields:
+                if not hasattr(self, f.name):
+                    setattr(self, f.name, f)
+
+    Unischema.__module__ = "petastorm.unischema"
+    Unischema.__qualname__ = "Unischema"
+    uni.UnischemaField, uni.Unischema = UnischemaField, Unischema
+
+    cod = types.ModuleType("petastorm.codecs")
+
+    class NdarrayCodec(object):
+        pass
+
+    class CompressedNdarrayCodec(object):
+        pass
+
+    class CompressedImageCodec(object):
+        def __init__(self, image_codec="png", quality=80):
+            self._image_codec = "." + image_codec
+            self._quality = quality
+
+    class ScalarCodec(object):
+        def __init__(self, spark_type):
+            self._spark_type = spark_type
+
+    for cls in (NdarrayCodec, CompressedNdarrayCodec, CompressedImageCodec, ScalarCodec):
+        cls.__module__ = "petastorm.codecs"
+        cls.__qualname__ = cls.__name__
+        setattr(cod, cls.__name__, cls)
+
+    idxm = types.ModuleType("petastorm.etl.rowgroup_indexers")
+
+    class SingleFieldIndexer(object):
+        def __init__(self, index_name, index_field):
+            self._index_name = index_name
+            self._column_name = index_field
+            self._index_data = defaultdict(set)
+
+    class FieldNotNullIndexer(object):
+        def __init__(self, index_name, index_field):
+            self._index_name = index_name
+            self._column_name = index_field
+            self._index_data = set()
+
+    for cls in (SingleFieldIndexer, FieldNotNullIndexer):
+        cls.__module__ = "petastorm.etl.rowgroup_indexers"
+        cls.__qualname__ = cls.__name__
+        setattr(idxm, cls.__name__, cls)
+
+    spark = types.ModuleType("pyspark.sql.types")
+    for tname in ("IntegerType", "LongType", "StringType", "DoubleType",
+                  "BooleanType", "DecimalType"):
+        cls = type(tname, (object,), {"__module__": "pyspark.sql.types",
+                                      "__init__": lambda self, *a, **k: None})
+        setattr(spark, tname, cls)
+
+    pkg = types.ModuleType("petastorm")
+    etl = types.ModuleType("petastorm.etl")
+    pysparkm = types.ModuleType("pyspark")
+    sqlm = types.ModuleType("pyspark.sql")
+    mods = {"petastorm": pkg, "petastorm.unischema": uni, "petastorm.codecs": cod,
+            "petastorm.etl": etl, "petastorm.etl.rowgroup_indexers": idxm,
+            "pyspark": pysparkm, "pyspark.sql": sqlm, "pyspark.sql.types": spark}
+    saved = {k: sys.modules.get(k) for k in mods}
+    sys.modules.update(mods)
+    return mods, saved
+
+
+@pytest.fixture()
+def fake_petastorm():
+    mods, saved = _install_fake_petastorm()
+    yield mods
+    for k, v in saved.items():
+        if v is None:
+            sys.modules.pop(k, None)
+        else:
+            sys.modules[k] = v
+
+
+def _npy_bytes(arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+@pytest.fixture()
+def legacy_dataset(tmp_path, fake_petastorm):
+    """Parquet dataset laid out exactly like a reference-written one."""
+    uni = fake_petastorm["petastorm.unischema"]
+    cod = fake_petastorm["petastorm.codecs"]
+    spark = fake_petastorm["pyspark.sql.types"]
+
+    schema = uni.Unischema("LegacySchema", [
+        uni.UnischemaField("id", np.int64, (), cod.ScalarCodec(spark.LongType()), False),
+        uni.UnischemaField("name", np.str_, (), cod.ScalarCodec(spark.StringType()), False),
+        uni.UnischemaField("embedding", np.float32, (4,), cod.NdarrayCodec(), False),
+        uni.UnischemaField("image", np.uint8, (6, 5, 3), cod.CompressedImageCodec("png"), False),
+    ])
+
+    rng = np.random.default_rng(7)
+    n = 20
+    ids = np.arange(n, dtype=np.int64)
+    names = [f"row_{i}" for i in range(n)]
+    embeddings = [rng.standard_normal(4).astype(np.float32) for _ in range(n)]
+    images = [rng.integers(0, 255, size=(6, 5, 3), dtype=np.uint8) for _ in range(n)]
+    img_field = Field("image", np.uint8, (6, 5, 3))
+    img_codec = OurImageCodec("png")
+
+    table = pa.table({
+        "id": pa.array(ids),
+        "name": pa.array(names),
+        "embedding": pa.array([_npy_bytes(e) for e in embeddings], type=pa.binary()),
+        "image": pa.array([img_codec.encode(img_field, im) for im in images],
+                          type=pa.binary()),
+    })
+    root = tmp_path / "legacy_ds"
+    root.mkdir()
+    pq.write_table(table, root / "part-00000.parquet", row_group_size=5)
+
+    idxm = fake_petastorm["petastorm.etl.rowgroup_indexers"]
+    single = idxm.SingleFieldIndexer("by_name", "name")
+    for i, nm in enumerate(names):
+        single._index_data[nm].add(i // 5)
+    notnull = idxm.FieldNotNullIndexer("name_not_null", "name")
+    notnull._index_data.update(range(4))
+    kv = {
+        interop.LEGACY_UNISCHEMA_KEY: pickle.dumps(schema),
+        interop.LEGACY_ROW_GROUPS_KEY: b'{"part-00000.parquet": 4}',
+        interop.LEGACY_INDEX_KEY: pickle.dumps(
+            {"by_name": single, "name_not_null": notnull}, pickle.HIGHEST_PROTOCOL),
+    }
+    pq.write_metadata(table.schema.with_metadata(
+        {k: v for k, v in kv.items()}), root / "_common_metadata")
+    rows = {"ids": ids, "names": names, "embeddings": embeddings, "images": images}
+    return str(root), rows
+
+
+# ---------------------------------------------------------------------------
+# Schema conversion
+# ---------------------------------------------------------------------------
+
+def test_legacy_schema_loads(legacy_dataset):
+    url, _ = legacy_dataset
+    info = open_dataset(url)
+    schema = info.stored_schema
+    assert schema is not None and schema.name == "LegacySchema"
+    assert list(schema.fields) == ["id", "name", "embedding", "image"]
+    assert schema["embedding"].shape == (4,)
+    assert isinstance(schema["embedding"].codec, OurNdarrayCodec)
+    assert isinstance(schema["image"].codec, OurImageCodec)
+    assert schema["image"].codec.image_codec == "png"
+    assert schema["name"].dtype == np.dtype("object")
+
+
+def test_legacy_end_to_end_read(legacy_dataset):
+    url, rows = legacy_dataset
+    seen = {}
+    with make_reader(url, workers_count=2) as reader:
+        for row in reader:
+            seen[int(row.id)] = row
+    assert sorted(seen) == list(range(20))
+    for i in range(20):
+        row = seen[i]
+        assert row.name == f"row_{i}"
+        np.testing.assert_array_equal(row.embedding, rows["embeddings"][i])
+        np.testing.assert_array_equal(row.image, rows["images"][i])
+
+
+def test_legacy_stale_row_group_counts_warn(tmp_path, fake_petastorm, caplog):
+    """A legacy counts payload disagreeing with real footers flags stale metadata."""
+    import logging
+
+    uni = fake_petastorm["petastorm.unischema"]
+    schema = uni.Unischema("S", [uni.UnischemaField("x", np.int64, (), None, False)])
+    table = pa.table({"x": pa.array(np.arange(10, dtype=np.int64))})
+    root = tmp_path / "stale"
+    root.mkdir()
+    pq.write_table(table, root / "part-0.parquet", row_group_size=5)  # 2 rowgroups
+    pq.write_metadata(table.schema.with_metadata({
+        interop.LEGACY_UNISCHEMA_KEY: pickle.dumps(schema),
+        interop.LEGACY_ROW_GROUPS_KEY: b'{"part-0.parquet": 7}',
+    }), root / "_common_metadata")
+    with caplog.at_level(logging.WARNING, logger="petastorm_tpu.etl.metadata"):
+        info = open_dataset(str(root))
+    assert len(info.row_groups) == 2  # footers win
+    assert any("stale" in rec.message for rec in caplog.records)
+
+
+def test_legacy_index_selector(legacy_dataset):
+    url, _ = legacy_dataset
+    info = open_dataset(url)
+    indexes = get_row_group_indexes(info)
+    assert set(indexes) == {"by_name", "name_not_null"}
+    assert indexes["by_name"].get_row_group_indexes("row_7") == {1}
+    assert indexes["name_not_null"].get_row_group_indexes() == {0, 1, 2, 3}
+    with make_reader(url, rowgroup_selector=SingleIndexSelector("by_name", ["row_12"])) as r:
+        ids = sorted(int(row.id) for row in r)
+    assert ids == [10, 11, 12, 13, 14]  # the whole containing rowgroup
+
+
+def test_legacy_package_names(fake_petastorm):
+    """Pre-petastorm module paths (etl/legacy.py:31-33) resolve too."""
+    uni = fake_petastorm["petastorm.unischema"]
+    cod = fake_petastorm["petastorm.codecs"]
+    schema = uni.Unischema("Old", [uni.UnischemaField("x", np.int32, (), None, False)])
+    # old streams are protocol <= 2 with text-framed module names, which is what
+    # made the reference's byte-level module rename possible (etl/legacy.py:38-45)
+    blob = pickle.dumps(schema, protocol=0)
+    blob = blob.replace(b"petastorm.unischema", b"av.ml.dataset_toolkit.unischema")
+    blob = blob.replace(b"petastorm.codecs", b"av.ml.dataset_toolkit.codecs")
+    out = interop.load_legacy_schema(blob)
+    assert out.name == "Old" and out["x"].dtype == np.dtype("int32")
+    assert cod is not None  # keep the fixture referenced
+
+
+def test_decimal_and_dtype_instances(fake_petastorm):
+    from decimal import Decimal
+
+    uni = fake_petastorm["petastorm.unischema"]
+    schema = uni.Unischema("D", [
+        uni.UnischemaField("d", Decimal, (), None, False),
+        uni.UnischemaField("f", np.dtype("float64"), (), None, False),
+        uni.UnischemaField("s", np.dtype("U10"), (), None, False),
+    ])
+    out = interop.load_legacy_schema(pickle.dumps(schema))
+    assert out["d"].dtype == np.dtype("object")
+    assert out["f"].dtype == np.dtype("float64")
+    assert out["s"].dtype == np.dtype("object")
+
+
+# ---------------------------------------------------------------------------
+# Restricted unpickler security
+# ---------------------------------------------------------------------------
+
+def test_unpickler_rejects_arbitrary_callables():
+    import os
+
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        interop._restricted_loads(pickle.dumps(os.system))
+
+
+def test_unpickler_rejects_reduce_payloads():
+    class Evil:
+        def __reduce__(self):
+            return (eval, ("1+1",))
+
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        interop._restricted_loads(pickle.dumps(Evil()))
+
+
+def test_unpickler_rejects_petastorm_named_classes_elsewhere():
+    """A class *named* Unischema in an unrelated module must not resolve."""
+    parent = types.ModuleType("evil")
+    mod = types.ModuleType("evil.unischema")
+    cls = type("Unischema", (object,), {"__module__": "evil.unischema"})
+    mod.Unischema = cls
+    parent.unischema = mod
+    sys.modules["evil"] = parent
+    sys.modules["evil.unischema"] = mod
+    try:
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            interop._restricted_loads(pickle.dumps(cls()))
+    finally:
+        del sys.modules["evil.unischema"]
+        del sys.modules["evil"]
+
+
+def test_non_unischema_payload_raises():
+    with pytest.raises(MetadataError, match="expected a Unischema"):
+        interop.load_legacy_schema(pickle.dumps({"not": "a schema"}))

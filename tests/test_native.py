@@ -248,6 +248,26 @@ class TestNativeImageDecode:
         for i in range(8):
             np.testing.assert_array_equal(out[i], imgs[i])
 
+    def test_color_png_to_gray_matches_cv2(self):
+        """Color streams decoded into a grayscale field must match the cv2
+        per-cell fallback bit-for-bit (BT.601 integer math), so tensors do not
+        depend on whether the native library built."""
+        import pyarrow as pa
+
+        cv2 = pytest.importorskip("cv2")
+        from petastorm_tpu.native.image import decode_column_native
+
+        rng = np.random.default_rng(11)
+        imgs = [rng.integers(0, 255, (12, 10, 3), dtype=np.uint8) for _ in range(4)]
+        encoded = [self._encode_png(i) for i in imgs]
+        col = pa.array(encoded, type=pa.binary())
+        out = np.empty((4, 12, 10), np.uint8)
+        assert decode_column_native(col, out)
+        for i in range(4):
+            expect = cv2.imdecode(np.frombuffer(encoded[i], np.uint8),
+                                  cv2.IMREAD_GRAYSCALE)
+            np.testing.assert_array_equal(out[i], expect)
+
     def test_sliced_column_respects_offset(self):
         import pyarrow as pa
 
