@@ -458,8 +458,17 @@ class CompressedImageCodec(Codec):
     def _pil_decode(self, field, value: bytes) -> np.ndarray:
         from PIL import Image
 
-        img = np.asarray(Image.open(io.BytesIO(value)))
-        return img.astype(field.dtype, copy=False)
+        img = Image.open(io.BytesIO(value))
+        # match the cv2/native paths: color streams reduce to luma for 1-channel
+        # fields, and 3-channel fields always get RGB (PIL's 'L' is the same
+        # ITU-R 601 weighting cv2 uses, within 1 LSB)
+        single_channel = len(field.shape) <= 2 or (
+            len(field.shape) == 3 and field.shape[2] == 1)
+        if single_channel and img.mode not in ("L", "I;16", "I"):
+            img = img.convert("L")
+        elif len(field.shape) == 3 and field.shape[2] == 3 and img.mode != "RGB":
+            img = img.convert("RGB")
+        return np.asarray(img).astype(field.dtype, copy=False)
 
     def to_json(self):
         return {"codec": self.codec_name, "image_codec": self._format, "quality": self._quality}

@@ -10,10 +10,11 @@ pyarrow.fs handles gs/s3/hdfs natively (the reference predates pyarrow.fs and ha
 hand-roll libhdfs3 namenode resolution and gcsfs shims).  Resolution order:
 
 1. no scheme or ``file://`` -> LocalFileSystem
-2. ``pyarrow.fs.FileSystem.from_uri`` (gs, s3, hdfs - C++ implementations; hdfs HA
-   is handled by libhdfs reading the cluster's hdfs-site.xml, which is what the
-   reference's HdfsNamenodeResolver reimplemented by hand)
-3. fsspec fallback wrapped in ``PyFileSystem(FSSpecHandler)`` for any other scheme
+2. ``hdfs://`` with a configured HA nameservice -> petastorm_tpu.hdfs failover
+   client (python-level namenode resolution + reconnect, like the reference's
+   HAHdfsClient); otherwise falls through to
+3. ``pyarrow.fs.FileSystem.from_uri`` (gs, s3, plain hdfs - C++ implementations)
+4. fsspec fallback wrapped in ``PyFileSystem(FSSpecHandler)`` for any other scheme
 
 Everything returned is picklable-by-construction via ``FilesystemFactory`` so worker
 processes can re-open the filesystem (reference: serializable ``filesystem_factory``,
@@ -22,12 +23,15 @@ fs_utils.py:42-196).
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence, Tuple, Union
 from urllib.parse import urlparse
 
 import pyarrow.fs as pafs
 
 from petastorm_tpu.errors import PetastormTpuError
+
+logger = logging.getLogger(__name__)
 
 
 def normalize_dir_url(url: str) -> str:
@@ -50,6 +54,22 @@ def get_filesystem_and_path(url: str,
         return filesystem, path
     if parsed.scheme in ("", "file"):
         return pafs.LocalFileSystem(), (parsed.path or url)
+    if parsed.scheme == "hdfs":
+        # logical HA nameservices resolve through the failover client; plain
+        # host[:port] authorities and unconfigured environments fall through to
+        # pyarrow's native hdfs (libhdfs reads the cluster config itself).
+        # A RESOLVED nameservice whose namenodes all refuse connections is a
+        # real outage: HdfsConnectError propagates (libhdfs would not fare
+        # better, and falling through would bury the cause).
+        from petastorm_tpu import hdfs as hdfs_ha
+
+        namenodes = hdfs_ha.resolve_url_namenodes(url)
+        if namenodes:
+            return (hdfs_ha.connect_to_either_namenode(
+                        namenodes, user=(storage_options or {}).get("user")),
+                    parsed.path)
+        logger.debug("%r is not a configured HA nameservice; using pyarrow"
+                     " native hdfs", url)
     try:
         fs, path = pafs.FileSystem.from_uri(url)
         return fs, path

@@ -125,6 +125,25 @@ def test_png_single_channel_shape_honored(rng):
     np.testing.assert_array_equal(batched[0], value)
 
 
+def test_pil_fallback_color_to_gray(rng, monkeypatch):
+    # hosts without cv2 use PIL; a color stream into a 1-channel field must
+    # still come out single-channel (and ~match cv2's ITU-R 601 luma)
+    f2d = Field("im", np.uint8, (9, 9), CompressedImageCodec("png"))
+    f3d = Field("im", np.uint8, (9, 9, 1), CompressedImageCodec("png"))
+    color = rng.integers(0, 255, (9, 9, 3), dtype=np.uint8)
+    fcolor = Field("im", np.uint8, (9, 9, 3), CompressedImageCodec("png"))
+    codec = CompressedImageCodec("png")
+    enc = codec.encode(fcolor, color)
+    monkeypatch.setattr(CompressedImageCodec, "_cv2", lambda self: None)
+    out2d = codec.decode(f2d, enc)
+    out3d = codec.decode(f3d, enc)
+    assert out2d.shape == (9, 9)
+    assert out3d.shape == (9, 9, 1)
+    luma = np.round(0.299 * color[..., 0] + 0.587 * color[..., 1]
+                    + 0.114 * color[..., 2])
+    assert np.abs(out2d.astype(int) - luma).max() <= 1
+
+
 def test_decode_threads_env_malformed(monkeypatch):
     import petastorm_tpu.codecs as codecs_mod
 
