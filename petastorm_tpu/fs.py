@@ -49,8 +49,15 @@ def get_filesystem_and_path(url: str,
     url = normalize_dir_url(url)
     parsed = urlparse(url)
     if filesystem is not None:
-        # bucket-prefixed path, matching FileSystem.from_uri's convention
-        path = (parsed.netloc + parsed.path) if parsed.scheme else url
+        # match FileSystem.from_uri's path convention per scheme: bucket-based
+        # stores (s3/gs) prefix the bucket, while an hdfs authority is a
+        # host/nameservice and is NOT part of the path
+        if parsed.scheme == "hdfs":
+            path = parsed.path
+        elif parsed.scheme:
+            path = parsed.netloc + parsed.path
+        else:
+            path = url
         return filesystem, path
     if parsed.scheme in ("", "file"):
         return pafs.LocalFileSystem(), (parsed.path or url)

@@ -197,11 +197,19 @@ class _HaFilesystemHandler(pafs.FileSystemHandler):
         # connection (reference: HAHdfsClient.__reduce__, hdfs/namenode.py:232-235)
         return self.__class__, (self._connector_cls, self._namenodes, self._user)
 
+    #: OSError subclasses that describe the FILE, not the connection - the
+    #: answer will not change on another namenode; re-raise untouched so
+    #: callers' `except FileNotFoundError` etc. still match
+    _NON_TRANSIENT = (FileNotFoundError, FileExistsError, PermissionError,
+                      IsADirectoryError, NotADirectoryError)
+
     def _call(self, method: str, *args, **kwargs):
         failures = []
         while len(failures) <= MAX_FAILOVER_ATTEMPTS:
             try:
                 return getattr(self._fs, method)(*args, **kwargs)
+            except self._NON_TRANSIENT:
+                raise
             except OSError as exc:
                 failures.append(exc)
                 if len(failures) <= MAX_FAILOVER_ATTEMPTS:

@@ -148,7 +148,8 @@ class ThreadedExecutor(ExecutorBase):
 
     def __init__(self, workers_count: int = 3,
                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
-                 in_queue_size: Optional[int] = None):
+                 in_queue_size: Optional[int] = None,
+                 profiling_enabled: bool = False):
         super().__init__()
         self._workers_count = workers_count
         # reference bounds ventilation at workers_count + 2 (reader.py:45-47,412)
@@ -156,28 +157,59 @@ class ThreadedExecutor(ExecutorBase):
         self._out_queue: "queue.Queue[Any]" = queue.Queue(results_queue_size)
         self._stop_event = threading.Event()
         self._threads = []
+        # opt-in worker profiling (reference per-thread cProfile,
+        # thread_pool.py:41-49,190-198).  Python 3.12 allows only ONE active
+        # profiler process-wide (sys.monitoring), so profiling is SAMPLED: a
+        # single designated worker thread is profiled; workers are homogeneous,
+        # so its profile is representative of all of them.
+        self._profiling_enabled = profiling_enabled
+        self._profiles = []
+        self._profiles_lock = threading.Lock()
 
     def start(self, worker_factory: WorkerFactory) -> None:
         if self._threads:
             raise PetastormTpuError("Executor already started")
         for i in range(self._workers_count):
             fn = worker_factory()
-            t = threading.Thread(target=self._worker_loop, args=(fn,),
+            t = threading.Thread(target=self._worker_loop,
+                                 args=(fn, self._profiling_enabled and i == 0),
                                  name=f"petastorm-tpu-worker-{i}", daemon=True)
             t.start()
             self._threads.append(t)
 
-    def _worker_loop(self, fn: Callable) -> None:
+    def _worker_loop(self, fn: Callable, profile_this_worker: bool = False) -> None:
+        profile = None
+        if profile_this_worker:
+            import cProfile
+
+            profile = cProfile.Profile()
         while not self._stop_event.is_set():
             try:
                 item = self._in_queue.get(timeout=_POLL_S)
             except queue.Empty:
                 continue
             try:
-                result = fn(item)
+                if profile is not None:
+                    try:
+                        result = profile.runcall(fn, item)
+                    except ValueError as exc:
+                        # py3.12 allows one active profiler process-wide; if
+                        # someone else holds it (second profiling pool, or the
+                        # app itself under cProfile), degrade to unprofiled
+                        # instead of failing the read
+                        if "profiling tool" not in str(exc):
+                            raise
+                        logger.warning("Worker profiling disabled: %s", exc)
+                        profile = None
+                        result = fn(item)
+                else:
+                    result = fn(item)
             except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
                 result = _Failure(exc)
             self._put_stop_aware(self._out_queue, result)
+        if profile is not None:
+            with self._profiles_lock:
+                self._profiles.append(profile)
 
     def _put_stop_aware(self, q: "queue.Queue", value: Any) -> None:
         # reference _stop_aware_put (thread_pool.py:200-214)
@@ -217,6 +249,30 @@ class ThreadedExecutor(ExecutorBase):
             raise PetastormTpuError("call stop() before join()")
         for t in self._threads:
             t.join()
+        if self._profiling_enabled and self._profiles:
+            stats = self.profile_stats()
+            if stats is not None:
+                import io as _io
+
+                out = _io.StringIO()
+                stats.stream = out
+                stats.sort_stats("cumulative").print_stats(20)
+                logger.info("Sampled worker profile (top 20 by cumulative):\n%s",
+                            out.getvalue())
+
+    def profile_stats(self):
+        """``pstats.Stats`` of the sampled worker thread, or None when
+        profiling was off / the sampled worker ran no item yet."""
+        import pstats
+
+        with self._profiles_lock:
+            profiles = [p for p in self._profiles if p.getstats()]
+            if not profiles:
+                return None
+            stats = pstats.Stats(profiles[0])
+            for p in profiles[1:]:
+                stats.add(p)
+            return stats
 
     @property
     def diagnostics(self) -> dict:

@@ -1,0 +1,105 @@
+"""Exact-size batch assembly across input-batch boundaries.
+
+Reference parity: petastorm/pyarrow_helpers/batching_table_queue.py -
+``BatchingTableQueue`` FIFO of record batches whose ``get()`` slices exact-size
+batches spanning input-table boundaries (batching_table_queue.py:21-80).  Like
+the reference's, this is a composable building block: the Reader's own batch
+sizing goes through the shuffling-buffer engine (petastorm_tpu/shuffle.py), and
+this queue serves consumers that need strict fixed-size batches from an
+arbitrary stream of :class:`ColumnBatch`/arrow data - e.g. static-shape XLA
+feeds where a ragged final batch would trigger recompilation.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional, Union
+
+import pyarrow as pa
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.errors import PetastormTpuError
+
+
+def _to_column_batch(data) -> ColumnBatch:
+    if isinstance(data, ColumnBatch):
+        return data
+    if isinstance(data, pa.RecordBatch):
+        data = pa.Table.from_batches([data])
+    if isinstance(data, pa.Table):
+        return ColumnBatch({name: data.column(name).to_numpy(zero_copy_only=False)
+                            for name in data.column_names}, data.num_rows)
+    raise PetastormTpuError(
+        f"BatchingQueue accepts ColumnBatch/pa.Table/pa.RecordBatch, got {type(data)}")
+
+
+class BatchingQueue:
+    """FIFO that re-slices an arbitrary stream of batches into exact-size ones.
+
+    ``put`` appends any-size batches; ``get`` returns a batch of exactly
+    ``batch_size`` rows assembled across input boundaries (raises if not enough
+    rows are buffered - check :meth:`can_get`); ``flush`` drains the ragged
+    remainder.  Slices stay views until a cross-boundary assembly forces a
+    concat, mirroring the zero-copy intent of the reference
+    (batching_table_queue.py:50-78).
+    """
+
+    def __init__(self, batch_size: int):
+        if batch_size < 1:
+            raise PetastormTpuError(f"batch_size must be >= 1, got {batch_size}")
+        self._batch_size = batch_size
+        self._queue: Deque[ColumnBatch] = deque()
+        self._head_offset = 0  # rows of queue[0] already consumed
+        self._buffered = 0
+
+    def __len__(self) -> int:
+        """Rows currently buffered."""
+        return self._buffered
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    def empty(self) -> bool:
+        return self._buffered == 0
+
+    def can_get(self) -> bool:
+        return self._buffered >= self._batch_size
+
+    def put(self, data: Union[ColumnBatch, "pa.Table", "pa.RecordBatch"]) -> None:
+        batch = _to_column_batch(data)
+        if len(batch) == 0:
+            return
+        self._queue.append(batch)
+        self._buffered += len(batch)
+
+    def _take(self, nrows: int) -> ColumnBatch:
+        parts = []
+        need = nrows
+        while need > 0:
+            head = self._queue[0]
+            avail = len(head) - self._head_offset
+            take = min(avail, need)
+            parts.append(head.slice_rows(self._head_offset,
+                                         self._head_offset + take))
+            need -= take
+            self._head_offset += take
+            if self._head_offset == len(head):
+                self._queue.popleft()
+                self._head_offset = 0
+        self._buffered -= nrows
+        return parts[0] if len(parts) == 1 else ColumnBatch.concat(parts)
+
+    def get(self) -> ColumnBatch:
+        if not self.can_get():
+            raise PetastormTpuError(
+                f"BatchingQueue has {self._buffered} rows buffered; need"
+                f" {self._batch_size} (check can_get(), or flush() the tail)")
+        return self._take(self._batch_size)
+
+    def flush(self) -> Optional[ColumnBatch]:
+        """Everything still buffered as one batch (callers drain exact-size
+        batches with ``get`` first, making this the ragged tail), or None."""
+        if self._buffered == 0:
+            return None
+        return self._take(self._buffered)

@@ -1,0 +1,66 @@
+"""Spark interop: decoded-row RDD over a petastorm_tpu (or legacy) dataset.
+
+Reference parity: petastorm/spark_utils.py:23-53 - ``dataset_as_rdd`` reads the
+parquet store as a Spark DataFrame and decodes each row with the dataset schema's
+codecs on the executors, yielding schema namedtuples.
+
+pyspark is not a dependency of this package (TPU ingest does not need a JVM);
+everything here gates on its presence at call time.  The Spark *writer* path is
+:mod:`petastorm_tpu.converter` (accepts a pyspark DataFrame when available).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+from petastorm_tpu.etl.metadata import open_dataset
+from petastorm_tpu.schema import Schema
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as exc:
+        raise NotImplementedError(
+            "dataset_as_rdd requires pyspark, which is not installed. The"
+            " TPU-native consumers are make_reader/make_jax_loader; Spark"
+            " interop is optional.") from exc
+
+
+def decode_row(row: Dict[str, Any], schema: Schema) -> Dict[str, Any]:
+    """Apply each field's codec to one storage-form row dict.
+
+    Row-level analog of the columnar decode plane (petastorm_tpu/worker.py);
+    exists for executors that hand us rows, like Spark (reference
+    utils.py:54-87).
+    """
+    out = {}
+    for field in schema:
+        value = row.get(field.name)
+        out[field.name] = None if value is None else field.codec.decode(field, value)
+    return out
+
+
+def dataset_as_rdd(dataset_url: str, spark_session,
+                   schema_fields: Optional[Sequence] = None):
+    """Decoded-row RDD of schema namedtuples for a dataset.
+
+    :param dataset_url: dataset URL (any scheme Spark itself can read)
+    :param spark_session: a ``pyspark.sql.SparkSession``
+    :param schema_fields: optional field names/regexes/Field objects to subset
+    """
+    _require_pyspark()
+    info = open_dataset(dataset_url, require_stored_schema=True)
+    schema = info.stored_schema
+    df = spark_session.read.parquet(dataset_url)
+    if schema_fields is not None:
+        schema = schema.view(schema_fields)
+        df = df.select(*list(schema.fields))
+    # default arguments freeze the objects Spark must ship to executors; the
+    # lambda itself must not close over `info` (holds a live filesystem)
+    return df.rdd.map(
+        lambda row, _schema=schema: _schema.make_namedtuple(
+            **decode_row(row.asDict(), _schema)))
+
+
+__all__ = ["dataset_as_rdd", "decode_row"]

@@ -229,6 +229,41 @@ def test_resolve_and_connect_plain_host():
     assert MockConnector.connect_attempts == ["plainhost:9000"]
 
 
+def test_non_transient_errors_bypass_failover():
+    """FileNotFoundError et al. describe the file, not the connection - they
+    must surface unchanged (no reconnects) so `except FileNotFoundError`
+    callers keep working."""
+    class _FnfFs:
+        def delete_dir(self, path):
+            raise FileNotFoundError(path)
+
+    class _FnfConnector(HdfsConnector):
+        connects = 0
+
+        @classmethod
+        def connect_namenode(cls, host, port, user=None):
+            cls.connects += 1
+            return _FnfFs()
+
+    handler = hdfs_ha._HaFilesystemHandler(_FnfConnector, ["host-a:8020"], None)
+    with pytest.raises(FileNotFoundError):
+        handler.delete_dir("/gone")
+    assert _FnfConnector.connects == 1  # no failover reconnects
+
+
+def test_hdfs_url_list_paths_drop_authority():
+    """Every URL in an hdfs:// list must resolve to the same path convention
+    (the authority is a host/nameservice, never a path prefix)."""
+    from petastorm_tpu.fs import get_filesystem_and_path
+
+    sentinel_fs = object()
+    _, p = get_filesystem_and_path("hdfs://ns1/data/a.parquet", filesystem=sentinel_fs)
+    assert p == "/data/a.parquet"
+    # bucket stores keep the bucket prefix
+    _, p = get_filesystem_and_path("s3://bucket/data/a.parquet", filesystem=sentinel_fs)
+    assert p == "bucket/data/a.parquet"
+
+
 def test_resolve_url_namenodes_shared_rule():
     assert hdfs_ha.resolve_url_namenodes(
         "hdfs://nameservice1/x", HA_CONFIG) == ["host-a:8020", "host-b:8020"]

@@ -1,0 +1,231 @@
+"""BatchingQueue, pool profiling, and Spark-adapter tests.
+
+Reference models: petastorm/pyarrow_helpers/tests (batching queue slicing),
+thread-pool cProfile aggregation (workers_pool/thread_pool.py:41-49,190-198),
+and spark_utils.dataset_as_rdd (mocked - pyspark is absent here, matching how
+the reference mocks external systems, SURVEY.md section 4).
+"""
+
+import sys
+import types
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.pool import ThreadedExecutor
+from petastorm_tpu.rebatch import BatchingQueue
+
+
+# ---------------------------------------------------------------------------
+# BatchingQueue
+# ---------------------------------------------------------------------------
+
+def _cb(start, n):
+    return ColumnBatch({"x": np.arange(start, start + n),
+                        "y": np.arange(start, start + n) * 2.0}, n)
+
+
+def test_rebatch_exact_slices_across_boundaries():
+    q = BatchingQueue(batch_size=4)
+    q.put(_cb(0, 3))
+    assert not q.can_get() and len(q) == 3
+    q.put(_cb(3, 6))
+    assert q.can_get() and len(q) == 9
+    b1 = q.get()
+    np.testing.assert_array_equal(b1.columns["x"], [0, 1, 2, 3])
+    b2 = q.get()
+    np.testing.assert_array_equal(b2.columns["x"], [4, 5, 6, 7])
+    assert not q.can_get()
+    tail = q.flush()
+    np.testing.assert_array_equal(tail.columns["x"], [8])
+    assert q.empty() and q.flush() is None
+
+
+def test_rebatch_get_without_rows_raises():
+    q = BatchingQueue(batch_size=2)
+    q.put(_cb(0, 1))
+    with pytest.raises(PetastormTpuError, match="need 2"):
+        q.get()
+
+
+def test_rebatch_accepts_arrow_tables_and_record_batches():
+    q = BatchingQueue(batch_size=5)
+    t = pa.table({"x": np.arange(4), "y": np.arange(4) * 2.0})
+    q.put(t)
+    q.put(t.to_batches()[0])
+    out = q.get()
+    np.testing.assert_array_equal(out.columns["x"], [0, 1, 2, 3, 0])
+    np.testing.assert_array_equal(out.columns["y"], [0.0, 2.0, 4.0, 6.0, 0.0])
+
+
+def test_rebatch_large_single_put_yields_many():
+    q = BatchingQueue(batch_size=3)
+    q.put(_cb(0, 10))
+    got = []
+    while q.can_get():
+        got.append(q.get())
+    assert [len(b) for b in got] == [3, 3, 3]
+    assert len(q.flush()) == 1
+
+
+def test_rebatch_empty_put_ignored_and_bad_types_rejected():
+    q = BatchingQueue(batch_size=2)
+    q.put(_cb(0, 0))
+    assert q.empty()
+    with pytest.raises(PetastormTpuError, match="accepts"):
+        q.put([1, 2, 3])
+    with pytest.raises(PetastormTpuError, match="batch_size"):
+        BatchingQueue(0)
+
+
+# ---------------------------------------------------------------------------
+# Thread-pool profiling
+# ---------------------------------------------------------------------------
+
+def _work(i):
+    return sum(range(200)) + i
+
+
+def test_threadpool_profiling_samples_one_worker():
+    # py3.12 allows one active profiler process-wide, so only one worker is
+    # profiled; with concurrent slow work this must NOT raise "Another
+    # profiling tool is already active"
+    import time
+
+    def slow(i):
+        time.sleep(0.002)
+        return _work(i)
+
+    pool = ThreadedExecutor(workers_count=3, profiling_enabled=True)
+    pool.start(lambda: slow)
+    for i in range(12):
+        pool.put(i)
+    got = sorted(pool.get() for _ in range(12))
+    assert got == [sum(range(200)) + i for i in range(12)]
+    pool.stop()
+    pool.join()
+    stats = pool.profile_stats()
+    assert stats is not None
+    # the profiled workload function must appear in the sampled stats
+    assert any("_work" in str(key) for key in stats.stats)
+
+
+def test_threadpool_profiling_degrades_when_profiler_busy():
+    """If another profiler holds the process-wide slot, the pool must keep
+    producing results unprofiled instead of failing the read."""
+    import cProfile
+
+    outer = cProfile.Profile()
+    outer.enable()
+    try:
+        pool = ThreadedExecutor(workers_count=2, profiling_enabled=True)
+        pool.start(lambda: _work)
+        for i in range(6):
+            pool.put(i)
+        got = sorted(pool.get() for _ in range(6))
+        assert got == [sum(range(200)) + i for i in range(6)]
+        pool.stop()
+        pool.join()
+    finally:
+        outer.disable()
+
+
+def test_threadpool_profiling_off_by_default():
+    pool = ThreadedExecutor(workers_count=1)
+    pool.start(lambda: _work)
+    pool.put(1)
+    assert pool.get() == sum(range(200)) + 1
+    pool.stop()
+    pool.join()
+    assert pool.profile_stats() is None
+
+
+# ---------------------------------------------------------------------------
+# Spark adapter (mocked pyspark)
+# ---------------------------------------------------------------------------
+
+class _FakeRow:
+    def __init__(self, d):
+        self._d = d
+
+    def asDict(self):
+        return dict(self._d)
+
+
+class _FakeRdd:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def map(self, fn):
+        return _FakeRdd([fn(r) for r in self._rows])
+
+    def collect(self):
+        return list(self._rows)
+
+
+class _FakeDataFrame:
+    def __init__(self, rows, columns):
+        self._rows = rows
+        self._columns = columns
+
+    def select(self, *names):
+        return _FakeDataFrame(
+            [{k: r[k] for k in names} for r in self._rows], list(names))
+
+    @property
+    def rdd(self):
+        return _FakeRdd([_FakeRow(r) for r in self._rows])
+
+
+class _FakeSparkSession:
+    """Reads the parquet files with pyarrow and presents DataFrame-ish rows in
+    STORAGE form (encoded binary cells), like Spark would."""
+
+    class _Reader:
+        def parquet(self, url):
+            import pyarrow.parquet as pq
+
+            from petastorm_tpu.fs import get_filesystem_and_path
+
+            fs, path = get_filesystem_and_path(url)
+            import posixpath
+
+            sel = pa.fs.FileSelector(path, recursive=True)
+            files = sorted(f.path for f in fs.get_file_info(sel)
+                           if f.type == pa.fs.FileType.File
+                           and not posixpath.basename(f.path).startswith("_"))
+            tables = [pq.read_table(f, filesystem=fs) for f in files]
+            table = pa.concat_tables(tables)
+            rows = table.to_pylist()
+            return _FakeDataFrame(rows, table.column_names)
+
+    @property
+    def read(self):
+        return self._Reader()
+
+
+def test_dataset_as_rdd_requires_pyspark(tmp_path):
+    from petastorm_tpu import spark as spark_mod
+
+    with pytest.raises(NotImplementedError, match="pyspark"):
+        spark_mod.dataset_as_rdd(str(tmp_path), _FakeSparkSession())
+
+
+def test_dataset_as_rdd_decodes_rows(tmp_path, monkeypatch):
+    from petastorm_tpu import spark as spark_mod
+    from petastorm_tpu.test_util.synthetic import TEST_SCHEMA, create_test_dataset
+
+    url = str(tmp_path / "ds")
+    rows = create_test_dataset(url, num_rows=12, row_group_size_rows=4)
+    monkeypatch.setitem(sys.modules, "pyspark", types.ModuleType("pyspark"))
+    rdd = spark_mod.dataset_as_rdd(url, _FakeSparkSession(),
+                                   schema_fields=["id", "matrix"])
+    out = {int(r.id): r for r in rdd.collect()}
+    assert sorted(out) == sorted(int(r["id"]) for r in rows)
+    src = {int(r["id"]): r for r in rows}
+    for i, row in out.items():
+        np.testing.assert_array_equal(row.matrix, src[i]["matrix"])
+        assert not hasattr(row, "image_png")  # subset honored
