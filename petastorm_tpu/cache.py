@@ -39,6 +39,105 @@ class NullCache(CacheBase):
         return fill_cache_func()
 
 
+class InMemoryCache(CacheBase):
+    """Process-local LRU cache of decoded batches, capped by estimated bytes.
+
+    No reference analog (its only cache is disk-backed) - on TPU host VMs with
+    hundreds of GB of RAM, caching decoded columnar batches in memory turns
+    repeated epochs over medium datasets into pure memory traffic (no parquet
+    IO, no decode).  Size accounting uses ``ColumnBatch`` array nbytes when
+    available, else ``sys.getsizeof``.
+    """
+
+    def __init__(self, size_limit_bytes: int = 4 * 2 ** 30):
+        from collections import OrderedDict as _OD
+
+        self._entries: "_OD[str, Any]" = _OD()
+        self._sizes: dict = {}
+        self._size_limit = size_limit_bytes
+        self._total = 0
+        import threading
+
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _array_size(col: Any) -> int:
+        import sys as _sys
+
+        import numpy as _np
+
+        if isinstance(col, _np.ndarray):
+            if col.dtype == object:
+                # nbytes counts 8 bytes/pointer for object arrays; sum the
+                # payloads (ragged/variable-shape cells) or the cap is a no-op
+                return int(col.nbytes) + sum(
+                    int(c.nbytes) if isinstance(c, _np.ndarray)
+                    else _sys.getsizeof(c) for c in col.ravel())
+            return int(col.nbytes)
+        return _sys.getsizeof(col)
+
+    @classmethod
+    def _estimate_size(cls, value: Any) -> int:
+        import sys as _sys
+
+        columns = getattr(value, "columns", None)
+        if isinstance(columns, dict):
+            return sum(cls._array_size(col) for col in columns.values())
+        return _sys.getsizeof(value)
+
+    @staticmethod
+    def _copy_value(value: Any) -> Any:
+        """Defensive copy so in-place consumer mutations (e.g. a TransformSpec
+        normalizing pixels in place) cannot corrupt cached entries - disk
+        caches get this isolation for free from their pickle round-trip."""
+        import copy as _copy
+
+        import numpy as _np
+
+        def _copy_col(c):
+            if isinstance(c, _np.ndarray):
+                if c.dtype == object:
+                    # .copy() on an object array copies pointers only; the
+                    # cells themselves must be duplicated
+                    out = _np.empty(len(c), dtype=object)
+                    for i, cell in enumerate(c):
+                        out[i] = cell.copy() if isinstance(cell, _np.ndarray) else cell
+                    return out
+                return c.copy()
+            return _copy.deepcopy(c)
+
+        columns = getattr(value, "columns", None)
+        if isinstance(columns, dict):
+            copied = {n: _copy_col(c) for n, c in columns.items()}
+            return type(value)(copied, getattr(value, "num_rows", len(value)))
+        return _copy.deepcopy(value)
+
+    def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return self._copy_value(self._entries[key])
+        value = fill_cache_func()
+        size = self._estimate_size(value)
+        if size > self._size_limit:
+            return value  # single entry over the cap: serve uncached
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = self._copy_value(value)
+                self._sizes[key] = size
+                self._total += size
+                while self._total > self._size_limit and len(self._entries) > 1:
+                    old_key, _ = self._entries.popitem(last=False)
+                    self._total -= self._sizes.pop(old_key)
+        return value
+
+    def cleanup(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._sizes.clear()
+            self._total = 0
+
+
 class LocalDiskCache(CacheBase):
     """File-per-key pickle cache with a byte-size cap.
 
@@ -117,11 +216,14 @@ class LocalDiskCache(CacheBase):
 
 def make_cache(cache_type: str = "null", cache_location: str = None,
                cache_size_limit: int = None) -> CacheBase:
-    """'null' | 'local-disk' (reference: make_reader cache args, reader.py:126-131)."""
+    """'null' | 'local-disk' | 'memory' (reference: reader.py:126-131; 'memory'
+    is new here - decoded-batch LRU in host RAM)."""
     if cache_type in (None, "null", "none"):
         return NullCache()
     if cache_type == "local-disk":
         if not cache_location:
             cache_location = os.path.join(tempfile.gettempdir(), "petastorm_tpu_cache")
         return LocalDiskCache(cache_location, cache_size_limit or 10 * 2 ** 30)
+    if cache_type == "memory":
+        return InMemoryCache(cache_size_limit or 4 * 2 ** 30)
     raise ValueError(f"Unknown cache_type {cache_type!r}")

@@ -154,6 +154,12 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             " boundary are computed before the predicate masks rows, so"
             " windows spanning masked rows would be silently lost. Use"
             " shuffle_row_drop_partitions=1.")
+    if cache_type == "memory" and reader_pool_type == "process":
+        raise PetastormTpuError(
+            "cache_type='memory' is process-local: every spawned worker would"
+            " hold its own empty cache, giving zero hits while multiplying"
+            " memory. Use reader_pool_type='thread' (the cache is shared and"
+            " thread-safe) or cache_type='local-disk' with the process pool.")
     try:
         info = open_dataset(dataset_url, storage_options=storage_options,
                             filesystem=filesystem,

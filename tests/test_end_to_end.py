@@ -209,6 +209,43 @@ def test_local_disk_cache_roundtrip(dataset, tmp_path):
         assert ids == [r["id"] for r in rows]
 
 
+def test_memory_cache_roundtrip(dataset):
+    url, rows = dataset
+    with make_reader(url, cache_type="memory", shuffle_row_groups=False,
+                     workers_count=1, num_epochs=2) as reader:
+        ids = sorted(r.id for r in reader)
+    assert ids == sorted([r["id"] for r in rows] * 2)
+
+
+def test_memory_cache_lru_eviction_and_hits():
+    from petastorm_tpu.batch import ColumnBatch
+    from petastorm_tpu.cache import InMemoryCache
+
+    calls = {"n": 0}
+
+    def make_batch(tag):
+        def fill():
+            calls["n"] += 1
+            return ColumnBatch({"x": np.full(1000, tag, np.int64)}, 1000)
+        return fill
+
+    cache = InMemoryCache(size_limit_bytes=20_000)  # fits 2 x 8KB batches
+    cache.get("a", make_batch(1))
+    cache.get("b", make_batch(2))
+    cache.get("a", make_batch(1))          # hit
+    assert calls["n"] == 2
+    cache.get("c", make_batch(3))          # evicts 'b' (LRU)
+    cache.get("a", make_batch(1))          # still cached
+    assert calls["n"] == 3
+    cache.get("b", make_batch(2))          # miss again after eviction
+    assert calls["n"] == 4
+    # oversized entries are served uncached, not stored
+    big = InMemoryCache(size_limit_bytes=100)
+    big.get("huge", make_batch(9))
+    big.get("huge", make_batch(9))
+    assert calls["n"] == 6
+
+
 def test_cache_with_predicate_rejected(dataset, tmp_path):
     url, _ = dataset
     with pytest.raises(PetastormTpuError):
@@ -362,3 +399,46 @@ def test_diagnostics_shape(dataset):
         next(reader)
         d = reader.diagnostics
     assert "items_per_epoch" in d and d["items_per_epoch"] == 6
+
+
+def test_memory_cache_process_pool_rejected(dataset):
+    url, _ = dataset
+    with pytest.raises(PetastormTpuError, match="process-local"):
+        make_reader(url, cache_type="memory", reader_pool_type="process")
+
+
+def test_memory_cache_isolated_from_inplace_mutation():
+    from petastorm_tpu.batch import ColumnBatch
+    from petastorm_tpu.cache import InMemoryCache
+
+    cache = InMemoryCache()
+    fixed = np.arange(6, dtype=np.float64)
+    ragged = np.empty(2, dtype=object)
+    ragged[0], ragged[1] = np.ones(3), np.ones(5)
+    v1 = cache.get("k", lambda: ColumnBatch({"a": fixed[:2], "r": ragged}, 2))
+    v1.columns["a"] /= 2.0          # consumer mutates in place
+    v1.columns["r"][0] *= 100.0
+    v2 = cache.get("k", lambda: (_ for _ in ()).throw(AssertionError("miss")))
+    np.testing.assert_array_equal(v2.columns["a"], [0.0, 1.0])
+    np.testing.assert_array_equal(v2.columns["r"][0], np.ones(3))
+
+
+def test_memory_cache_object_column_sizing():
+    from petastorm_tpu.batch import ColumnBatch
+    from petastorm_tpu.cache import InMemoryCache
+
+    big = np.empty(2, dtype=object)
+    big[0] = np.zeros(300_000, np.uint8)  # 300KB payload behind 8-byte pointer
+    big[1] = np.zeros(300_000, np.uint8)
+    batch = ColumnBatch({"r": big}, 2)
+    assert InMemoryCache._estimate_size(batch) > 500_000
+    # cap smaller than the true payload: entry must be served uncached
+    cache = InMemoryCache(size_limit_bytes=100_000)
+    calls = {"n": 0}
+
+    def fill():
+        calls["n"] += 1
+        return batch
+    cache.get("k", fill)
+    cache.get("k", fill)
+    assert calls["n"] == 2

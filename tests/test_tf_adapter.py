@@ -54,3 +54,104 @@ def test_ngram_rejected(tf_dataset_url):
     with make_reader(tf_dataset_url, ngram=ngram, num_epochs=1) as reader:
         with pytest.raises(PetastormTpuError, match="NGram"):
             make_petastorm_dataset(reader)
+
+
+# ---------------------------------------------------------------------------
+# tf_tensors: TF1 graph-mode API (reference tf_utils.py:202-319)
+# ---------------------------------------------------------------------------
+
+def test_tf_tensors_graph_mode(tf_dataset_url):
+    from petastorm_tpu.tf import tf_tensors
+
+    graph = tf.Graph()
+    with graph.as_default():
+        with make_reader(tf_dataset_url, reader_pool_type="serial",
+                         shuffle_row_groups=False, num_epochs=1) as reader:
+            row_tensors = tf_tensors(reader)
+            assert row_tensors.vec.get_shape().as_list() == [3]
+            with tf.compat.v1.Session() as sess:
+                rows = [sess.run(row_tensors) for _ in range(20)]
+    assert [int(r.id) for r in rows] == list(range(20))
+    assert rows[5].name == b"row_5"
+    assert rows[5].u16 == 10 and rows[5].u16.dtype == np.int32
+    np.testing.assert_array_equal(rows[7].vec, np.full(3, 7, np.float32))
+
+
+def test_tf_tensors_with_shuffling_queue(tf_dataset_url):
+    from petastorm_tpu.tf import RANDOM_SHUFFLING_QUEUE_SIZE, tf_tensors
+
+    graph = tf.Graph()
+    with graph.as_default():
+        with make_reader(tf_dataset_url, reader_pool_type="serial",
+                         shuffle_row_groups=False, num_epochs=None) as reader:
+            row_tensors = tf_tensors(reader, shuffling_queue_capacity=10,
+                                     min_after_dequeue=2)
+            # the queue-size diagnostic node exists under the well-known name
+            size_op = graph.get_operation_by_name(RANDOM_SHUFFLING_QUEUE_SIZE)
+            assert size_op is not None
+            with tf.compat.v1.Session() as sess:
+                coord = tf.compat.v1.train.Coordinator()
+                threads = tf.compat.v1.train.start_queue_runners(sess, coord)
+                ids = [int(sess.run(row_tensors).id) for _ in range(40)]
+                coord.request_stop()
+                coord.join(threads, stop_grace_period_secs=5)
+    # infinite-epoch shuffled stream: all values legal, not a straight replay
+    assert set(ids) <= set(range(20)) and len(ids) == 40
+    assert ids[:20] != list(range(20))
+
+
+def test_tf_tensors_ngram(tf_dataset_url):
+    from petastorm_tpu.tf import tf_tensors
+
+    ngram = NGram({0: ["id", "vec"], 1: ["id"]}, 1, "id")
+    graph = tf.Graph()
+    with graph.as_default():
+        with make_reader(tf_dataset_url, reader_pool_type="serial",
+                         shuffle_row_groups=False, num_epochs=1,
+                         ngram=ngram) as reader:
+            window = tf_tensors(reader)
+            assert sorted(window) == [0, 1]
+            with tf.compat.v1.Session() as sess:
+                w = sess.run(window)
+    assert int(w[1].id) == int(w[0].id) + 1
+    np.testing.assert_array_equal(w[0].vec, np.full(3, int(w[0].id), np.float32))
+    assert not hasattr(w[1], "vec")
+
+
+def test_tf_tensors_rejects_eager(tf_dataset_url):
+    from petastorm_tpu.tf import tf_tensors
+
+    with make_reader(tf_dataset_url, num_epochs=1) as reader:
+        with pytest.raises(PetastormTpuError, match="graph"):
+            tf_tensors(reader)
+
+
+def test_tf_tensors_single_field_shuffling_queue(tf_dataset_url):
+    """1-component queues dequeue a bare Tensor; must still build and run."""
+    from petastorm_tpu.tf import tf_tensors
+
+    graph = tf.Graph()
+    with graph.as_default():
+        with make_reader(tf_dataset_url, schema_fields=["id"],
+                         reader_pool_type="serial", shuffle_row_groups=False,
+                         num_epochs=None) as reader:
+            row_tensors = tf_tensors(reader, shuffling_queue_capacity=8,
+                                     min_after_dequeue=2)
+            with tf.compat.v1.Session() as sess:
+                coord = tf.compat.v1.train.Coordinator()
+                threads = tf.compat.v1.train.start_queue_runners(sess, coord)
+                vals = [int(sess.run(row_tensors).id) for _ in range(10)]
+                coord.request_stop()
+                coord.join(threads, stop_grace_period_secs=5)
+    assert set(vals) <= set(range(20))
+
+
+def test_tf_tensors_batched_shuffling_rejected(tf_dataset_url):
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.tf import tf_tensors
+
+    graph = tf.Graph()
+    with graph.as_default():
+        with make_batch_reader(tf_dataset_url, num_epochs=1) as reader:
+            with pytest.raises(PetastormTpuError, match="rowgroup batches"):
+                tf_tensors(reader, shuffling_queue_capacity=100)
