@@ -90,7 +90,8 @@ class JaxDataLoader:
                  prefetch: int = 2,
                  keep_wide_dtypes: bool = False,
                  transform_fn: Optional[Callable[[Dict[str, np.ndarray]],
-                                                 Dict[str, np.ndarray]]] = None):
+                                                 Dict[str, np.ndarray]]] = None,
+                 trace_dir: Optional[str] = None):
         self._reader = reader
         self._mesh = mesh
         self._specs = shardings
@@ -138,6 +139,10 @@ class JaxDataLoader:
         self._finished = False
         self._failure: Optional[BaseException] = None
         self._delivered_batches = 0
+        #: when set, a jax.profiler trace (device + host ingest activity,
+        #: viewable in TensorBoard/Perfetto) brackets the loader's lifetime
+        self._trace_dir = trace_dir
+        self._tracing = False
         #: per-(field, trailing-shape) cache of (sharding, local slice) - static
         #: for the loader's lifetime, rebuilt per batch otherwise
         self._placement_cache: Dict[Tuple[str, Tuple[int, ...]],
@@ -283,10 +288,29 @@ class JaxDataLoader:
 
     # -- consumer -------------------------------------------------------------
 
+    @property
+    def diagnostics(self) -> Dict:
+        """Per-stage queue depths + reader diagnostics (SURVEY.md section 5:
+        the TPU build's observability story).  ``prefetch_depth`` near
+        capacity = host pipeline keeps up; near 0 = device is input-bound."""
+        out = {"prefetch_depth": self._out.qsize(),
+               "prefetch_capacity": self._out.maxsize,
+               "delivered_batches": self._delivered_batches,
+               "finished": self._finished}
+        reader_diag = getattr(self._reader, "diagnostics", None)
+        if isinstance(reader_diag, dict):
+            out["reader"] = reader_diag
+        return out
+
     def __iter__(self):
         if not self._started:
             self._started = True
             self._thread.start()
+            if self._trace_dir:
+                # after thread start: a start_trace failure (e.g. another trace
+                # already active process-wide) must leave a working loader
+                jax.profiler.start_trace(self._trace_dir)
+                self._tracing = True
         return self
 
     def __next__(self) -> Dict[str, jax.Array]:
@@ -316,9 +340,11 @@ class JaxDataLoader:
                         raise self._failure
         if isinstance(value, _Done):
             self._finished = True
+            self._stop_trace()  # exhaustion flushes the trace without stop()
             raise StopIteration
         if isinstance(value, _Error):
             self._failure = value.exc
+            self._stop_trace()
             raise value.exc
         self._delivered_batches += 1
         return value
@@ -344,9 +370,18 @@ class JaxDataLoader:
 
     # -- lifecycle ------------------------------------------------------------
 
+    def _stop_trace(self) -> None:
+        if self._tracing:
+            self._tracing = False
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as exc:  # no trace running (stopped elsewhere)
+                logger.debug("stop_trace: %s", exc)
+
     def stop(self) -> None:
         self._stop_event.set()
         self._reader.stop()
+        self._stop_trace()
 
     def join(self) -> None:
         if self._started:

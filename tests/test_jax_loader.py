@@ -281,3 +281,45 @@ def test_jit_consumes_sharded_batch(num_ds, devices):
         b = next(iter(loader))
         out = step(b["vec"])
     assert np.isfinite(float(out))
+
+
+def test_loader_diagnostics_and_trace(num_ds, tmp_path):
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    url, _ = num_ds
+    trace_dir = str(tmp_path / "jax_trace")
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           num_epochs=1) as reader:
+        with JaxDataLoader(reader, batch_size=8, fields=["idx", "vec"],
+                           trace_dir=trace_dir) as loader:
+            n = sum(1 for _ in loader)
+            diag = loader.diagnostics
+    assert n > 0
+    assert diag["delivered_batches"] == n
+    assert diag["prefetch_capacity"] >= 1
+    assert "reader" in diag
+    import os
+
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir)  # trace written
+
+
+def test_trace_flushed_on_exhaustion_without_stop(num_ds, tmp_path):
+    # plain `for b in loader` with no context manager: exhausting the iterator
+    # must stop the process-wide jax trace (else a later start_trace raises)
+    import os
+
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    url, _ = num_ds
+    trace_dir = str(tmp_path / "jax_trace_exhaust")
+    reader = make_batch_reader(url, shuffle_row_groups=False, num_epochs=1)
+    loader = JaxDataLoader(reader, batch_size=8, fields=["idx"],
+                           trace_dir=trace_dir)
+    n = sum(1 for _ in loader)
+    assert n > 0
+    assert not loader._tracing
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir)
+    loader.stop()  # idempotent after exhaustion
+    loader.join()
