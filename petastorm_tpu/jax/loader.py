@@ -143,6 +143,8 @@ class JaxDataLoader:
         #: viewable in TensorBoard/Perfetto) brackets the loader's lifetime
         self._trace_dir = trace_dir
         self._tracing = False
+        #: producer has queued its _Done/_Error end-of-stream marker
+        self._sentinel_pending = False
         #: per-(field, trailing-shape) cache of (sharding, local slice) - static
         #: for the loader's lifetime, rebuilt per batch otherwise
         self._placement_cache: Dict[Tuple[str, Tuple[int, ...]],
@@ -230,8 +232,10 @@ class JaxDataLoader:
                     continue  # partial tail batch dropped
                 self._emit(out)
             self._push(_Done())
+            self._sentinel_pending = True
         except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
             self._push(_Error(exc))
+            self._sentinel_pending = True
 
     def _emit(self, host_batch: ColumnBatch) -> None:
         cols = {n: host_batch.columns[n] for n in self._fields}
@@ -293,7 +297,10 @@ class JaxDataLoader:
         """Per-stage queue depths + reader diagnostics (SURVEY.md section 5:
         the TPU build's observability story).  ``prefetch_depth`` near
         capacity = host pipeline keeps up; near 0 = device is input-bound."""
-        out = {"prefetch_depth": self._out.qsize(),
+        depth = self._out.qsize()
+        if self._sentinel_pending:  # end-of-stream marker is not a batch
+            depth = max(depth - 1, 0)
+        out = {"prefetch_depth": depth,
                "prefetch_capacity": self._out.maxsize,
                "delivered_batches": self._delivered_batches,
                "finished": self._finished}
@@ -307,10 +314,14 @@ class JaxDataLoader:
             self._started = True
             self._thread.start()
             if self._trace_dir:
-                # after thread start: a start_trace failure (e.g. another trace
-                # already active process-wide) must leave a working loader
-                jax.profiler.start_trace(self._trace_dir)
-                self._tracing = True
+                try:
+                    jax.profiler.start_trace(self._trace_dir)
+                    self._tracing = True
+                except (RuntimeError, OSError) as exc:
+                    # another trace already active process-wide, or unwritable
+                    # dir: iterate untraced rather than fail the ingest
+                    logger.warning("trace_dir=%r: could not start jax trace:"
+                                   " %s", self._trace_dir, exc)
         return self
 
     def __next__(self) -> Dict[str, jax.Array]:
@@ -337,13 +348,16 @@ class JaxDataLoader:
                     except queue.Empty:
                         self._failure = PetastormTpuError(
                             "Loader producer thread died silently")
+                        self._stop_trace()
                         raise self._failure
         if isinstance(value, _Done):
             self._finished = True
+            self._sentinel_pending = False
             self._stop_trace()  # exhaustion flushes the trace without stop()
             raise StopIteration
         if isinstance(value, _Error):
             self._failure = value.exc
+            self._sentinel_pending = False
             self._stop_trace()
             raise value.exc
         self._delivered_batches += 1

@@ -1,0 +1,102 @@
+"""URL -> filesystem resolution unit tests.
+
+Reference analog: petastorm/tests/test_fs_utils.py (FilesystemResolver scheme
+handling, multi-URL validation fs_utils.py:199-228, serializable factory).
+"""
+
+import pickle
+
+import pyarrow as pa
+import pyarrow.fs as pafs
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.fs import (FilesystemFactory, get_filesystem_and_path,
+                              get_filesystem_and_path_or_paths,
+                              normalize_dir_url)
+
+
+def test_normalize_dir_url():
+    assert normalize_dir_url("file:///tmp/ds/") == "file:///tmp/ds"
+    assert normalize_dir_url("/tmp/ds///") == "/tmp/ds"
+    assert normalize_dir_url("/") == "/"
+    with pytest.raises(PetastormTpuError):
+        normalize_dir_url(123)
+
+
+def test_local_no_scheme(tmp_path):
+    fs, path = get_filesystem_and_path(str(tmp_path))
+    assert isinstance(fs, pafs.LocalFileSystem)
+    assert path == str(tmp_path)
+
+
+def test_local_file_scheme(tmp_path):
+    fs, path = get_filesystem_and_path(f"file://{tmp_path}")
+    assert isinstance(fs, pafs.LocalFileSystem)
+    assert path == str(tmp_path)
+    # resolved fs actually works
+    (tmp_path / "x").write_text("hi")
+    assert fs.get_file_info(path + "/x").type == pafs.FileType.File
+
+
+def test_explicit_filesystem_path_conventions():
+    fs = pafs.LocalFileSystem()
+    # bucket-style scheme: bucket is part of the path
+    got_fs, path = get_filesystem_and_path("s3://bucket/key/ds", filesystem=fs)
+    assert got_fs is fs and path == "bucket/key/ds"
+    got_fs, path = get_filesystem_and_path("gs://bucket/ds", filesystem=fs)
+    assert got_fs is fs and path == "bucket/ds"
+    # hdfs authority is a host/nameservice, NOT part of the path
+    got_fs, path = get_filesystem_and_path("hdfs://ns1/user/ds", filesystem=fs)
+    assert got_fs is fs and path == "/user/ds"
+    # schemeless: path passed through
+    got_fs, path = get_filesystem_and_path("/plain/path", filesystem=fs)
+    assert got_fs is fs and path == "/plain/path"
+
+
+def test_fsspec_fallback_scheme():
+    # 'memory' is not a pyarrow-native scheme; resolution must fall through to
+    # fsspec wrapped in PyFileSystem
+    import fsspec
+
+    mem = fsspec.filesystem("memory")
+    mem.pipe("/probe/a.bin", b"data")
+    fs, path = get_filesystem_and_path("memory://probe/a.bin")
+    assert isinstance(fs, pafs.PyFileSystem)
+    with fs.open_input_file(path) as f:
+        assert f.read() == b"data"
+
+
+def test_unresolvable_scheme_error_mentions_both_causes():
+    with pytest.raises(PetastormTpuError, match="pyarrow said.*fsspec said"):
+        get_filesystem_and_path("no-such-scheme://whatever/ds")
+
+
+def test_multi_url_resolution(tmp_path):
+    urls = [f"file://{tmp_path}/a", f"file://{tmp_path}/b"]
+    fs, paths = get_filesystem_and_path_or_paths(urls)
+    assert isinstance(fs, pafs.LocalFileSystem)
+    assert paths == [f"{tmp_path}/a", f"{tmp_path}/b"]
+    # single string in -> single path out
+    fs, path = get_filesystem_and_path_or_paths(urls[0])
+    assert path == f"{tmp_path}/a"
+
+
+def test_multi_url_mixed_schemes_rejected(tmp_path):
+    with pytest.raises(PetastormTpuError, match="share scheme"):
+        get_filesystem_and_path_or_paths([f"file://{tmp_path}/a", "s3://b/c"])
+    with pytest.raises(PetastormTpuError, match="[Ee]mpty"):
+        get_filesystem_and_path_or_paths([])
+
+
+def test_filesystem_factory_pickles(tmp_path):
+    factory = FilesystemFactory(f"file://{tmp_path}/ds/")
+    assert factory.url == f"file://{tmp_path}/ds"  # normalized
+    clone = pickle.loads(pickle.dumps(factory))
+    assert isinstance(clone(), pafs.LocalFileSystem)
+
+
+def test_filesystem_factory_explicit_fs_returned_verbatim():
+    fs = pafs.LocalFileSystem()
+    factory = FilesystemFactory("anything://x/y", filesystem=fs)
+    assert factory() is fs
