@@ -100,6 +100,10 @@ class Codec(ABC):
     codec_name: str = ""
     #: True when petastorm_tpu.ops has an on-device decode kernel for this codec.
     device_decodable: bool = False
+    #: True when encoded cells are already entropy-coded (PNG/JPEG/deflate):
+    #: the writer then stores the column UNCOMPRESSED - parquet-level snappy
+    #: over such bytes saves nothing and costs a decompress pass on every read
+    precompressed: bool = False
 
     @abstractmethod
     def storage_type(self, field) -> pa.DataType:
@@ -286,6 +290,7 @@ class CompressedNdarrayCodec(Codec):
     """
 
     codec_name = "compressed_ndarray"
+    precompressed = True
 
     def storage_type(self, field) -> pa.DataType:
         return pa.binary()
@@ -356,6 +361,7 @@ class CompressedImageCodec(Codec):
 
     codec_name = "compressed_image"
     device_decodable = True
+    precompressed = True
 
     def __init__(self, image_codec: str = "png", quality: int = 80):
         if image_codec not in ("png", "jpeg", "jpg"):

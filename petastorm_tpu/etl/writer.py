@@ -24,7 +24,7 @@ import json
 import logging
 import posixpath
 import uuid
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 import pyarrow as pa
@@ -41,6 +41,16 @@ from petastorm_tpu.schema import SCHEMA_METADATA_KEY, Schema, insert_explicit_nu
 logger = logging.getLogger(__name__)
 
 DEFAULT_ROW_GROUP_SIZE_MB = 32  # reference default: row_group_size_mb (dataset_metadata.py:62)
+
+
+def default_compression(schema: Schema, exclude: Optional[set] = None
+                        ) -> Dict[str, str]:
+    """Per-column parquet codecs: snappy, but UNCOMPRESSED for fields whose
+    codec already emits entropy-coded bytes (``Codec.precompressed``)."""
+    exclude = exclude or set()
+    return {f.name: ("NONE" if getattr(f.codec, "precompressed", False)
+                     else "SNAPPY")
+            for f in schema if f.name not in exclude}
 
 
 def _encode_chunk(schema: Schema, file_schema: pa.Schema,
@@ -69,7 +79,8 @@ def write_dataset(url: str,
                   filesystem: Optional[pafs.FileSystem] = None,
                   storage_options: Optional[dict] = None,
                   stamp_metadata: bool = True,
-                  mode: str = "error") -> List[str]:
+                  mode: str = "error",
+                  compression: Optional[Union[str, Dict[str, str]]] = None) -> List[str]:
     """Encode + write rows as a petastorm_tpu parquet dataset; returns file paths.
 
     ``partition_by`` names scalar fields materialized as hive ``key=value``
@@ -80,6 +91,11 @@ def write_dataset(url: str,
     (default; silently mixing old and new rows is almost never intended),
     ``"overwrite"`` (delete existing contents first), or ``"append"`` (add new
     part files; the metadata stamp is refreshed to cover old + new).
+
+    ``compression``: parquet codec name, or {column: codec} dict.  Default:
+    snappy, except columns whose field codec is ``precompressed`` (PNG/JPEG
+    images, compressed ndarrays) are stored UNCOMPRESSED - re-compressing
+    entropy-coded bytes saves nothing and costs a decompress pass per read.
     """
     if mode not in ("error", "overwrite", "append"):
         raise ValueError(f"mode must be 'error', 'overwrite' or 'append',"
@@ -110,6 +126,8 @@ def write_dataset(url: str,
     file_schema = pa.schema([storage.field(f.name) for f in schema
                              if f.name not in set(partition_by)],
                             metadata={SCHEMA_METADATA_KEY: schema.to_json()})
+    if compression is None:
+        compression = default_compression(schema, exclude=set(partition_by))
 
     writers: Dict[str, pq.ParquetWriter] = {}
     files: List[str] = []
@@ -123,7 +141,12 @@ def write_dataset(url: str,
             fs.create_dir(subdir, recursive=True)
             fname = f"{file_prefix}-{len(files):05d}-{uuid.uuid4().hex[:8]}.parquet"
             path = posixpath.join(subdir, fname)
-            writers[key] = pq.ParquetWriter(path, file_schema, filesystem=fs)
+            # page checksums are the storage-integrity layer: the image codec's
+            # native decoder skips in-stream PNG CRCs, so corruption detection
+            # belongs here (verified on read via verify_checksums=True)
+            writers[key] = pq.ParquetWriter(path, file_schema, filesystem=fs,
+                                            compression=compression,
+                                            write_page_checksum=True)
             files.append(path)
             rows_written[key] = 0
         return writers[key]

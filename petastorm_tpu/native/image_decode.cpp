@@ -27,14 +27,20 @@
 namespace {
 
 // ---------------------------------------------------------------------------
-// PNG via the libpng 1.6 "simplified" API: handles bit-depth/palette/alpha
-// conversion to the requested format in one call.
-// ---------------------------------------------------------------------------
-// Color-source -> grayscale-target PNG decode via the full libpng API with
+// PNG via the full libpng 1.6 API (not the "simplified" one): full control
+// over transforms and CRC policy.  Color-source -> grayscale-target uses
 // png_set_rgb_to_gray(0.299, 0.587, 0.114) - the exact call OpenCV's PNG
 // reader makes for IMREAD_GRAYSCALE - so native and cv2 fallback paths yield
 // bit-identical tensors.  (The simplified API's PNG_FORMAT_GRAY uses libpng's
 // default BT.709 + gamma handling, which differs by up to ~50/255.)
+//
+// In-stream CRC checking is skipped (PNG_CRC_QUIET_USE): inflate of
+// incompressible image data is near-memcpy speed, leaving CRC as a large
+// fraction of decode time.  Storage integrity is the parquet layer's job -
+// the writer stamps page checksums (etl/writer.py) and the reader can verify
+// them (make_reader(verify_checksums=True)); a decode-time CRC on every read
+// would re-pay that cost on the hot path.
+// ---------------------------------------------------------------------------
 struct PngMemSrc {
   const uint8_t* data;
   size_t len;
@@ -51,8 +57,16 @@ void png_mem_read(png_structp png, png_bytep dst, png_size_t n) {
   s->pos += n;
 }
 
-int decode_png_gray_cv2(const uint8_t* src, size_t len, uint8_t* out,
-                        int height, int width) {
+// special setup() return: re-dispatch to the cv2-gray path (not an error)
+constexpr int kPngRedirectGray = 1;
+
+// Shared full-API read skeleton: open + mem source + CRC policy + dimension
+// check, then the caller's transform setup (given the source color_type),
+// then rowbytes validation and the row read.  Any libpng error longjmps to
+// the setjmp here and returns -5.
+template <typename SetupFn>
+int read_png(const uint8_t* src, size_t len, uint8_t* out, int height,
+             int width, size_t stride, SetupFn setup) {
   png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr,
                                            nullptr, nullptr);
   if (!png) return -2;
@@ -64,26 +78,29 @@ int decode_png_gray_cv2(const uint8_t* src, size_t len, uint8_t* out,
   // fully built before setjmp: longjmp must not skip over mutations of
   // non-volatile locals
   std::vector<png_bytep> rows(height);
-  for (int y = 0; y < height; ++y) rows[y] = out + (size_t)y * width;
+  for (int y = 0; y < height; ++y) rows[y] = out + (size_t)y * stride;
+  int rc = 0;
   if (setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     return -5;
   }
   PngMemSrc mem{src, len, 0};
   png_set_read_fn(png, &mem, png_mem_read);
+  png_set_crc_action(png, PNG_CRC_QUIET_USE, PNG_CRC_QUIET_USE);
   png_read_info(png, info);
   if ((int)png_get_image_width(png, info) != width ||
       (int)png_get_image_height(png, info) != height) {
     png_destroy_read_struct(&png, &info, nullptr);
     return -3;
   }
-  png_set_expand(png);    // palette->rgb, low-bit gray->8, tRNS->alpha
-  png_set_strip_16(png);  // 16-bit->8-bit
-  png_set_strip_alpha(png);
-  // (red, green) weights; blue is implicitly 1 - red - green = 0.114
-  png_set_rgb_to_gray(png, PNG_ERROR_ACTION_NONE, 0.299, 0.587);
+  rc = setup(png, png_get_color_type(png, info));
+  if (rc != 0) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return rc;
+  }
+  (void)png_set_interlace_handling(png);
   png_read_update_info(png, info);
-  if (png_get_rowbytes(png, info) != (size_t)width) {
+  if (png_get_rowbytes(png, info) != stride) {
     png_destroy_read_struct(&png, &info, nullptr);
     return -4;
   }
@@ -92,36 +109,42 @@ int decode_png_gray_cv2(const uint8_t* src, size_t len, uint8_t* out,
   return 0;
 }
 
+int decode_png_gray_cv2(const uint8_t* src, size_t len, uint8_t* out,
+                        int height, int width) {
+  return read_png(src, len, out, height, width, (size_t)width,
+                  [](png_structp png, png_byte) {
+                    png_set_expand(png);    // palette->rgb, low-bit gray->8
+                    png_set_strip_16(png);  // 16-bit->8-bit
+                    png_set_strip_alpha(png);
+                    // (red, green) weights; blue = 1 - red - green = 0.114
+                    png_set_rgb_to_gray(png, PNG_ERROR_ACTION_NONE, 0.299,
+                                        0.587);
+                    return 0;
+                  });
+}
+
 int decode_png(const uint8_t* src, size_t len, uint8_t* out, int height,
                int width, int channels) {
-  png_image image;
-  std::memset(&image, 0, sizeof(image));
-  image.version = PNG_IMAGE_VERSION;
-  if (!png_image_begin_read_from_memory(&image, src, len)) return -2;
-  if ((int)image.width != width || (int)image.height != height) {
-    png_image_free(&image);
-    return -3;
-  }
-  // After begin_read, image.format describes the file's native format.
-  const bool src_color = (image.format & PNG_FORMAT_FLAG_COLOR) != 0;
-  if (channels == 1 && src_color) {
-    png_image_free(&image);
+  if (channels != 1 && channels != 3 && channels != 4) return -4;
+  int rc = read_png(
+      src, len, out, height, width, (size_t)width * channels,
+      [channels](png_structp png, png_byte color_type) {
+        if (channels == 1 && (color_type & PNG_COLOR_MASK_COLOR))
+          return kPngRedirectGray;  // needs cv2-matching gray weights
+        png_set_expand(png);    // palette->rgb, low-bit gray->8, tRNS->alpha
+        png_set_strip_16(png);  // 16-bit->8-bit
+        if (channels >= 3) png_set_gray_to_rgb(png);
+        if (channels == 4) {
+          if (!(color_type & PNG_COLOR_MASK_ALPHA))
+            png_set_add_alpha(png, 0xFF, PNG_FILLER_AFTER);
+        } else {
+          png_set_strip_alpha(png);
+        }
+        return 0;
+      });
+  if (rc == kPngRedirectGray)
     return decode_png_gray_cv2(src, len, out, height, width);
-  }
-  image.format = (channels == 3)   ? PNG_FORMAT_RGB
-                 : (channels == 1) ? PNG_FORMAT_GRAY
-                 : (channels == 4) ? PNG_FORMAT_RGBA
-                                   : 0;
-  if (image.format == 0 && channels != 1) {
-    png_image_free(&image);
-    return -4;
-  }
-  if (!png_image_finish_read(&image, nullptr, out,
-                             width * channels /* row_stride */, nullptr)) {
-    png_image_free(&image);
-    return -5;
-  }
-  return 0;
+  return rc;
 }
 
 // ---------------------------------------------------------------------------

@@ -75,6 +75,7 @@ def make_reader(dataset_url: str,
                 storage_options: Optional[dict] = None,
                 filesystem=None,
                 resume_from: Optional[dict] = None,
+                verify_checksums: bool = False,
                 ngram=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -89,7 +90,8 @@ def make_reader(dataset_url: str,
                              shard_mode, cache_type, cache_location, cache_size_limit,
                              transform_spec, storage_options, filesystem,
                              batched_output=False, require_stored_schema=True,
-                             resume_from=resume_from, ngram=ngram)
+                             resume_from=resume_from, ngram=ngram,
+                             verify_checksums=verify_checksums)
 
 
 def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
@@ -113,6 +115,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       storage_options: Optional[dict] = None,
                       filesystem=None,
                       resume_from: Optional[dict] = None,
+                      verify_checksums: bool = False,
                       ngram=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
@@ -127,7 +130,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              shard_mode, cache_type, cache_location, cache_size_limit,
                              transform_spec, storage_options, filesystem,
                              batched_output=True, require_stored_schema=False,
-                             resume_from=resume_from, ngram=ngram)
+                             resume_from=resume_from, ngram=ngram,
+                             verify_checksums=verify_checksums)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -137,7 +141,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       shard_mode, cache_type, cache_location, cache_size_limit,
                       transform_spec, storage_options, filesystem,
                       batched_output, require_stored_schema,
-                      resume_from: Optional[dict] = None, ngram=None) -> "Reader":
+                      resume_from: Optional[dict] = None, ngram=None,
+                      verify_checksums: bool = False) -> "Reader":
     if ngram is not None and batched_output:
         raise PetastormTpuError(
             "NGram is not supported by make_batch_reader (reference parity,"
@@ -238,7 +243,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
                                    predicate=worker_predicate,
                                    transform=transform_spec, cache=cache,
-                                   ngram=ngram, ngram_schema=ngram_schema)
+                                   ngram=ngram, ngram_schema=ngram_schema,
+                                   verify_checksums=verify_checksums)
 
     executor = make_executor(reader_pool_type, workers_count, results_queue_size)
     start_item = 0

@@ -50,7 +50,8 @@ class RowGroupDecoderWorker:
                  transform: Optional[TransformSpec] = None,
                  cache: Optional[CacheBase] = None,
                  ngram=None,
-                 ngram_schema: Optional[Schema] = None):
+                 ngram_schema: Optional[Schema] = None,
+                 verify_checksums: bool = False):
         self._fs_factory = fs_factory
         self._schema = schema
         self._read_fields = list(read_fields)
@@ -60,6 +61,7 @@ class RowGroupDecoderWorker:
         self._cache_prefix = hashlib.md5(fs_factory.url.encode()).hexdigest()
         self._ngram = ngram
         self._ngram_schema = ngram_schema or schema
+        self._verify_checksums = verify_checksums
 
     # -- factory protocol -----------------------------------------------------
 
@@ -76,7 +78,8 @@ class RowGroupDecoderWorker:
                 if len(open_files) >= _MAX_OPEN_FILES:
                     oldest = next(iter(open_files))
                     open_files.pop(oldest)[0].close()
-                pf = pq.ParquetFile(fs.open_input_file(path))
+                pf = pq.ParquetFile(fs.open_input_file(path),
+                                    page_checksum_verification=self._verify_checksums)
                 entry = (pf, set(pf.schema_arrow.names))
                 open_files[path] = entry
             return entry
