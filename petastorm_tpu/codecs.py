@@ -353,10 +353,12 @@ class CompressedImageCodec(Codec):
     Reference: petastorm/codecs.py:53-118 - including the RGB<->BGR swap for
     3-channel images (cv2 is BGR-native) so stored streams are standard RGB files.
 
-    TPU path: ``device_decodable`` is True for the normalize stage - the JAX loader
-    can keep decode on host but fuse uint8->float normalize on-chip
-    (petastorm_tpu/ops/normalize.py); full on-device JPEG decode is the
-    BASELINE.json north star and lands in ops/image.py.
+    TPU path (``device_decodable``): the JAX loader can fuse uint8->float
+    normalize on-chip (petastorm_tpu/ops/normalize.py), and jpeg fields support
+    full hybrid decode - ``make_reader(..., decode_placement={'field': 'device'})``
+    ships raw streams, the host runs only entropy decode, and dequant + IDCT +
+    upsample + color run on the TPU (petastorm_tpu/ops/jpeg.py; the
+    BASELINE.json north star).
     """
 
     codec_name = "compressed_image"

@@ -39,6 +39,7 @@ import threading
 from typing import Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -101,6 +102,9 @@ class JaxDataLoader:
         self._keep_wide = keep_wide_dtypes
         self._transform_fn = transform_fn
         self._host_fields = list(host_fields)
+        #: fields arriving as raw jpeg bytes (reader decode_placement='device');
+        #: decoded on-chip in _emit via ops/jpeg.decode_coefficients
+        self._device_decode = list(getattr(reader, "device_decode_fields", ()) or ())
 
         # output_schema describes the columns iter_batches actually yields
         # (differs from reader.schema for ngram readers)
@@ -154,6 +158,8 @@ class JaxDataLoader:
 
     def _validate_deliverable(self, schema) -> None:
         for name in self._fields:
+            if name in self._device_decode:
+                continue  # raw jpeg bytes in, schema-shaped uint8 out (on-chip)
             field = schema[name]
             if field.dtype.kind in ("U", "S", "O", "M", "m"):
                 raise PetastormTpuError(
@@ -239,10 +245,15 @@ class JaxDataLoader:
 
     def _emit(self, host_batch: ColumnBatch) -> None:
         cols = {n: host_batch.columns[n] for n in self._fields}
+        # raw jpeg-bytes columns go through the hybrid decode path, not the
+        # generic pad/transfer below (object arrays cannot be zero-padded)
+        raw_cols = {n: cols.pop(n) for n in self._device_decode if n in cols}
         if self._transform_fn is not None:
             cols = self._transform_fn(cols)
         device_batch = {}
         valid_rows = host_batch.num_rows
+        for name, raw in raw_cols.items():
+            device_batch[name] = self._decode_on_device(name, raw)
         if self._mesh is not None and valid_rows < self._local_rows:
             # partial final batch on a mesh: zero-pad to the static local batch so
             # the global shape (and the consumer's jit signature) never changes -
@@ -269,6 +280,76 @@ class JaxDataLoader:
         if self._mesh is not None and valid_rows < self._local_rows:
             device_batch["_valid_rows"] = valid_rows
         self._push(device_batch)
+
+    def _decode_on_device(self, name: str, raw_col: np.ndarray) -> jax.Array:
+        """Hybrid jpeg decode of one raw-bytes column (decode_placement='device').
+
+        Host runs only libjpeg's entropy decoder (one GIL-released C call);
+        the coefficient planes ship to the device(s) batch-sharded and the
+        FLOP-heavy dequant + IDCT + upsample + color runs on-chip, sharded,
+        with no cross-shard communication (petastorm_tpu/ops/jpeg.py).
+        """
+        from petastorm_tpu.errors import CodecError
+        from petastorm_tpu.native.image import read_jpeg_coefficients_column
+        from petastorm_tpu.ops.jpeg import decode_coefficients, decode_from_layout
+
+        field = self._schema[name]
+        cells = list(raw_col)
+        try:
+            planes, qtabs, layout = read_jpeg_coefficients_column(cells)
+        except CodecError as exc:
+            # mixed subsampling/geometry inside one batch (e.g. encoder
+            # settings changed mid-dataset): decode this batch on host
+            logger.warning("device decode of %r fell back to host for one"
+                           " batch: %s", name, exc)
+            return self._host_decode_fallback(field, cells)
+        if (layout.height, layout.width) != tuple(field.shape[:2]):
+            raise CodecError(
+                f"field {name!r}: stored jpeg is {layout.height}x{layout.width},"
+                f" schema says {tuple(field.shape[:2])}")
+        sampling = tuple((h, v) for (h, v, _, _) in layout.components)
+        if self._mesh is None:
+            out = decode_from_layout(planes, qtabs, layout)
+        else:
+            if len(cells) < self._local_rows:
+                # zero coefficient blocks decode to flat gray padding rows
+                # ('_valid_rows' marks how many are real, as for host fields)
+                pad = self._local_rows - len(cells)
+                planes = [np.concatenate(
+                    [p, np.zeros((pad,) + p.shape[1:], p.dtype)]) for p in planes]
+                qtabs = np.concatenate(
+                    [qtabs, np.ones((pad,) + qtabs.shape[1:], qtabs.dtype)])
+            spec = self._spec_for(name)
+            batch_sharding = NamedSharding(
+                self._mesh, PartitionSpec(spec[0] if len(spec) else None))
+            jp = tuple(jax.make_array_from_process_local_data(
+                batch_sharding, p, (self._global_batch,) + p.shape[1:])
+                for p in planes)
+            jq = jax.make_array_from_process_local_data(
+                batch_sharding, qtabs, (self._global_batch,) + qtabs.shape[1:])
+            out = decode_coefficients(jp, jq,
+                                      image_size=(layout.height, layout.width),
+                                      sampling=sampling)
+            if any(ax is not None for ax in spec[1:]):
+                # user sharded trailing image axes too: reshard once on device
+                out = jax.device_put(out, NamedSharding(self._mesh, spec))
+        if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 3:
+            out = out[..., None]  # honor a declared (H, W, 1) grayscale shape
+        return out
+
+    def _host_decode_fallback(self, field, cells) -> jax.Array:
+        """Per-image host decode of one batch (mixed-geometry escape hatch)."""
+        out = np.stack([field.codec.decode(field, c) for c in cells])
+        if self._mesh is None:
+            return jax.device_put(out)
+        if len(cells) < self._local_rows:
+            pad = self._local_rows - len(cells)
+            out = np.concatenate(
+                [out, np.zeros((pad,) + out.shape[1:], out.dtype)])
+        sharding, sl, global_shape = self._placement_for(field.name,
+                                                         out.shape[1:])
+        return jax.make_array_from_process_local_data(
+            sharding, out[(slice(None),) + sl[1:]], global_shape)
 
     def _placement_for(self, name: str, trailing: Tuple[int, ...]
                        ) -> Tuple[NamedSharding, Tuple[slice, ...], Tuple[int, ...]]:

@@ -36,6 +36,25 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
     ]
+    lib.pst_jpeg_coef_layout.restype = ctypes.c_int
+    lib.pst_jpeg_coef_layout.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p,
+    ]
+    lib.pst_jpeg_read_coefs.restype = ctypes.c_int
+    lib.pst_jpeg_read_coefs.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.pst_jpeg_coef_batch.restype = ctypes.c_int
+    lib.pst_jpeg_coef_batch.argtypes = [
+        ctypes.c_void_p,  # const uint8_t* const* srcs (uint64 array)
+        ctypes.c_void_p,  # const uint64_t* lens
+        ctypes.c_int,     # n
+        ctypes.c_void_p,  # int16_t* const* outs
+        ctypes.c_void_p,  # const uint64_t* plane_strides
+        ctypes.c_void_p,  # uint16_t* qtabs
+        ctypes.c_void_p,  # const int32_t* meta
+        ctypes.c_int,     # nthreads
+    ]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -112,3 +131,136 @@ def decode_column_native(column, out: np.ndarray, nthreads: int = 1) -> bool:
             f"native image decode failed at cell {rc - 1} (expected shape "
             f"({h}, {w}, {c}) uint8; corrupt stream or shape mismatch)")
     return True
+
+
+# -- hybrid JPEG decode: host entropy half (see ops/jpeg.py for the TPU half) --
+
+_JPEG_MAX_COMPS = 4
+_JPEG_META_LEN = 3 + 4 * _JPEG_MAX_COMPS
+
+
+class JpegCoefLayout:
+    """Geometry of one JPEG's coefficient planes (all values in 8x8 blocks)."""
+
+    __slots__ = ("width", "height", "components")
+
+    def __init__(self, width: int, height: int, components):
+        self.width = width
+        self.height = height
+        #: per component: (h_samp, v_samp, blocks_w, blocks_h)
+        self.components = components
+
+    def __eq__(self, other):
+        return (isinstance(other, JpegCoefLayout)
+                and (self.width, self.height, self.components)
+                == (other.width, other.height, other.components))
+
+    def __repr__(self):
+        return (f"JpegCoefLayout({self.width}x{self.height},"
+                f" comps={self.components})")
+
+
+def jpeg_coef_layout(buf: bytes) -> Optional["JpegCoefLayout"]:
+    """Parse a JPEG header into its coefficient-plane geometry (no entropy
+    decode); None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    meta = np.zeros(_JPEG_META_LEN, dtype=np.int32)
+    rc = lib.pst_jpeg_coef_layout(bytes(buf), len(buf), meta.ctypes.data)
+    if rc != 0:
+        from petastorm_tpu.errors import CodecError
+
+        raise CodecError(f"not a decodable JPEG (rc={rc})")
+    ncomp = int(meta[0])
+    comps = tuple(tuple(int(v) for v in meta[3 + 4 * c: 7 + 4 * c])
+                  for c in range(ncomp))
+    return JpegCoefLayout(int(meta[1]), int(meta[2]), comps)
+
+
+def read_jpeg_coefficients(buf: bytes, layout: Optional[JpegCoefLayout] = None):
+    """Entropy-decode one JPEG into quantized DCT coefficient planes.
+
+    Returns ``(planes, qtabs, layout)``: ``planes[c]`` is int16
+    (blocks_h, blocks_w, 64) in natural order, ``qtabs`` is uint16 (ncomp, 64).
+    The FLOP-heavy rest of the decode (dequant + IDCT + upsample + color)
+    belongs on the TPU: ``petastorm_tpu.ops.jpeg.decode_coefficients``.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native image library unavailable")
+    if layout is None:
+        layout = jpeg_coef_layout(buf)
+    planes = [np.empty((bh, bw, 64), dtype=np.int16)
+              for (_, _, bw, bh) in layout.components]
+    qtabs = np.empty((len(layout.components), 64), dtype=np.uint16)
+    outs = (ctypes.c_void_p * len(planes))(
+        *[p.ctypes.data for p in planes])
+    rc = lib.pst_jpeg_read_coefs(bytes(buf), len(buf),
+                                 ctypes.cast(outs, ctypes.c_void_p),
+                                 qtabs.ctypes.data)
+    if rc != 0:
+        from petastorm_tpu.errors import CodecError
+
+        raise CodecError(f"JPEG coefficient read failed (rc={rc})")
+    return planes, qtabs, layout
+
+
+def _layout_meta(layout: JpegCoefLayout) -> np.ndarray:
+    meta = np.zeros(_JPEG_META_LEN, dtype=np.int32)
+    meta[0] = len(layout.components)
+    meta[1] = layout.width
+    meta[2] = layout.height
+    for c, comp in enumerate(layout.components):
+        meta[3 + 4 * c: 7 + 4 * c] = comp
+    return meta
+
+
+def read_jpeg_coefficients_column(column, nthreads: int = 1):
+    """Entropy-decode a column of same-geometry JPEGs into stacked planes.
+
+    One GIL-released C call over the whole batch, reading the streams
+    zero-copy out of the arrow buffer when ``column`` is an arrow binary
+    array.  Returns ``(planes, qtabs, layout)`` where ``planes[c]`` is int16
+    (n, blocks_h, blocks_w, 64) and ``qtabs`` is uint16 (n, ncomp, 64) -
+    ready to ship to the device as one contiguous transfer per component.
+    Raises CodecError when geometries differ (caller falls back to per-image
+    host decode).
+    """
+    from petastorm_tpu.errors import CodecError
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native image library unavailable")
+    if isinstance(column, (list, tuple)):
+        cells = [np.frombuffer(b, dtype=np.uint8) for b in column]
+        ptrs = np.array([c.ctypes.data for c in cells], dtype=np.uint64)
+        lens = np.array([len(c) for c in cells], dtype=np.uint64)
+        first = column[0] if column else b""
+    else:
+        pointers = _column_pointers(column)
+        if pointers is None:  # chunked/offset edge cases: fall back to copies
+            return read_jpeg_coefficients_column(column.to_pylist(),
+                                                 nthreads=nthreads)
+        ptrs, lens = pointers
+        first = column[0].as_py() if len(column) else b""
+    n = len(ptrs)
+    if n == 0:
+        raise CodecError("empty column")
+    layout = jpeg_coef_layout(first)
+    ncomp = len(layout.components)
+    planes = [np.empty((n, bh, bw, 64), dtype=np.int16)
+              for (_, _, bw, bh) in layout.components]
+    qtabs = np.empty((n, ncomp, 64), dtype=np.uint16)
+    outs = (ctypes.c_void_p * ncomp)(*[p.ctypes.data for p in planes])
+    strides = np.array([p.strides[0] // 2 for p in planes], dtype=np.uint64)
+    meta = _layout_meta(layout)
+    rc = lib.pst_jpeg_coef_batch(
+        ptrs.ctypes.data, lens.ctypes.data, n,
+        ctypes.cast(outs, ctypes.c_void_p), strides.ctypes.data,
+        qtabs.ctypes.data, meta.ctypes.data, nthreads)
+    if rc != 0:
+        raise CodecError(
+            f"JPEG coefficient batch failed at cell {rc - 1} (corrupt stream"
+            f" or geometry differs from {layout})")
+    return planes, qtabs, layout

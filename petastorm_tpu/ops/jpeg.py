@@ -1,0 +1,168 @@
+"""Hybrid JPEG decode, device half: dequant + IDCT + upsample + color on TPU.
+
+The host half (petastorm_tpu/native/image.py:read_jpeg_coefficients*) runs only
+libjpeg's entropy decoder and ships quantized DCT coefficient planes - roughly
+a quarter of the CPU cost of a full decode, and int16 coefficient planes are
+about the same number of bytes as the decoded uint8 pixels.  Everything
+FLOP-heavy lands here as batched linear algebra the MXU eats:
+
+* dequantize: elementwise multiply by the quant table,
+* inverse DCT: two 8x8 matmuls per block, batched over every block of every
+  image (``einsum`` over (N*blocks, 8, 8) - MXU-shaped),
+* chroma upsampling: libjpeg's "fancy" triangle filter (h2v1/h2v2) expressed
+  as padded weighted sums (or nearest-neighbor via ``jnp.repeat``),
+* YCbCr -> RGB: one 3x3 matmul + clip.
+
+This is the BASELINE.json north-star design ("on-device image decode"):
+variable-length entropy coding is hostile to SIMD/MXU hardware, but it is the
+*cheap* part; the split puts each half where it runs best.  Reference analog:
+the CompressedImageCodec decode path (petastorm/codecs.py:92-101), which does
+the whole decode on host via cv2.
+
+Accuracy: float IDCT + float triangle upsample + float color vs libjpeg's
+fixed-point pipeline differ by a few levels (test tolerance: max <= 6, mean
+< 1 vs cv2 on photographic content).  JPEG is lossy; this is within the
+variation between existing conformant decoders.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _idct_basis() -> np.ndarray:
+    """A[u, x] = c(u)/2 * cos((2x+1) u pi / 16); spatial = A^T @ X @ A."""
+    u = np.arange(8)[:, None]
+    x = np.arange(8)[None, :]
+    a = 0.5 * np.cos((2 * x + 1) * u * np.pi / 16)
+    a[0] *= 1 / np.sqrt(2)
+    return a.astype(np.float32)
+
+
+def _idct_blocks(coefs: jax.Array, qtab: jax.Array) -> jax.Array:
+    """(..., bh, bw, 64) int16 coefs + (..., 64) qtab -> (..., bh*8, bw*8) f32.
+
+    Level-shifted (+128) spatial samples, unclipped.
+    """
+    *lead, bh, bw, _ = coefs.shape
+    x = coefs.astype(jnp.float32) * qtab.astype(jnp.float32)[..., None, None, :]
+    x = x.reshape(*lead, bh, bw, 8, 8)
+    a = jnp.asarray(_idct_basis())
+    # spatial[k, l] = sum_uv X[u, v] A[u, k] A[v, l]
+    s = jnp.einsum("...uv,uk,vl->...kl", x, a, a,
+                   preferred_element_type=jnp.float32)
+    s = s + 128.0
+    # (..., bh, bw, 8, 8) -> (..., bh, 8, bw, 8) -> (..., bh*8, bw*8)
+    s = jnp.moveaxis(s, -2, -3)
+    return s.reshape(*lead, bh * 8, bw * 8)
+
+
+def _upsample_axis_fancy(x: jax.Array, axis: int) -> jax.Array:
+    """libjpeg 'fancy' (triangle) 2x upsample along one axis.
+
+    out[2i] = (3*x[i] + x[i-1]) / 4, out[2i+1] = (3*x[i] + x[i+1]) / 4,
+    with edge replication - the float version of jdsample.c's h2v1 filter.
+    """
+    x = jnp.moveaxis(x, axis, -1)
+    prev = jnp.concatenate([x[..., :1], x[..., :-1]], axis=-1)
+    nxt = jnp.concatenate([x[..., 1:], x[..., -1:]], axis=-1)
+    even = (3.0 * x + prev) * 0.25
+    odd = (3.0 * x + nxt) * 0.25
+    out = jnp.stack([even, odd], axis=-1).reshape(*x.shape[:-1], -1)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def _upsample_to(plane: jax.Array, factors: Tuple[int, int], height: int,
+                 width: int, fancy: bool) -> jax.Array:
+    """Upsample (..., ch, cw) by integer ``factors`` and crop to (h, w)."""
+    fy, fx = factors
+    for axis, f in ((-2, fy), (-1, fx)):
+        if f == 1:
+            continue
+        if fancy and f == 2:
+            plane = _upsample_axis_fancy(plane, axis)
+        else:  # nearest for the rare 4x factors (and fancy=False)
+            plane = jnp.repeat(plane, f, axis=axis)
+    return plane[..., :height, :width]
+
+
+# JFIF YCbCr -> RGB (ITU-R BT.601)
+_YCC_TO_RGB = np.array([[1.0, 0.0, 1.402],
+                        [1.0, -0.344136286, -0.714136286],
+                        [1.0, 1.772, 0.0]], dtype=np.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("image_size", "sampling",
+                                             "out_dtype", "fancy_upsampling"))
+def decode_coefficients(planes: Sequence[jax.Array],
+                        qtabs: jax.Array,
+                        image_size: Tuple[int, int],
+                        sampling: Tuple[Tuple[int, int], ...],
+                        out_dtype=jnp.uint8,
+                        fancy_upsampling: bool = True) -> jax.Array:
+    """Quantized DCT coefficient planes -> decoded image batch, on device.
+
+    Args:
+      planes: per component, int16 (N, blocks_h, blocks_w, 64) in natural
+        order - the arrays from ``native.image.read_jpeg_coefficients_column``.
+      qtabs: uint16 (N, ncomp, 64) quant tables (natural order).
+      image_size: (height, width) of the full image.
+      sampling: per component (h_samp, v_samp) JPEG sampling factors.
+      out_dtype: uint8 (default) for pixels, or a float dtype to skip the
+        round-trip when feeding a normalize stage.
+
+    Returns (N, H, W, 3) RGB for 3-component JPEGs, (N, H, W) for grayscale.
+    """
+    height, width = image_size
+    ncomp = len(planes)
+    if ncomp not in (1, 3):
+        raise ValueError(f"unsupported component count {ncomp}")
+    max_h = max(s[0] for s in sampling)
+    max_v = max(s[1] for s in sampling)
+    comps = []
+    for c, coefs in enumerate(planes):
+        spatial = _idct_blocks(coefs, qtabs[:, c, :])
+        h_samp, v_samp = sampling[c]
+        ch = -(-height * v_samp // max_v)  # ceil
+        cw = -(-width * h_samp // max_h)
+        spatial = spatial[..., :ch, :cw]
+        comps.append(_upsample_to(spatial, (max_v // v_samp, max_h // h_samp),
+                                  height, width, fancy_upsampling))
+    if ncomp == 1:
+        out = comps[0]
+    else:
+        ycc = jnp.stack(comps, axis=-1)  # (N, H, W, 3)
+        ycc = ycc - jnp.asarray([0.0, 128.0, 128.0], dtype=jnp.float32)
+        out = ycc @ jnp.asarray(_YCC_TO_RGB).T
+    if jnp.issubdtype(jnp.dtype(out_dtype), jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return out.astype(out_dtype)
+
+
+def decode_from_layout(planes, qtabs, layout, out_dtype=jnp.uint8,
+                       fancy_upsampling: bool = True) -> jax.Array:
+    """Decode already-transferred coefficient planes using a
+    ``native.image.JpegCoefLayout`` (shared plumbing for the convenience
+    wrapper below and the JaxDataLoader device-decode path)."""
+    sampling = tuple((h, v) for (h, v, _, _) in layout.components)
+    return decode_coefficients(
+        tuple(jnp.asarray(p) for p in planes), jnp.asarray(qtabs),
+        image_size=(layout.height, layout.width), sampling=sampling,
+        out_dtype=out_dtype, fancy_upsampling=fancy_upsampling)
+
+
+def decode_jpeg_column(column, out_dtype=jnp.uint8,
+                       fancy_upsampling: bool = True) -> jax.Array:
+    """Convenience wrapper: arrow/list of same-geometry JPEG streams ->
+    decoded batch on the default device (host entropy decode + device rest)."""
+    from petastorm_tpu.native.image import read_jpeg_coefficients_column
+
+    planes, qtabs, layout = read_jpeg_coefficients_column(column)
+    return decode_from_layout(planes, qtabs, layout, out_dtype=out_dtype,
+                              fancy_upsampling=fancy_upsampling)

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import logging
 import queue
-from typing import List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -76,6 +76,7 @@ def make_reader(dataset_url: str,
                 filesystem=None,
                 resume_from: Optional[dict] = None,
                 verify_checksums: bool = False,
+                decode_placement: Optional[Dict[str, str]] = None,
                 ngram=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -91,7 +92,8 @@ def make_reader(dataset_url: str,
                              transform_spec, storage_options, filesystem,
                              batched_output=False, require_stored_schema=True,
                              resume_from=resume_from, ngram=ngram,
-                             verify_checksums=verify_checksums)
+                             verify_checksums=verify_checksums,
+                             decode_placement=decode_placement)
 
 
 def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
@@ -116,6 +118,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       filesystem=None,
                       resume_from: Optional[dict] = None,
                       verify_checksums: bool = False,
+                      decode_placement: Optional[Dict[str, str]] = None,
                       ngram=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
@@ -131,7 +134,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              transform_spec, storage_options, filesystem,
                              batched_output=True, require_stored_schema=False,
                              resume_from=resume_from, ngram=ngram,
-                             verify_checksums=verify_checksums)
+                             verify_checksums=verify_checksums,
+                             decode_placement=decode_placement)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -142,7 +146,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       transform_spec, storage_options, filesystem,
                       batched_output, require_stored_schema,
                       resume_from: Optional[dict] = None, ngram=None,
-                      verify_checksums: bool = False) -> "Reader":
+                      verify_checksums: bool = False,
+                      decode_placement: Optional[Dict[str, str]] = None) -> "Reader":
     if ngram is not None and batched_output:
         raise PetastormTpuError(
             "NGram is not supported by make_batch_reader (reference parity,"
@@ -240,19 +245,93 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     fs_factory = FilesystemFactory(dataset_url if isinstance(dataset_url, str)
                                    else dataset_url[0], storage_options,
                                    filesystem=filesystem)
+    device_fields = _validate_decode_placement(decode_placement, full_schema,
+                                               read_fields, transform_spec, ngram)
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
                                    predicate=worker_predicate,
                                    transform=transform_spec, cache=cache,
                                    ngram=ngram, ngram_schema=ngram_schema,
-                                   verify_checksums=verify_checksums)
+                                   verify_checksums=verify_checksums,
+                                   raw_fields=device_fields)
 
     executor = make_executor(reader_pool_type, workers_count, results_queue_size)
     start_item = 0
     if resume_from is not None:
         start_item = int(resume_from.get("position", 0))
-    return Reader(info=info, schema=output_schema, plan=plan, executor=executor,
-                  worker=worker, num_epochs=num_epochs, batched_output=batched_output,
-                  start_item=start_item, ngram=ngram)
+    reader = Reader(info=info, schema=output_schema, plan=plan, executor=executor,
+                    worker=worker, num_epochs=num_epochs, batched_output=batched_output,
+                    start_item=start_item, ngram=ngram)
+    #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
+    reader.device_decode_fields = device_fields
+    return reader
+
+
+def _validate_decode_placement(decode_placement, schema, read_fields,
+                               transform_spec, ngram) -> list:
+    """Check a decode_placement mapping; returns the 'device' field names.
+
+    Device placement = the worker skips the codec and ships raw JPEG bytes;
+    the jax loader runs entropy decode on host and the FLOP-heavy rest
+    (dequant + IDCT + upsample + color) on the TPU (ops/jpeg.py).
+    """
+    if not decode_placement:
+        return []
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.native import image as native_image
+
+    device_fields = []
+    for name, place in decode_placement.items():
+        if place not in ("host", "device"):
+            raise PetastormTpuError(
+                f"decode_placement[{name!r}] must be 'host' or 'device',"
+                f" got {place!r}")
+        if name not in schema:
+            raise PetastormTpuError(f"decode_placement field {name!r} not in"
+                                    f" schema {[f.name for f in schema]}")
+        if place == "host":
+            continue
+        if not native_image.available():
+            raise PetastormTpuError(
+                "decode_placement='device' needs the native image library"
+                " (petastorm_tpu/native/image_decode.cpp failed to build on"
+                " this host); use host decode")
+        field = schema[name]
+        codec = field.codec
+        if not (isinstance(codec, CompressedImageCodec)
+                and codec.image_codec == "jpeg"):
+            raise PetastormTpuError(
+                f"decode_placement='device' requires a jpeg"
+                f" CompressedImageCodec field; {name!r} has"
+                f" {type(codec).__name__}"
+                + (f"({codec.image_codec})" if isinstance(
+                    codec, CompressedImageCodec) else "")
+                + ". PNG's deflate stream cannot be entropy-split for on-chip"
+                " decode - store images as jpeg for device decode.")
+        if not field.is_fixed_shape:
+            raise PetastormTpuError(
+                f"decode_placement='device' field {name!r} needs a fixed shape"
+                f" (got {field.shape}): XLA compiles per geometry")
+        if (len(field.shape) not in (2, 3)
+                or (len(field.shape) == 3 and field.shape[2] not in (1, 3))):
+            raise PetastormTpuError(
+                f"decode_placement='device' field {name!r} must be (H, W),"
+                f" (H, W, 1) or (H, W, 3); got {field.shape}")
+        if ngram is not None:
+            raise PetastormTpuError(
+                "decode_placement='device' is not supported with ngram readers")
+        if transform_spec is not None:
+            raise PetastormTpuError(
+                "decode_placement='device' cannot be combined with a"
+                " transform_spec: the transform would see raw jpeg bytes, not"
+                " pixels. Decode on host, or transform on device after the"
+                " loader.")
+        if name not in read_fields:
+            raise PetastormTpuError(
+                f"decode_placement='device' field {name!r} is not being read"
+                " (excluded by schema_fields); drop it from decode_placement"
+                " or add it to schema_fields")
+        device_fields.append(name)
+    return device_fields
 
 
 class Reader:

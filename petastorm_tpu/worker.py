@@ -51,7 +51,8 @@ class RowGroupDecoderWorker:
                  cache: Optional[CacheBase] = None,
                  ngram=None,
                  ngram_schema: Optional[Schema] = None,
-                 verify_checksums: bool = False):
+                 verify_checksums: bool = False,
+                 raw_fields: Sequence[str] = ()):
         self._fs_factory = fs_factory
         self._schema = schema
         self._read_fields = list(read_fields)
@@ -62,6 +63,9 @@ class RowGroupDecoderWorker:
         self._ngram = ngram
         self._ngram_schema = ngram_schema or schema
         self._verify_checksums = verify_checksums
+        #: fields delivered as raw encoded bytes (codec decode skipped) -
+        #: decode_placement='device': the jax loader decodes them on-chip
+        self._raw_fields = frozenset(raw_fields)
 
     # -- factory protocol -----------------------------------------------------
 
@@ -135,7 +139,8 @@ class RowGroupDecoderWorker:
 
     def _cache_key(self, item: WorkItem, span: tuple) -> str:
         start, stop = span
-        fields_tag = hashlib.md5(",".join(self._read_fields).encode()).hexdigest()[:8]
+        tag = ",".join(self._read_fields) + "|raw:" + ",".join(sorted(self._raw_fields))
+        fields_tag = hashlib.md5(tag.encode()).hexdigest()[:8]
         return (f"{self._cache_prefix}:{item.row_group.path}:{item.row_group.row_group}"
                 f":{start}:{stop}:{fields_tag}")
 
@@ -170,8 +175,13 @@ class RowGroupDecoderWorker:
         columns: Dict[str, np.ndarray] = {}
         for name in stored:
             field = self._schema[name]
-            columns[name] = field.codec.decode_column(
-                field, table.column(name).combine_chunks())
+            chunk = table.column(name).combine_chunks()
+            if name in self._raw_fields:
+                col = np.empty(n, dtype=object)
+                col[:] = chunk.to_pylist()
+                columns[name] = col
+            else:
+                columns[name] = field.codec.decode_column(field, chunk)
         pvals = dict(item.row_group.partition_values)
         for name in virtual:
             if name not in pvals:
