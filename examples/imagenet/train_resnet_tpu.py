@@ -52,7 +52,7 @@ def generate_dataset(url: str, rows: int, side: int, seed: int = 0) -> None:
 
 
 def train(dataset_url: str, steps: int, global_batch: int, side: int,
-          num_classes: int = 1000):
+          num_classes: int = 1000, decode: str = "device"):
     devices = jax.devices()
     mesh = Mesh(np.asarray(devices), ("data",))
     model = ResNet50(num_classes=num_classes)
@@ -75,7 +75,17 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    reader = make_reader(dataset_url, num_epochs=None, workers_count=4)
+    # decode='device': hybrid jpeg decode - host does only entropy decode,
+    # dequant + IDCT + upsample + color run on-chip (ops/jpeg.py)
+    if decode == "device":
+        from petastorm_tpu.native import image as native_image
+
+        if not native_image.available():
+            print("native image library unavailable; falling back to host decode")
+            decode = "host"
+    placement = {"image": "device"} if decode == "device" else None
+    reader = make_reader(dataset_url, num_epochs=None, workers_count=4,
+                         decode_placement=placement)
     step = 0
     with JaxDataLoader(reader, batch_size=global_batch, mesh=mesh,
                        shardings={"image": P("data"), "label": P("data")}) as loader:
@@ -109,7 +119,9 @@ if __name__ == "__main__":
     parser.add_argument("--side", type=int, default=224)
     parser.add_argument("--steps", type=int, default=8)
     parser.add_argument("--global-batch", type=int, default=32)
+    parser.add_argument("--decode", choices=("host", "device"), default="device",
+                        help="device = hybrid on-chip jpeg decode")
     args = parser.parse_args()
     url = args.dataset_url or tempfile.mkdtemp(prefix="imagenet_tpu_") + "/imagenet"
     generate_dataset(url, args.rows, args.side)
-    train(url, args.steps, args.global_batch, args.side)
+    train(url, args.steps, args.global_batch, args.side, decode=args.decode)

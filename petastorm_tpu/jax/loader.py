@@ -34,6 +34,7 @@ TPU-specific behavior:
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 from typing import Callable, Dict, Optional, Sequence, Tuple, Union
@@ -295,8 +296,17 @@ class JaxDataLoader:
 
         field = self._schema[name]
         cells = list(raw_col)
+        # the entropy half runs in this (single) producer thread: fan out the
+        # batched C call over cores on real TPU host VMs (GIL released);
+        # sched_getaffinity respects cgroup/affinity limits where available
         try:
-            planes, qtabs, layout = read_jpeg_coefficients_column(cells)
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:
+            cores = os.cpu_count() or 1
+        nthreads = max(1, min(8, cores - 1))
+        try:
+            planes, qtabs, layout = read_jpeg_coefficients_column(
+                cells, nthreads=nthreads)
         except CodecError as exc:
             # mixed subsampling/geometry inside one batch (e.g. encoder
             # settings changed mid-dataset): decode this batch on host
