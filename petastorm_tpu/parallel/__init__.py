@@ -7,6 +7,7 @@ Here shard assignment is derived from the JAX runtime itself.
 
 from petastorm_tpu.parallel.mesh import (data_parallel_mesh, local_data_slice,
                                          shard_options_from_jax, sharding_for_batch)
+from petastorm_tpu.parallel.write import distributed_write_dataset
 
 __all__ = ["data_parallel_mesh", "shard_options_from_jax", "sharding_for_batch",
-           "local_data_slice"]
+           "local_data_slice", "distributed_write_dataset"]

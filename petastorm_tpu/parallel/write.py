@@ -1,0 +1,201 @@
+"""Distributed (multi-host) dataset writes coordinated by the JAX runtime.
+
+The reference's write path is a Spark job: the JVM coordinates executors and
+the driver stamps metadata afterwards (petastorm/etl/dataset_metadata.py:53-133).
+On a TPU pod there is no JVM; the natural coordinator is the JAX distributed
+runtime that training already depends on.  The recipe (documented in
+etl/writer.py) is mechanical - every host writes its own part files, exactly
+one host stamps metadata after a barrier - and this module packages it with
+the failure semantics a pod job needs:
+
+* barriers are ALWAYS reached (try/finally), so one host crashing mid-phase
+  cannot deadlock the others in ``sync_global_devices`` (which has no timeout);
+* a host whose write fails drops a ``_distributed_write_failed.<idx>`` marker
+  on the shared filesystem; host 0 refuses to stamp when any marker exists;
+* every host verifies the metadata stamp before returning, so a failure
+  anywhere surfaces as an exception everywhere, not as a silently
+  short-rowed dataset.
+
+No data moves between hosts: each host encodes and writes only the rows it
+was handed, so write bandwidth scales linearly with host count.  Only the
+barrier rides the JAX distributed channel.
+"""
+
+from __future__ import annotations
+
+import logging
+import posixpath
+from typing import Callable, Iterable, List, Optional
+
+import pyarrow.fs as pafs
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.schema import Schema
+
+logger = logging.getLogger(__name__)
+
+#: underscore prefix keeps markers out of data-file discovery (etl metadata
+#: and parquet readers skip ``_*`` files)
+_FAIL_MARKER = "_distributed_write_failed"
+
+
+def _default_sync(tag: str) -> None:
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def distributed_write_dataset(url: str,
+                              schema: Schema,
+                              local_rows: Iterable[dict],
+                              *,
+                              process_index: Optional[int] = None,
+                              process_count: Optional[int] = None,
+                              sync_fn: Optional[Callable[[str], None]] = None,
+                              mode: str = "error",
+                              **write_kwargs) -> List[str]:
+    """Write THIS host's ``local_rows`` into a shared dataset; returns the
+    part-file paths this host wrote.
+
+    Every participating host must call this with the same ``url``, ``schema``
+    and ``mode`` (and its own row slice - sharding the source is the caller's
+    job, e.g. ``rows[process_index::process_count]``).  Host 0 preflights the
+    target per ``mode`` ('error' rejects a non-empty dataset dir, 'overwrite'
+    clears it - the same contract as ``write_dataset``; rerunning a crashed
+    job with 'error' fails instead of silently doubling rows), stamps the
+    dataset metadata once all hosts finished writing, and every host verifies
+    the stamp before returning.
+
+    ``process_index``/``process_count``/``sync_fn`` default to the JAX
+    distributed runtime (``jax.process_index()``,
+    ``multihost_utils.sync_global_devices``); pass them explicitly to use a
+    different coordinator (tests use a ``threading.Barrier``).
+
+    Remaining ``write_kwargs`` are forwarded to ``etl.writer.write_dataset``
+    (row_group_size_mb, partition_by, compression, ...).
+    """
+    from petastorm_tpu.etl.writer import stamp_dataset_metadata, write_dataset
+    from petastorm_tpu.fs import get_filesystem_and_path
+
+    if process_index is None or process_count is None:
+        import jax
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+    if not 0 <= process_index < process_count:
+        raise ValueError(f"process_index {process_index} out of range"
+                         f" [0, {process_count})")
+    if mode not in ("error", "overwrite"):
+        raise ValueError(f"mode must be 'error' or 'overwrite', got {mode!r}"
+                         " (append would make a crashed-job rerun silently"
+                         " double rows)")
+    sync = sync_fn or _default_sync
+    owned = {"file_prefix", "stamp_metadata", "mode"} & set(write_kwargs)
+    if owned:
+        raise ValueError(f"{sorted(owned)} are owned by"
+                         " distributed_write_dataset (per-host prefixes,"
+                         " single-host stamp, coordinated mode handling)")
+    storage_options = write_kwargs.get("storage_options")
+    filesystem = write_kwargs.get("filesystem")
+    fs, root = get_filesystem_and_path(url, storage_options, filesystem)
+
+    # phase 1 - preflight (host 0 only): apply the mode contract and clear
+    # stale failure markers while every other host waits
+    preflight_error: Optional[BaseException] = None
+    if process_index == 0:
+        try:
+            _preflight(fs, root, url, mode)
+        except BaseException as exc:  # noqa: BLE001 - re-raised after barrier
+            preflight_error = exc
+            # peers check this marker after the barrier instead of writing
+            # into a dirty/rejected target and hanging at the next barrier
+            _drop_fail_marker(fs, root, "preflight")
+    try:
+        sync("petastorm_tpu:distributed_write:preflight")
+    finally:
+        if preflight_error is not None:
+            raise preflight_error
+    if process_index != 0 and fs.get_file_info(
+            posixpath.join(root, f"{_FAIL_MARKER}.preflight")
+            ).type == pafs.FileType.File:
+        raise PetastormTpuError(
+            f"distributed write to {url!r} aborted: preflight failed on"
+            " host 0 (see its log)")
+
+    # phase 2 - every host writes its own part files (append is safe now:
+    # the only files present are peers' parts from this same job).  A failed
+    # host drops a marker and KEEPS PARTICIPATING in the remaining barriers -
+    # raising early would strand the surviving hosts in sync_global_devices.
+    files: List[str] = []
+    write_error: Optional[BaseException] = None
+    try:
+        files = write_dataset(url, schema, local_rows,
+                              file_prefix=f"part-{process_index:05d}",
+                              stamp_metadata=False, mode="append",
+                              **write_kwargs)
+    except BaseException as exc:  # noqa: BLE001 - re-raised after barriers
+        write_error = exc
+        _drop_fail_marker(fs, root, process_index)
+    sync("petastorm_tpu:distributed_write:data")
+
+    # phase 3 - host 0 stamps, unless any host reported failure
+    if process_index == 0 and write_error is None:
+        try:
+            markers = [f.path for f in fs.get_file_info(
+                           pafs.FileSelector(root, recursive=False))
+                       if posixpath.basename(f.path).startswith(_FAIL_MARKER)]
+            if markers:
+                raise PetastormTpuError(
+                    f"write failed on host(s) {sorted(markers)}; dataset not"
+                    " stamped")
+            stamp_dataset_metadata(url, schema,
+                                   storage_options=storage_options,
+                                   filesystem=filesystem)
+        except BaseException as exc:  # noqa: BLE001 - surfaced by phase 4
+            logger.error("distributed write stamp failed: %s", exc)
+    sync("petastorm_tpu:distributed_write:stamp")
+    if write_error is not None:
+        raise write_error
+
+    # phase 4 - every host verifies the stamp, so a failure anywhere raises
+    # everywhere instead of deadlocking or silently dropping rows
+    meta_path = posixpath.join(root, "_common_metadata")
+    if fs.get_file_info(meta_path).type != pafs.FileType.File:
+        raise PetastormTpuError(
+            f"distributed write to {url!r} failed: metadata was not stamped"
+            " (a host's write or the stamp raised; see host 0's log)")
+    logger.info("host %d/%d wrote %d part file(s) to %s",
+                process_index, process_count, len(files), url)
+    return files
+
+
+def _preflight(fs: pafs.FileSystem, root: str, url: str, mode: str) -> None:
+    from petastorm_tpu.etl.metadata import _is_data_file
+
+    info = fs.get_file_info(root)
+    if info.type == pafs.FileType.Directory:
+        entries = fs.get_file_info(pafs.FileSelector(root, recursive=True))
+        data = [f.path for f in entries
+                if f.type == pafs.FileType.File and _is_data_file(f.path)]
+        if data and mode == "error":
+            raise PetastormTpuError(
+                f"Dataset path {url!r} already contains {len(data)} data"
+                " file(s); pass mode='overwrite' to replace")
+        if data or any(posixpath.basename(f.path).startswith(_FAIL_MARKER)
+                       for f in entries if f.type == pafs.FileType.File):
+            fs.delete_dir_contents(root)
+    fs.create_dir(root, recursive=True)
+
+
+def _drop_fail_marker(fs: pafs.FileSystem, root: str, idx) -> None:
+    try:
+        fs.create_dir(root, recursive=True)
+        with fs.open_output_stream(
+                posixpath.join(root, f"{_FAIL_MARKER}.{idx}")) as f:
+            f.write(b"")
+    except Exception as exc:  # noqa: BLE001 - marker is best-effort
+        logger.warning("could not write failure marker: %s", exc)
