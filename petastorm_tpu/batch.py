@@ -19,6 +19,11 @@ import numpy as np
 class ColumnBatch:
     columns: Dict[str, np.ndarray]
     num_rows: int
+    #: absolute ventilation ordinal of the work item this batch came from
+    #: (set by the decode worker; lets the Reader track the exact contiguous
+    #: consumed prefix for checkpoint/resume even when a pool completes items
+    #: out of ventilation order)
+    ordinal: "int | None" = None
 
     def __post_init__(self):
         for name, col in self.columns.items():

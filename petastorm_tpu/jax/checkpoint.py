@@ -86,6 +86,12 @@ def resume_reader_kwargs(loader_state: Dict) -> Dict:
     """kwargs for make_reader/make_batch_reader/make_jax_loader that resume
     iteration at the checkpointed cursor.  The caller must pass the SAME
     dataset/shard/shuffle-seed/num-epochs configuration as the original run
-    (the cursor indexes into that deterministic plan)."""
+    (the cursor indexes into that deterministic plan).
+
+    The FULL reader state is passed through: ``items_per_epoch`` feeds the
+    settings-changed safety check, and ``elastic_rebased`` (present on
+    cursors from elastically-resumed readers) carries the coordinate
+    translation - stripping either would disable a refusal path.
+    """
     reader_state = loader_state.get("reader", loader_state)
-    return {"resume_from": {"position": int(reader_state["position"])}}
+    return {"resume_from": dict(reader_state)}

@@ -143,3 +143,152 @@ class ReadPlan:
             sub = np.random.default_rng((self._seed, epoch, 1)).permutation(len(items))
             items = [items[int(i)] for i in sub]
         return items
+
+    def total_items(self, num_epochs: int) -> int:
+        """Items across ``num_epochs`` epochs (uniform epoch length)."""
+        return len(self.epoch_items(0)) * num_epochs
+
+
+class ElasticResumePlan:
+    """Plan for resuming a partially-consumed epoch under a NEW shard layout.
+
+    The reference cannot do this at all ("no elastic re-sharding, no mid-epoch
+    resume", SURVEY.md section 5); here it falls out of determinism: every old
+    shard's epoch order is a pure function of (seed, epoch, shard), so the
+    not-yet-consumed remainder of the in-progress epoch is reconstructible
+    from the old shards' cursors alone - no data exchange, every new host
+    computes the same answer.
+
+    Epochs are REBASED: ``epoch_items(0)`` is this new shard's deal of the
+    leftover items, ``epoch_items(e >= 1)`` delegates to a normal plan for the
+    old layout's epoch ``resume_epoch + e`` under the new shard layout.
+
+    Exactness matches ``Reader.state_dict``: exact when every old shard was
+    checkpointed at an epoch boundary or in lockstep; mid-epoch, each cursor
+    counts *completed* items, so up to the in-flight window per old shard may
+    be re-read (never lost).
+    """
+
+    def __init__(self, base: ReadPlan, resume_epoch: int,
+                 leftover: Sequence[WorkItem]):
+        self._base = base
+        self._resume_epoch = resume_epoch
+        self._leftover = list(leftover)
+        self.row_groups = base.row_groups
+
+    @property
+    def resume_epoch(self) -> int:
+        return self._resume_epoch
+
+    @property
+    def leftover_len(self) -> int:
+        return len(self._leftover)
+
+    @property
+    def base_items_per_epoch(self) -> int:
+        return len(self._base.epoch_items(0))
+
+    def epoch_items(self, epoch: int) -> List[WorkItem]:
+        if epoch == 0:
+            return list(self._leftover)
+        return self._base.epoch_items(self._resume_epoch + epoch)
+
+    def rows_per_epoch(self) -> int:
+        return sum(item.num_rows for item in self._leftover)
+
+    def total_items(self, num_epochs: int) -> int:
+        if num_epochs <= 0:
+            return 0
+        return len(self._leftover) + self._base.total_items(num_epochs - 1)
+
+
+def resolve_cursor(state: dict, shard: Optional[int] = None) -> Tuple[int, int]:
+    """(absolute position, items_per_epoch) of a checkpoint in BASE-plan
+    coordinates, translating cursors taken from an elastically-resumed reader
+    (whose epochs were rebased around the leftover epoch).
+
+    A mid-leftover cursor has no base-coordinate equivalent (leftover items
+    interleave several old shards) and is refused loudly.
+    """
+    who = f"old shard {shard}: " if shard is not None else ""
+    if "items_per_epoch" not in state:
+        raise PetastormTpuError(
+            f"{who}cursor lacks 'items_per_epoch' - pass the full"
+            " Reader.state_dict() (older/stripped cursors cannot be"
+            " safety-checked and are refused)")
+    pos = int(state["position"])
+    ipe = int(state["items_per_epoch"])
+    rebased = state.get("elastic_rebased")
+    if rebased is None:
+        return pos, ipe
+    leftover = int(rebased["leftover_len"])
+    if pos < leftover:
+        raise PetastormTpuError(
+            f"{who}cursor is mid-way through an elastic leftover epoch"
+            f" (position {pos} < leftover {leftover}); it cannot be mapped"
+            " back to per-shard coordinates. Checkpoint again after the"
+            " leftover epoch finishes.")
+    base_ipe = int(rebased["base_items_per_epoch"])
+    base_pos = (int(rebased["resume_epoch"]) + 1) * base_ipe + (pos - leftover)
+    return base_pos, base_ipe
+
+
+def elastic_resume_plan(row_groups: Sequence[RowGroupRef],
+                        states: Sequence[dict],
+                        new_shard_index: int,
+                        new_shard_count: int,
+                        shuffle_row_groups: bool = True,
+                        shuffle_seed: Optional[int] = None,
+                        shuffle_row_drop_partitions: int = 1,
+                        shard_mode: str = "static") -> ElasticResumePlan:
+    """Build the resume plan for ONE new shard from ALL old shards' cursors.
+
+    ``states``: every old shard's ``Reader.state_dict()``, ordered by old
+    shard index (length = old shard count).  Shuffle/seed/drop/shard-mode
+    arguments must match the original run - the orderings are recomputed, not
+    stored.  The in-progress epoch is the earliest epoch any old shard had
+    not finished; ahead-of-lockstep shards contribute nothing to the leftover
+    (their few next-epoch items are re-read, never lost).
+    """
+    old_count = len(states)
+    if old_count < 1:
+        raise PetastormTpuError("elastic resume needs at least one old state")
+    if not 0 <= new_shard_index < new_shard_count:
+        raise PetastormTpuError(
+            f"new_shard_index {new_shard_index} out of range for"
+            f" {new_shard_count}")
+
+    def shard_plan(idx: int, count: Optional[int]) -> ReadPlan:
+        return ReadPlan(row_groups,
+                        shard_index=idx if count else None,
+                        shard_count=count,
+                        shuffle_row_groups=shuffle_row_groups,
+                        shuffle_seed=shuffle_seed,
+                        shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                        shard_mode=shard_mode)
+
+    cursors = []  # (epoch, offset, plan) per old shard
+    for s, state in enumerate(states):
+        plan_s = (shard_plan(s, old_count) if old_count > 1
+                  else shard_plan(0, None))
+        ipe = len(plan_s.epoch_items(0))
+        pos, stored_ipe = resolve_cursor(state, shard=s)
+        if stored_ipe != ipe:
+            raise PetastormTpuError(
+                f"old shard {s}: checkpoint says {stored_ipe} items/epoch but"
+                f" the recomputed plan has {ipe} - dataset contents or plan"
+                " settings (seed/shuffle/drop/shard_mode) changed since the"
+                " checkpoint")
+        epoch, off = (pos // ipe, pos % ipe) if ipe else (0, 0)
+        cursors.append((epoch, off, plan_s))
+
+    resume_epoch = min(epoch for epoch, _, _ in cursors)
+    leftover: List[WorkItem] = []
+    for epoch, off, plan_s in cursors:
+        if epoch == resume_epoch:
+            leftover.extend(plan_s.epoch_items(resume_epoch)[off:])
+    dealt = leftover[new_shard_index::new_shard_count]
+    base = shard_plan(new_shard_index,
+                      new_shard_count if new_shard_count > 1 else None)
+    # rebased epoch 1 == old epoch resume_epoch + 1
+    return ElasticResumePlan(base, resume_epoch, dealt)

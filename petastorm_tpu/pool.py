@@ -55,6 +55,28 @@ class _Failure:
 WorkerFactory = Callable[[], Callable[[Any], Any]]
 
 
+class VentilatedItem:
+    """A work item tagged with its absolute ventilation ordinal.
+
+    Pools may complete items out of ventilation order; the ordinal lets the
+    consumer reconstruct the exact contiguous consumed prefix (the only
+    resume cursor that can guarantee no item is ever lost).  Picklable for
+    the process pool.
+    """
+
+    __slots__ = ("ordinal", "item")
+
+    def __init__(self, ordinal: int, item: Any):
+        self.ordinal = ordinal
+        self.item = item
+
+    def __getstate__(self):
+        return (self.ordinal, self.item)
+
+    def __setstate__(self, state):
+        self.ordinal, self.item = state
+
+
 class ExecutorBase(ABC):
     """start -> (put*/get*) -> stop -> join lifecycle, mirroring the reference pool
     protocol (start/ventilate/get_results/stop/join)."""
@@ -462,7 +484,9 @@ class Ventilator:
         """Items this ventilator will emit (excludes skipped resume prefix)."""
         if self._num_epochs is None:
             return None
-        return max(self.items_per_epoch * self._num_epochs - self._start_item, 0)
+        # plans know their own totals (ElasticResumePlan's leftover epoch is
+        # shorter than its subsequent epochs)
+        return max(self._plan.total_items(self._num_epochs) - self._start_item, 0)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name="petastorm-tpu-ventilator",
@@ -476,6 +500,7 @@ class Ventilator:
             offset = self._start_item % self.items_per_epoch
         else:
             epoch, offset = 0, 0
+        ordinal = self._start_item  # absolute position in the full item stream
         while not self._stop_event.is_set():
             if self._num_epochs is not None and epoch >= self._num_epochs:
                 return
@@ -483,9 +508,10 @@ class Ventilator:
                 if self._stop_event.is_set():
                     return
                 try:
-                    self._executor.put(item)
+                    self._executor.put(VentilatedItem(ordinal, item))
                 except ReaderClosedError:
                     return
+                ordinal += 1
             offset = 0
             epoch += 1
 

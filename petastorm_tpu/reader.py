@@ -42,7 +42,7 @@ from petastorm_tpu.errors import (EpochNotFinishedError, MetadataError,
 from petastorm_tpu.etl.indexing import get_row_group_indexes
 from petastorm_tpu.etl.metadata import open_dataset
 from petastorm_tpu.fs import FilesystemFactory
-from petastorm_tpu.plan import ReadPlan
+from petastorm_tpu.plan import ElasticResumePlan, ReadPlan, elastic_resume_plan
 from petastorm_tpu.pool import Ventilator, make_executor
 from petastorm_tpu.schema import Schema
 from petastorm_tpu.transform import TransformSpec, transform_schema
@@ -94,6 +94,31 @@ def make_reader(dataset_url: str,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement)
+
+
+def elastic_resume(states: Sequence[dict]) -> dict:
+    """``resume_from`` token for resuming under a DIFFERENT shard layout.
+
+    ``states``: EVERY old shard's ``Reader.state_dict()``, ordered by old
+    shard index (a global checkpoint has all of them).  Pass the token to
+    ``make_reader(..., resume_from=elastic_resume(states), cur_shard=<new>,
+    shard_count=<new>, num_epochs=<epochs remaining, counting the partial
+    one>)`` on every new host, with all other plan settings (seed, shuffle,
+    drop partitions, shard_mode, filters) unchanged from the checkpointed
+    run.  The leftover of the in-progress epoch is re-dealt across the new
+    shards deterministically; no item is lost, and at most the old in-flight
+    window is re-read (exact when checkpointed at an epoch boundary).
+
+    An elastically-resumed reader checkpoints again like any other: its
+    cursor records the rebased-coordinate translation and resumes plainly or
+    elastically once past the leftover epoch.  A mid-leftover cursor is not
+    expressible in per-shard coordinates and is refused with a clear error -
+    checkpoint again after the leftover epoch finishes.
+
+    Reference gap: "no elastic re-sharding, no mid-epoch resume"
+    (SURVEY.md section 5).
+    """
+    return {"elastic": {"states": [dict(s) for s in states]}}
 
 
 def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
@@ -230,10 +255,24 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             if not row_groups:
                 raise NoDataAvailableError("Predicate filtered out all partitions")
 
-    plan = ReadPlan(row_groups, shard_index=cur_shard, shard_count=shard_count,
-                    shuffle_row_groups=shuffle_row_groups, shuffle_seed=shuffle_seed,
-                    shuffle_row_drop_partitions=shuffle_row_drop_partitions,
-                    shard_mode=shard_mode)
+    if resume_from is not None and "elastic" in resume_from:
+        # resume a partially-consumed epoch under a NEW shard layout: the old
+        # shards' cursors fully determine the leftover items (plans are pure
+        # functions of seed/epoch/shard). All OTHER settings (dataset,
+        # predicate/selector filters, seed, shuffle, drop, shard_mode) must
+        # match the checkpointed run.
+        plan = elastic_resume_plan(
+            row_groups, resume_from["elastic"]["states"],
+            new_shard_index=cur_shard if cur_shard is not None else 0,
+            new_shard_count=shard_count if shard_count is not None else 1,
+            shuffle_row_groups=shuffle_row_groups, shuffle_seed=shuffle_seed,
+            shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+            shard_mode=shard_mode)
+    else:
+        plan = ReadPlan(row_groups, shard_index=cur_shard, shard_count=shard_count,
+                        shuffle_row_groups=shuffle_row_groups, shuffle_seed=shuffle_seed,
+                        shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                        shard_mode=shard_mode)
 
     cache = make_cache(cache_type, cache_location, cache_size_limit)
     # cache+predicate is disallowed (reference py_dict_reader_worker.py:145-150);
@@ -256,8 +295,22 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
 
     executor = make_executor(reader_pool_type, workers_count, results_queue_size)
     start_item = 0
-    if resume_from is not None:
-        start_item = int(resume_from.get("position", 0))
+    if resume_from is not None and "elastic" not in resume_from:
+        if "elastic_rebased" in resume_from:
+            # cursor from an elastically-resumed reader: translate its rebased
+            # coordinates back to this (base) plan's absolute item stream
+            from petastorm_tpu.plan import resolve_cursor
+
+            start_item, base_ipe = resolve_cursor(resume_from)
+            plan_ipe = len(plan.epoch_items(0))
+            if plan_ipe != base_ipe:
+                raise PetastormTpuError(
+                    f"cursor was taken under a layout with {base_ipe}"
+                    f" items/epoch but this reader's plan has {plan_ipe};"
+                    " shard count or plan settings differ - use"
+                    " elastic_resume() with every shard's state instead")
+        else:
+            start_item = int(resume_from.get("position", 0))
     reader = Reader(info=info, schema=output_schema, plan=plan, executor=executor,
                     worker=worker, num_epochs=num_epochs, batched_output=batched_output,
                     start_item=start_item, ngram=ngram)
@@ -363,6 +416,12 @@ class Reader:
 
         self._start_item = start_item
         self._consumed_items = 0
+        # exact contiguous consumed prefix: pools complete items out of
+        # ventilation order, so counting alone cannot give a resume cursor
+        # that never loses items - ordinals on each batch can
+        self._prefix = start_item
+        self._consumed_ordinals: set = set()
+        self._ordinals_seen = False
         self._current: Optional[ColumnBatch] = None
         self._current_pos = 0
         self._namedtuple_type = schema.make_namedtuple_type()
@@ -434,6 +493,12 @@ class Reader:
             except queue.Empty:
                 continue
             self._consumed_items += 1
+            if batch.ordinal is not None:
+                self._ordinals_seen = True
+                self._consumed_ordinals.add(batch.ordinal)
+                while self._prefix in self._consumed_ordinals:
+                    self._consumed_ordinals.discard(self._prefix)
+                    self._prefix += 1
             if batch.num_rows > 0:
                 if self.batched_output and self._all_items_consumed():
                     # batch path: flag as the final value is returned; the row
@@ -458,6 +523,8 @@ class Reader:
         self._ventilator.join()
         self._start_item = 0
         self._consumed_items = 0
+        self._prefix = 0
+        self._consumed_ordinals.clear()
         self._current = None
         self._current_pos = 0
         self.last_row_consumed = False
@@ -470,13 +537,27 @@ class Reader:
     def state_dict(self) -> dict:
         """Work-item cursor for ``make_reader(..., resume_from=state)``.
 
-        Exact at epoch boundaries; mid-epoch the cursor counts *completed* items,
-        which can differ from the ventilation prefix by up to the in-flight window
-        (see module docstring).  Same (dataset, seed, shard, epoch-count) settings
-        must be passed when resuming.
+        ``position`` is the exact CONTIGUOUS consumed prefix of the
+        deterministic item stream (tracked via per-batch ventilation
+        ordinals): resuming from it never loses an item; items completed
+        out of order beyond the prefix (at most the in-flight window) are
+        re-read.  Same (dataset, seed, shard, epoch-count) settings must be
+        passed when resuming.
         """
-        return {"position": self._start_item + self._consumed_items,
-                "items_per_epoch": self._ventilator.items_per_epoch}
+        position = (self._prefix if self._ordinals_seen
+                    else self._start_item + self._consumed_items)
+        state = {"position": position,
+                 "items_per_epoch": self._ventilator.items_per_epoch}
+        if isinstance(self._plan, ElasticResumePlan):
+            # rebased coordinates: record the translation so this cursor can
+            # itself be resumed (plainly or elastically) once past the
+            # leftover epoch
+            state["elastic_rebased"] = {
+                "leftover_len": self._plan.leftover_len,
+                "resume_epoch": self._plan.resume_epoch,
+                "base_items_per_epoch": self._plan.base_items_per_epoch,
+            }
+        return state
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -14,6 +14,7 @@ surviving-row mask filters the *arrow* table of the remaining columns before the
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import logging
 from typing import Dict, List, Optional, Sequence
@@ -88,8 +89,19 @@ class RowGroupDecoderWorker:
                 open_files[path] = entry
             return entry
 
-        def process(item: WorkItem) -> ColumnBatch:
-            return self._process(_parquet_file, item)
+        def process(item) -> ColumnBatch:
+            from petastorm_tpu.pool import VentilatedItem
+
+            ordinal = None
+            if isinstance(item, VentilatedItem):
+                ordinal, item = item.ordinal, item.item
+            batch = self._process(_parquet_file, item)
+            # ordinal rides the batch so the consumer can track the exact
+            # contiguous consumed prefix (resume correctness under pools
+            # that complete items out of ventilation order).  Shallow copy:
+            # a cached batch object may be delivered again next epoch with a
+            # different ordinal, so the cached instance must stay unmarked.
+            return dataclasses.replace(batch, ordinal=ordinal)
 
         return process
 

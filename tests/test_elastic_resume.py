@@ -1,0 +1,174 @@
+"""Elastic resume: continue a partially-consumed epoch under a new shard count.
+
+Reference gap (SURVEY.md section 5): "No elastic re-sharding, no mid-epoch
+resume."  Multi-host is simulated with several Readers in one process, the
+same way sharding is tested (SURVEY.md section 4 / tests/test_end_to_end.py
+analog test_partition_multi_node).
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import elastic_resume, make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+
+SEED = 7
+ROWS = 64  # 16 rowgroups x 4 rows
+
+
+@pytest.fixture(scope="module")
+def ds(tmp_path_factory):
+    schema = Schema("Elastic", [Field("id", np.int64)])
+    url = str(tmp_path_factory.mktemp("elastic") / "ds")
+    write_dataset(url, schema, [{"id": i} for i in range(ROWS)],
+                  row_group_size_rows=4)
+    return url
+
+
+def _reader(url, shard, count, num_epochs, resume=None):
+    # serial pool: completion order == ventilation order, so state_dict
+    # cursors are exact prefixes (the property elastic resume builds on)
+    return make_batch_reader(url, reader_pool_type="serial",
+                             shuffle_row_groups=True, shuffle_seed=SEED,
+                             cur_shard=shard, shard_count=count,
+                             num_epochs=num_epochs, resume_from=resume)
+
+
+def _consume(reader, n_items=None):
+    """Consume n_items batches (or all); returns the row ids seen."""
+    ids = []
+    it = reader.iter_batches()
+    taken = 0
+    for batch in it:
+        ids.extend(int(v) for v in batch.columns["id"])
+        taken += 1
+        if n_items is not None and taken >= n_items:
+            break
+    return ids
+
+
+@pytest.mark.parametrize("old_count,new_count", [(4, 2), (2, 4), (4, 4), (3, 5)])
+def test_mid_epoch_reshard_no_loss_no_dup(ds, old_count, new_count):
+    seen = []
+    states = []
+    for s in range(old_count):
+        with _reader(ds, s, old_count, num_epochs=2) as r:
+            # consume a different partial prefix per shard (incl. 0 items)
+            seen.extend(_consume(r, n_items=s))
+            states.append(r.state_dict())
+    token = elastic_resume(states)
+    for j in range(new_count):
+        with _reader(ds, j, new_count, num_epochs=2, resume=token) as r:
+            seen.extend(_consume(r))
+    # epoch 0's leftover + all of epoch 1: every id exactly twice overall
+    counts = collections.Counter(seen)
+    assert sorted(counts) == list(range(ROWS))
+    assert set(counts.values()) == {2}, collections.Counter(counts.values())
+
+
+def test_epoch_boundary_reshard_exact(ds):
+    # finish epoch 0 completely on 4 shards, then run epoch 1 on 2 shards
+    seen, states = [], []
+    for s in range(4):
+        with _reader(ds, s, 4, num_epochs=1) as r:
+            seen.extend(_consume(r))
+            states.append(r.state_dict())
+    assert sorted(seen) == list(range(ROWS))  # epoch 0 complete
+    token = elastic_resume(states)
+    resumed = []
+    for j in range(2):
+        with _reader(ds, j, 2, num_epochs=1, resume=token) as r:
+            resumed.extend(_consume(r))
+    # the resumed epoch is old epoch 1: complete, disjoint shards, no dup
+    assert sorted(resumed) == list(range(ROWS))
+    # and it is genuinely epoch 1's order, not a replay of epoch 0's
+    from petastorm_tpu.etl.metadata import open_dataset
+    from petastorm_tpu.plan import ReadPlan
+
+    rgs = open_dataset(ds).row_groups
+    e1_global = [it.row_group.global_index
+                 for it in ReadPlan(rgs, shuffle_seed=SEED).epoch_items(1)]
+    e0_global = [it.row_group.global_index
+                 for it in ReadPlan(rgs, shuffle_seed=SEED).epoch_items(0)]
+    assert e1_global != e0_global  # sanity: orders differ between epochs
+
+
+def test_changed_settings_detected(ds):
+    with _reader(ds, 0, 4, num_epochs=1) as r:
+        _consume(r, n_items=1)
+        state = r.state_dict()
+    bad = dict(state, items_per_epoch=state["items_per_epoch"] + 1)
+    with pytest.raises(PetastormTpuError, match="changed since"):
+        make_batch_reader(ds, shuffle_seed=SEED, cur_shard=0, shard_count=2,
+                          resume_from=elastic_resume([bad] * 4))
+
+
+def test_mid_leftover_re_resume_refused_loudly(ds):
+    """An elastic-resumed reader's mid-leftover cursor is not expressible in
+    old-plan coordinates; re-resuming from it must refuse, not corrupt."""
+    states = []
+    for s in range(2):
+        with _reader(ds, s, 2, num_epochs=3) as r:
+            _consume(r, n_items=3)
+            states.append(r.state_dict())
+    token = elastic_resume(states)
+    with _reader(ds, 0, 4, num_epochs=3, resume=token) as r:
+        _consume(r, n_items=1)
+        mid_leftover_state = r.state_dict()
+    assert "elastic_rebased" in mid_leftover_state
+    with pytest.raises(PetastormTpuError, match="mid-way through"):
+        make_batch_reader(ds, shuffle_seed=SEED, cur_shard=0, shard_count=2,
+                          resume_from=elastic_resume([mid_leftover_state] * 4))
+    with pytest.raises(PetastormTpuError, match="mid-way through"):
+        make_batch_reader(ds, shuffle_seed=SEED, cur_shard=0, shard_count=4,
+                          resume_from=mid_leftover_state)
+
+
+def test_re_resume_past_leftover_epoch(ds):
+    """After the leftover epoch, an elastic reader's cursor resumes plainly
+    (same layout) AND elastically (another reshape) with no loss/dup."""
+    seen, states = [], []
+    for s in range(4):
+        with _reader(ds, s, 4, num_epochs=3) as r:
+            seen.extend(_consume(r, n_items=s))
+            states.append(r.state_dict())
+    token = elastic_resume(states)
+    # reshape 4 -> 2; run past the leftover epoch and into old epoch 1
+    states2 = []
+    for j in range(2):
+        with _reader(ds, j, 2, num_epochs=3, resume=token) as r:
+            leftover_items = len(r._plan.epoch_items(0))
+            seen.extend(_consume(r, n_items=leftover_items + 2))
+            states2.append(r.state_dict())
+    # reshape again 2 -> 3 from the rebased cursors; num_epochs counts the
+    # REMAINING epochs (leftover of old epoch 1 + old epoch 2 = 2)
+    token2 = elastic_resume(states2)
+    for k in range(3):
+        with _reader(ds, k, 3, num_epochs=2, resume=token2) as r:
+            seen.extend(_consume(r))
+    counts = collections.Counter(seen)
+    assert sorted(counts) == list(range(ROWS))
+    assert set(counts.values()) == {3}  # 3 epochs, each id exactly 3x
+
+
+def test_thread_pool_resume_never_loses_items(ds):
+    """Completion order != ventilation order under a thread pool; the
+    ordinal-tracked prefix cursor must still guarantee zero loss (duplicates
+    bounded by the in-flight window are acceptable)."""
+    for trial in range(3):
+        with make_batch_reader(ds, reader_pool_type="thread", workers_count=4,
+                               shuffle_seed=SEED + trial,
+                               num_epochs=1) as r:
+            phase1 = _consume(r, n_items=5)
+            state = r.state_dict()
+        with make_batch_reader(ds, reader_pool_type="thread", workers_count=4,
+                               shuffle_seed=SEED + trial, num_epochs=1,
+                               resume_from=state) as r:
+            phase2 = _consume(r)
+        counts = collections.Counter(phase1 + phase2)
+        assert sorted(counts) == list(range(ROWS)), "items lost on resume"
+        assert max(counts.values()) <= 2  # dups bounded by in-flight window
