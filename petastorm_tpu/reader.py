@@ -425,6 +425,7 @@ class Reader:
         self._current: Optional[ColumnBatch] = None
         self._current_pos = 0
         self._namedtuple_type = schema.make_namedtuple_type()
+        self._field_names = list(schema.fields)
 
         self._executor.start(worker)
         self._ventilator = Ventilator(executor, plan, num_epochs,
@@ -460,8 +461,11 @@ class Reader:
             # one window: {offset: namedtuple} (reference row-path shape)
             return self.ngram.row(self._ngram_views, self._ngram_types,
                                   self._current, pos)
-        row = self._current.row(pos)
-        return self._namedtuple_type(**{n: row[n] for n in self.schema.fields})
+        # hot row loop: _make with a positional list (namedtuple fields are in
+        # schema order) skips the two per-row dict builds of row()+kwargs
+        cols = self._current.columns
+        return self._namedtuple_type._make([cols[n][pos]
+                                            for n in self._field_names])
 
     def iter_batches(self):
         """Yield raw ColumnBatches (the TPU feed path: no namedtuple wrapping).
