@@ -1,0 +1,139 @@
+"""HBM-resident shuffling buffer: decorrelate batches ON DEVICE.
+
+Reference parity: BatchedDataLoader's torch-tensor shuffling buffers - rows
+live in GPU memory and are sampled with ``torch.randperm``
+(petastorm/pytorch.py:257-367, reader_impl/pytorch_shuffling_buffer.py:261).
+The TPU translation (SURVEY.md section 7 step 7, "HBM-resident shuffle"):
+the buffer is a pytree of stacked ``jax.Array``s that never leaves HBM, and
+mixing runs under ``jit`` with donated state, so shuffling costs no
+host<->device traffic at all.
+
+Mixing model (exchange shuffle): the buffer holds ``capacity`` slots of one
+batch each.  A push picks a uniformly random slot, merges the incoming batch
+with the resident batch (2B rows), permutes the merged rows on device, emits
+B of them, and writes the other B back to the slot.  Per step that is one
+slot gather + scatter + a 2B-row permutation - O(batch) HBM traffic however
+large the buffer - while rows random-walk across slots over time.  The
+warm-up fill accumulates the first ``capacity`` batches and stacks them into
+the store with ONE fused op (no per-push store rewrite).  The decorrelation
+window is ``capacity`` batches, the same knob as the reference's
+``shuffling_queue_capacity`` (in batches, not rows).
+
+Works on sharded arrays too: output shardings are pinned to the incoming
+batch's, so the row permutation's cross-shard movement rides ICI inside one
+compiled exchange step and each emitted shard lands where the consumer
+expects it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from petastorm_tpu.errors import PetastormTpuError
+
+
+def _stacked_sharding(batch_leaf: jax.Array):
+    """Sharding for a (capacity, *leaf.shape) stack of this leaf."""
+    sharding = getattr(batch_leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return NamedSharding(sharding.mesh, PartitionSpec(None, *sharding.spec))
+    return sharding
+
+
+def _exchange(store, batch, slot, key):
+    """(new_store, out_batch): swap-mix ``batch`` with ``store[slot]``."""
+    resident = jax.tree.map(lambda s: jax.lax.dynamic_index_in_dim(
+        s, slot, axis=0, keepdims=False), store)
+    merged = jax.tree.map(lambda r, b: jnp.concatenate([r, b]), resident, batch)
+    rows = jax.tree.leaves(batch)[0].shape[0]
+    perm = jax.random.permutation(key, 2 * rows)
+    out = jax.tree.map(lambda m: m[perm[:rows]], merged)
+    back = jax.tree.map(lambda m: m[perm[rows:]], merged)
+    store = jax.tree.map(
+        lambda s, b: jax.lax.dynamic_update_index_in_dim(s, b, slot, axis=0),
+        store, back)
+    return store, out
+
+
+def _self_shuffle(store, key):
+    """Permute rows within each slot + slots themselves (drain-time mixing)."""
+    cap = jax.tree.leaves(store)[0].shape[0]
+    rows = jax.tree.leaves(store)[0].shape[1]
+    slot_perm = jax.random.permutation(key, cap)
+    row_perm = jax.random.permutation(jax.random.fold_in(key, 1), rows)
+    return jax.tree.map(lambda s: s[slot_perm][:, row_perm], store)
+
+
+class DeviceShufflingBuffer:
+    """Exchange-shuffle ``capacity`` device batches resident in HBM.
+
+    ``push(batch)`` returns a decorrelated batch once the buffer is warm
+    (None while filling); ``drain()`` yields the resident batches, shuffled,
+    whether or not the buffer ever filled.  All batches must share one pytree
+    structure and shape (the loader guarantees this).  ``seed=None`` draws
+    one from OS entropy (matching the host buffer's unseeded behavior).
+    """
+
+    def __init__(self, capacity: int, seed: Optional[int] = None):
+        if capacity < 1:
+            raise PetastormTpuError("device shuffle capacity must be >= 1")
+        self._capacity = capacity
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        self._key = jax.random.PRNGKey(seed)
+        self._pending: List[Dict[str, jax.Array]] = []  # warm-up accumulator
+        self._store = None  # pytree of (capacity, B, ...) stacked arrays
+        self._exchange = None  # jitted per buffer: out_shardings pinned
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _stack(self, batches):
+        """One fused, sharding-pinned stack of the warm-up batches."""
+        out_sh = jax.tree.map(_stacked_sharding, batches[0])
+        stack = jax.jit(lambda bs: jax.tree.map(lambda *xs: jnp.stack(xs), *bs),
+                        out_shardings=out_sh)
+        return stack(batches)
+
+    def push(self, batch: Dict[str, jax.Array]) -> Optional[Dict[str, jax.Array]]:
+        if self._store is None:
+            self._pending.append(batch)
+            if len(self._pending) < self._capacity:
+                return None
+            self._store = self._stack(self._pending)
+            self._pending = []
+            # the row permutation moves rows across shards, so output
+            # shardings are pinned (XLA routes the mixing over ICI and
+            # re-lands each shard where the consumer expects it)
+            store_sh = jax.tree.map(lambda s: s.sharding, self._store)
+            batch_sh = jax.tree.map(lambda b: b.sharding, batch)
+            self._exchange = jax.jit(_exchange, donate_argnums=(0,),
+                                     out_shardings=(store_sh, batch_sh))
+            return None
+        key = self._next_key()
+        slot = jax.random.randint(key, (), 0, self._capacity)
+        self._store, out = self._exchange(self._store, batch, slot,
+                                          jax.random.fold_in(key, 1))
+        return out
+
+    def drain(self) -> Iterator[Dict[str, jax.Array]]:
+        """Emit the resident batches (always shuffled); buffer ends empty."""
+        store = self._store
+        if store is None:
+            if not self._pending:
+                return
+            store = self._stack(self._pending)  # partial fill: < capacity slots
+        self._store, self._pending, self._exchange = None, [], None
+        store_sh = jax.tree.map(lambda s: s.sharding, store)
+        shuffle = jax.jit(_self_shuffle, donate_argnums=(0,),
+                          out_shardings=store_sh)
+        store = shuffle(store, self._next_key())
+        n = jax.tree.leaves(store)[0].shape[0]
+        for i in range(n):
+            yield jax.tree.map(lambda s: s[i], store)

@@ -93,7 +93,9 @@ class JaxDataLoader:
                  keep_wide_dtypes: bool = False,
                  transform_fn: Optional[Callable[[Dict[str, np.ndarray]],
                                                  Dict[str, np.ndarray]]] = None,
-                 trace_dir: Optional[str] = None):
+                 trace_dir: Optional[str] = None,
+                 device_shuffle_capacity: int = 0,
+                 device_shuffle_seed: Optional[int] = None):
         self._reader = reader
         self._mesh = mesh
         self._specs = shardings
@@ -127,6 +129,25 @@ class JaxDataLoader:
             raise PetastormTpuError("batch_size must be >= 1")
         self._global_batch = batch_size
         self._local_rows = self._local_layout()
+
+        #: HBM-resident exchange shuffle over whole device batches (the TPU
+        #: analog of the reference's GPU-tensor BatchedDataLoader buffers,
+        #: petastorm/pytorch_shuffling_buffer.py) - composes with the host
+        #: shuffling buffer below, which mixes rows before batch assembly
+        self._device_buffer = None
+        if device_shuffle_capacity:
+            if self._host_fields:
+                raise PetastormTpuError(
+                    "device_shuffle_capacity cannot be combined with"
+                    " host_fields: host-side values cannot live in the HBM"
+                    " buffer. Use the host shuffling buffer"
+                    " (shuffling_queue_capacity) instead.")
+            from petastorm_tpu.jax.device_buffer import DeviceShufflingBuffer
+
+            self._device_buffer = DeviceShufflingBuffer(
+                device_shuffle_capacity, seed=device_shuffle_seed)
+        #: partial batches held back so they are emitted after the drain
+        self._tail_batches = []
 
         if shuffling_queue_capacity and shuffling_queue_capacity > 0:
             min_after = (min_after_retrieve if min_after_retrieve is not None
@@ -238,6 +259,16 @@ class JaxDataLoader:
                 if out.num_rows < local_bs and self._drop_last:
                     continue  # partial tail batch dropped
                 self._emit(out)
+            if self._device_buffer is not None:
+                for resident in self._device_buffer.drain():
+                    if self._stop_event.is_set():
+                        break
+                    self._push(resident)
+                for tail in self._tail_batches:
+                    if self._stop_event.is_set():
+                        break
+                    self._push(tail)
+                self._tail_batches = []
             self._push(_Done())
             self._sentinel_pending = True
         except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
@@ -280,6 +311,17 @@ class JaxDataLoader:
             device_batch[name] = host_batch.columns[name]
         if self._mesh is not None and valid_rows < self._local_rows:
             device_batch["_valid_rows"] = valid_rows
+        if self._device_buffer is not None:
+            if valid_rows == self._local_rows:
+                out = self._device_buffer.push(device_batch)
+                if out is not None:
+                    self._push(out)
+            else:
+                # partial tail batch (different shape / '_valid_rows') cannot
+                # enter the HBM buffer; stash it so it is still emitted LAST,
+                # after the drain - consumers treat it as the epoch-end signal
+                self._tail_batches.append(device_batch)
+            return
         self._push(device_batch)
 
     def _decode_on_device(self, name: str, raw_col: np.ndarray) -> jax.Array:

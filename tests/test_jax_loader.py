@@ -323,3 +323,102 @@ def test_trace_flushed_on_exhaustion_without_stop(num_ds, tmp_path):
     assert os.path.isdir(trace_dir) and os.listdir(trace_dir)
     loader.stop()  # idempotent after exhaustion
     loader.join()
+
+
+def test_device_shuffle_buffer_delivers_all_rows_shuffled(num_ds):
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    url, _ = num_ds
+
+    def run(capacity, seed=3):
+        with make_batch_reader(url, shuffle_row_groups=False,
+                               reader_pool_type="serial", num_epochs=1) as r:
+            with JaxDataLoader(r, batch_size=4, fields=["idx"],
+                               device_shuffle_capacity=capacity,
+                               device_shuffle_seed=seed) as loader:
+                return [int(v) for b in loader for v in np.asarray(b["idx"])]
+
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           reader_pool_type="serial", num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=4, fields=["idx"]) as loader:
+            plain = [int(v) for b in loader for v in np.asarray(b["idx"])]
+    shuffled = run(4)
+    # every row exactly once, order changed, deterministic per seed
+    assert sorted(shuffled) == sorted(plain)
+    assert shuffled != plain
+    assert run(4) == shuffled
+    assert run(4, seed=9) != shuffled
+
+
+def test_device_shuffle_buffer_on_mesh(num_ds, devices):
+    from jax.sharding import Mesh, PartitionSpec
+
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    url, rows = num_ds
+    total = len(rows)
+    mesh = Mesh(np.array(devices).reshape(8), ("data",))
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           reader_pool_type="serial", num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=16, mesh=mesh,
+                           shardings=PartitionSpec("data"), fields=["idx"],
+                           device_shuffle_capacity=2, drop_last=False) as loader:
+            seen = []
+            for b in loader:
+                assert b["idx"].sharding.spec == PartitionSpec("data") \
+                    or "_valid_rows" in b
+                seen.extend(int(v) for v in np.asarray(b["idx"])[
+                    :b.get("_valid_rows", b["idx"].shape[0])])
+    assert sorted(seen) == list(range(total))
+
+
+def test_device_shuffle_rejects_host_fields(num_ds):
+    from petastorm_tpu.errors import PetastormTpuError
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    url, _ = num_ds
+    with make_batch_reader(url, num_epochs=1) as r:
+        with pytest.raises(PetastormTpuError, match="host_fields"):
+            JaxDataLoader(r, batch_size=4, fields=["idx"], host_fields=["tag"],
+                          device_shuffle_capacity=2)
+
+
+def test_device_shuffle_partial_fill_still_shuffles(num_ds):
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    url, _ = num_ds
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           reader_pool_type="serial", num_epochs=1) as r:
+        # capacity far beyond the stream: everything drains from warm-up
+        with JaxDataLoader(r, batch_size=4, fields=["idx"],
+                           device_shuffle_capacity=100,
+                           device_shuffle_seed=5) as loader:
+            got = [int(v) for b in loader for v in np.asarray(b["idx"])]
+    assert sorted(got) == list(range(64))
+    assert got != list(range(64))  # drained shuffled, not insertion order
+
+
+def test_device_shuffle_tail_batch_stays_last(num_ds, devices):
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    url, rows = num_ds
+    mesh = data_parallel_mesh()
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           reader_pool_type="serial", num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=24, mesh=mesh, drop_last=False,
+                           fields=["idx"], device_shuffle_capacity=2,
+                           device_shuffle_seed=7) as loader:
+            batches = list(loader)
+    # 64 rows / 24 = 2 full + 1 padded tail; the '_valid_rows' batch ends the
+    # stream even though resident batches drained after it was produced
+    assert [("_valid_rows" in b) for b in batches] == [False, False, True]
+    seen = []
+    for b in batches:
+        n = b.get("_valid_rows", b["idx"].shape[0])
+        seen.extend(int(v) for v in np.asarray(b["idx"])[:n])
+    assert sorted(seen) == list(range(64))
