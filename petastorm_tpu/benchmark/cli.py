@@ -30,6 +30,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "jax=device feed via JaxDataLoader")
     parser.add_argument("--batch-size", type=int, default=32,
                         help="device batch size (--method jax only)")
+    parser.add_argument("--simulated-step-ms", type=float, default=0.0,
+                        help="emulate an N-ms training step between batches;"
+                             " the report's input_stall_percent then reads as"
+                             " device-idle%% (--method jax only)")
     parser.add_argument("--no-shuffle", action="store_true",
                         help="disable rowgroup shuffling")
     parser.add_argument("--json", action="store_true",
@@ -55,7 +59,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             measure_batches=max(args.measure_cycles // 25, 8),
             pool_type=args.pool_type, workers_count=args.workers_count,
             field_regex=args.field_regex,
-            shuffle_row_groups=not args.no_shuffle)
+            shuffle_row_groups=not args.no_shuffle,
+            simulated_step_s=args.simulated_step_ms / 1000.0)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(
@@ -67,9 +72,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.json:
         print(result.to_json())
     else:
-        print(f"{result.samples_per_sec:.2f} samples/sec "
-              f"({result.samples} samples in {result.wall_s:.2f}s), "
-              f"RSS {result.rss_mb:.1f} MB, CPU {result.cpu_percent:.1f}%")
+        line = (f"{result.samples_per_sec:.2f} samples/sec "
+                f"({result.samples} samples in {result.wall_s:.2f}s), "
+                f"RSS {result.rss_mb:.1f} MB, CPU {result.cpu_percent:.1f}%")
+        if result.input_stall_percent is not None:
+            line += (f", input stall {result.input_stall_percent:.1f}%"
+                     f" (prefetch depth {result.prefetch_depth_avg:.1f})")
+        print(line)
     return 0
 
 

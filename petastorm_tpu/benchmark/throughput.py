@@ -35,9 +35,16 @@ class BenchmarkResult:
     samples: int
     rss_mb: float
     cpu_percent: float
+    #: percent of wall time the consumer was blocked waiting for the next
+    #: batch - the device-idle metric for the feed (SURVEY.md section 7 step
+    #: 10): ~0 means the host pipeline keeps the chip busy
+    input_stall_percent: "float | None" = None
+    #: mean prefetch-queue depth sampled at each batch (capacity = healthy)
+    prefetch_depth_avg: "float | None" = None
 
     def to_json(self) -> str:
-        return json.dumps(dataclasses.asdict(self))
+        d = {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+        return json.dumps(d)
 
 
 def _rss_mb() -> float:
@@ -120,12 +127,19 @@ def jax_loader_throughput(dataset_url: str,
                           workers_count: int = 3,
                           field_regex: Optional[Sequence[str]] = None,
                           shuffle_row_groups: bool = True,
-                          storage_options: Optional[dict] = None) -> BenchmarkResult:
+                          storage_options: Optional[dict] = None,
+                          simulated_step_s: float = 0.0) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
     host decode + transfer, i.e. the ceiling on how fast this loader can feed
     a training step.
+
+    ``simulated_step_s`` emulates a training step between batches; with it,
+    ``input_stall_percent`` answers the operational question "would this feed
+    keep a chip with an N-ms step busy?" - the device-idle% north-star metric
+    (BASELINE.md).  At 0 the feed runs flat out and the stall percent is by
+    construction ~100 (every moment is waiting).
     """
     import jax
 
@@ -145,26 +159,39 @@ def jax_loader_throughput(dataset_url: str,
         reader.stop()
         reader.join()
         raise
+    wait_s = 0.0
+    depth_sum = 0
+    depth_n = 0
     with loader:
         it = iter(loader)
 
         def consume(n_batches: int) -> int:
+            nonlocal wait_s, depth_sum, depth_n
             n = 0
             for _ in range(n_batches):
+                t1 = time.perf_counter()
                 batch = next(it)
                 jax.block_until_ready(batch)
+                wait_s += time.perf_counter() - t1
+                depth_sum += loader.diagnostics["prefetch_depth"]
+                depth_n += 1
                 first = next(iter(batch.values()))
                 n += int(first.shape[0])
+                if simulated_step_s:
+                    time.sleep(simulated_step_s)
             return n
 
         consume(warmup_batches)
+        wait_s, depth_sum, depth_n = 0.0, 0, 0
         clock.start()
         t0 = time.perf_counter()
         samples = consume(measure_batches)
         wall = time.perf_counter() - t0
         cpu = clock.stop()
     return BenchmarkResult(samples_per_sec=samples / wall, wall_s=wall,
-                           samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu)
+                           samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu,
+                           input_stall_percent=100.0 * wait_s / wall,
+                           prefetch_depth_avg=depth_sum / max(depth_n, 1))
 
 
 def run_isolated(cli_args: List[str]) -> BenchmarkResult:
