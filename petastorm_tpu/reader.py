@@ -424,6 +424,8 @@ class Reader:
         self._ordinals_seen = False
         self._current: Optional[ColumnBatch] = None
         self._current_pos = 0
+        self._row_buffer: list = []
+        self._row_pos = 0
         self._namedtuple_type = schema.make_namedtuple_type()
         self._field_names = list(schema.fields)
 
@@ -445,6 +447,22 @@ class Reader:
             batch = self._next_batch()
             return self._namedtuple_type(**{n: batch.columns[n]
                                             for n in self.schema.fields})
+        if self.ngram is None:
+            # hot row loop: materialize a whole rowgroup's namedtuples in one
+            # C-level map(zip(...)) pass, then hand them out by index - far
+            # less per-row python than building each row on demand
+            if self._row_pos >= len(self._row_buffer):
+                cols = self._next_batch().columns
+                self._row_buffer = list(map(
+                    self._namedtuple_type._make,
+                    zip(*[cols[n] for n in self._field_names])))
+                self._row_pos = 0
+            row = self._row_buffer[self._row_pos]
+            self._row_pos += 1
+            if (self._row_pos >= len(self._row_buffer)
+                    and self._all_items_consumed()):
+                self.last_row_consumed = True
+            return row
         if self._current is None or self._current_pos >= self._current.num_rows:
             self._current = self._next_batch()
             self._current_pos = 0
@@ -453,19 +471,13 @@ class Reader:
         if (self._current_pos >= self._current.num_rows
                 and self._all_items_consumed()):
             self.last_row_consumed = True
-        if self.ngram is not None:
-            if self.ngram.stack_timesteps:
-                raise PetastormTpuError(
-                    "stack_timesteps NGram readers are columnar-only: use"
-                    " iter_batches() or the jax loader")
-            # one window: {offset: namedtuple} (reference row-path shape)
-            return self.ngram.row(self._ngram_views, self._ngram_types,
-                                  self._current, pos)
-        # hot row loop: _make with a positional list (namedtuple fields are in
-        # schema order) skips the two per-row dict builds of row()+kwargs
-        cols = self._current.columns
-        return self._namedtuple_type._make([cols[n][pos]
-                                            for n in self._field_names])
+        if self.ngram.stack_timesteps:
+            raise PetastormTpuError(
+                "stack_timesteps NGram readers are columnar-only: use"
+                " iter_batches() or the jax loader")
+        # one window: {offset: namedtuple} (reference row-path shape)
+        return self.ngram.row(self._ngram_views, self._ngram_types,
+                              self._current, pos)
 
     def iter_batches(self):
         """Yield raw ColumnBatches (the TPU feed path: no namedtuple wrapping).
@@ -529,6 +541,8 @@ class Reader:
         self._consumed_items = 0
         self._prefix = 0
         self._consumed_ordinals.clear()
+        self._row_buffer = []
+        self._row_pos = 0
         self._current = None
         self._current_pos = 0
         self.last_row_consumed = False
