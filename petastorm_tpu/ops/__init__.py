@@ -11,5 +11,8 @@ normalization happens on-chip"), keeping the host->device transfer at 1 byte/pix
 from petastorm_tpu.ops.normalize import normalize_images
 from petastorm_tpu.ops.ring_attention import (ring_attention,
                                               ring_attention_sharded)
+from petastorm_tpu.ops.ulysses import (ulysses_attention,
+                                       ulysses_attention_sharded)
 
-__all__ = ["normalize_images", "ring_attention", "ring_attention_sharded"]
+__all__ = ["normalize_images", "ring_attention", "ring_attention_sharded",
+           "ulysses_attention", "ulysses_attention_sharded"]

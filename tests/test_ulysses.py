@@ -1,0 +1,134 @@
+"""Ulysses (all-to-all) sequence parallelism on the virtual 8-device mesh.
+
+The second context-parallel strategy beside ring attention
+(tests/test_ring_attention.py): two all_to_all collectives re-shard
+sequence<->heads around dense local attention.  Same loader delivery
+contract, same exactness bar (matches replicated full attention).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from petastorm_tpu.ops.ulysses import ulysses_attention
+
+
+def _mesh(data=2, seq=4):
+    devs = np.asarray(jax.devices()[:data * seq]).reshape(data, seq)
+    return Mesh(devs, ("data", "seq"))
+
+
+def _reference_attention(q, k, v, causal, scale=None):
+    d = q.shape[-1]
+    scale = scale or 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s = q.shape[2]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_full_attention(causal):
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    b, h, s, d = 2, 4, 32, 16  # h divisible by seq axis size 4
+    q, k, v = (jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+               for _ in range(3))
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    ref = _reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_matches_ring_attention():
+    from petastorm_tpu.ops.ring_attention import ring_attention
+
+    mesh = _mesh()
+    rng = np.random.default_rng(2)
+    b, h, s, d = 2, 4, 32, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+               for _ in range(3))
+    u = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    r = ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(u), np.asarray(r),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_differentiable():
+    mesh = _mesh()
+    rng = np.random.default_rng(1)
+    b, h, s, d = 2, 4, 16, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+               for _ in range(3))
+
+    def loss_u(q, k, v):
+        return ulysses_attention(q, k, v, mesh=mesh, causal=True).sum()
+
+    def loss_ref(q, k, v):
+        return _reference_attention(q, k, v, True).sum()
+
+    g_u = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_u, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_indivisible_heads_rejected():
+    mesh = _mesh()
+    rng = np.random.default_rng(3)
+    q = k = v = jnp.asarray(rng.standard_normal((2, 3, 32, 8)), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v, mesh=mesh)
+
+
+def test_loader_feeds_ulysses_end_to_end(tmp_path):
+    """Sequence-sharded loader delivery -> embedding -> ulysses attention."""
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    mesh = _mesh()
+    seq_len, vocab, heads, hdim = 32, 64, 4, 8
+    rng = np.random.default_rng(4)
+    url = str(tmp_path / "seqs")
+    write_dataset(url, Schema("S", [Field("tokens", np.int32, (seq_len,))]),
+                  [{"tokens": rng.integers(0, vocab, seq_len).astype(np.int32)}
+                   for _ in range(16)], row_group_size_rows=8)
+    emb = jnp.asarray(rng.standard_normal((vocab, heads * hdim)), jnp.float32)
+
+    def apply(tokens):
+        b, s = tokens.shape
+        x = emb[tokens].reshape(b, s, heads, hdim).transpose(0, 2, 1, 3)
+        return ulysses_attention(x, x, x, mesh=mesh, causal=True)
+
+    with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=8, mesh=mesh,
+                           shardings={"tokens": P("data", "seq")}) as loader:
+            batch = next(iter(loader))
+            out = jax.jit(apply)(batch["tokens"])
+    assert out.shape == (8, heads, seq_len, hdim)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_bf16_inputs_match_ring_numerics():
+    """Softmax accumulates in float32 for both CP strategies, so swapping one
+    for the other must not change bf16 training numerics."""
+    from petastorm_tpu.ops.ring_attention import ring_attention
+
+    mesh = _mesh()
+    rng = np.random.default_rng(5)
+    b, h, s, d = 2, 4, 32, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+               for _ in range(3))
+    u = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    r = ring_attention(q, k, v, mesh=mesh, causal=True)
+    assert u.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(u, dtype=np.float32),
+                               np.asarray(r, dtype=np.float32),
+                               rtol=2e-2, atol=2e-2)
