@@ -98,3 +98,32 @@ def test_shuffle_quality_improves_with_knobs(ordered_ds):
     assert rho_groups < 0.5         # rowgroup shuffle decorrelates coarsely
     assert rho_full < rho_none
     assert rho_full < 0.2           # buffer + row-drop approaches uniform
+
+
+def test_device_buffer_shuffle_quality(tmp_path):
+    """Statistical check (SURVEY.md section 4 lesson 5): the HBM exchange
+    buffer decorrelates read order, not just permutes within batches."""
+    import numpy as np
+
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+    from petastorm_tpu.test_util.shuffling_analysis import rank_correlation
+
+    url = str(tmp_path / "ds")
+    write_dataset(url, Schema("Q", [Field("id", np.int64)]),
+                  [{"id": i} for i in range(256)], row_group_size_rows=8)
+
+    def read_order(capacity):
+        with make_batch_reader(url, shuffle_row_groups=False,
+                               reader_pool_type="serial", num_epochs=1) as r:
+            with JaxDataLoader(r, batch_size=8, fields=["id"],
+                               device_shuffle_capacity=capacity,
+                               device_shuffle_seed=11) as loader:
+                return np.asarray([int(v) for b in loader
+                                   for v in np.asarray(b["id"])])
+
+    assert abs(rank_correlation(np.arange(256))) > 0.99  # sequential baseline
+    shuffled = abs(rank_correlation(read_order(8)))
+    assert shuffled < 0.5, shuffled
