@@ -174,9 +174,18 @@ class ThreadedExecutor(ExecutorBase):
                  profiling_enabled: bool = False):
         super().__init__()
         self._workers_count = workers_count
+        # SimpleQueue (C implementation) + bound semaphores instead of
+        # queue.Queue: the data handoff itself becomes a C call (no python
+        # mutex + two condition notifies per op); the semaphores still cost
+        # python-level sync but their waiters only pile up at the bounds.
+        # Measured: modest but consistent gain on a contended 1-core host.
         # reference bounds ventilation at workers_count + 2 (reader.py:45-47,412)
-        self._in_queue: "queue.Queue[Any]" = queue.Queue(in_queue_size or workers_count + 2)
-        self._out_queue: "queue.Queue[Any]" = queue.Queue(results_queue_size)
+        # and treats a non-positive results size as unbounded
+        self._in_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        self._in_slots = threading.BoundedSemaphore(in_queue_size or workers_count + 2)
+        self._out_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        self._out_slots = threading.BoundedSemaphore(
+            results_queue_size if results_queue_size > 0 else 2 ** 30)
         self._stop_event = threading.Event()
         self._threads = []
         # opt-in worker profiling (reference per-thread cProfile,
@@ -210,6 +219,7 @@ class ThreadedExecutor(ExecutorBase):
                 item = self._in_queue.get(timeout=_POLL_S)
             except queue.Empty:
                 continue
+            self._in_slots.release()
             try:
                 if profile is not None:
                     try:
@@ -228,34 +238,34 @@ class ThreadedExecutor(ExecutorBase):
                     result = fn(item)
             except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
                 result = _Failure(exc)
-            self._put_stop_aware(self._out_queue, result)
+            self._put_result_stop_aware(result)
         if profile is not None:
             with self._profiles_lock:
                 self._profiles.append(profile)
 
-    def _put_stop_aware(self, q: "queue.Queue", value: Any) -> None:
-        # reference _stop_aware_put (thread_pool.py:200-214)
+    def _put_result_stop_aware(self, value: Any) -> None:
+        # reference _stop_aware_put (thread_pool.py:200-214): bound via the
+        # slot semaphore, never block indefinitely across a stop
         while not self._stop_event.is_set():
-            try:
-                q.put(value, timeout=_POLL_S)
+            if self._out_slots.acquire(timeout=_POLL_S):
+                self._out_queue.put(value)
                 return
-            except queue.Full:
-                continue
 
     def put(self, item: Any) -> None:
         if self._stopped:
             raise ReaderClosedError("Executor is stopped")
         while not self._stop_event.is_set():
-            try:
-                self._in_queue.put(item, timeout=_POLL_S)
+            if self._in_slots.acquire(timeout=_POLL_S):
+                self._in_queue.put(item)
                 self._ventilated += 1
                 return
-            except queue.Full:
-                continue
         raise ReaderClosedError("Executor stopped while putting")
 
     def get(self, timeout: Optional[float] = None) -> Any:
         result = self._out_queue.get(timeout=timeout)
+        # releases are bounded by successful gets, which are bounded by
+        # acquired puts: a ValueError here would be a real accounting bug
+        self._out_slots.release()
         if isinstance(result, _Failure):
             self.stop()
             raise WorkerError(f"Worker failed:\n{result.formatted}")
