@@ -1,0 +1,103 @@
+"""Predicate combinator unit tests.
+
+Reference analog: petastorm/tests/test_predicates.py (combinators at
+petastorm/predicates.py:44-182).  End-to-end predicate behavior (pushdown,
+split-read) lives in tests/test_end_to_end.py; this file covers each
+combinator's vectorized mask and per-row fallback in isolation.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.predicates import (in_intersection, in_lambda, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+
+COLS = {
+    "a": np.array([1, 2, 3, 4, 5]),
+    "b": np.array([2, 2, 9, 4, 9]),
+    "name": np.array(["x", "y", "x", "z", "y"], dtype=object),
+}
+
+
+def test_in_set_mask_and_row():
+    p = in_set({2, 4}, "a")
+    assert p.get_fields() == ["a"]
+    assert p.do_include_vectorized(COLS).tolist() == [False, True, False, True, False]
+    assert p.do_include({"a": 4}) and not p.do_include({"a": 3})
+
+
+def test_in_set_strings():
+    p = in_set({"x"}, "name")
+    assert p.do_include_vectorized(COLS).tolist() == [True, False, True, False, False]
+
+
+def test_in_intersection():
+    p = in_intersection({2, 4}, ["a", "b"])
+    assert sorted(p.get_fields()) == ["a", "b"]
+    # both a AND b must be in {2, 4}
+    assert p.do_include_vectorized(COLS).tolist() == [False, True, False, True, False]
+
+
+def test_in_negate():
+    p = in_negate(in_set({2, 4}, "a"))
+    assert p.get_fields() == ["a"]
+    assert p.do_include_vectorized(COLS).tolist() == [True, False, True, False, True]
+    assert p.do_include({"a": 3})
+
+
+def test_in_reduce_all_any_custom():
+    evens = in_lambda(["a"], lambda c: c["a"] % 2 == 0, vectorized=True)
+    small = in_lambda(["a"], lambda c: c["a"] < 4, vectorized=True)
+    assert in_reduce([evens, small], np.all).do_include_vectorized(
+        COLS).tolist() == [False, True, False, False, False]
+    assert in_reduce([evens, small], np.any).do_include_vectorized(
+        COLS).tolist() == [True, True, True, True, False]
+    # custom reduce: exactly-one-of
+    xor = in_reduce([evens, small], lambda m, axis: np.sum(m, axis=axis) == 1)
+    assert xor.do_include_vectorized(COLS).tolist() == [True, False, True, True, False]
+    # field union is deduplicated, order-preserving
+    assert in_reduce([evens, small]).get_fields() == ["a"]
+
+
+def test_in_lambda_row_and_state():
+    seen = []
+    p = in_lambda(["a"], lambda row, state: state.append(row["a"]) or row["a"] > 2,
+                  state=seen)
+    assert p.do_include_vectorized(COLS).tolist() == [False, False, True, True, True]
+    assert seen == [1, 2, 3, 4, 5]  # state threaded through (reference contract)
+
+
+def test_in_pseudorandom_split_properties():
+    names = np.array([f"sample_{i}" for i in range(2000)], dtype=object)
+    fractions = [0.5, 0.3, 0.2]
+    masks = [in_pseudorandom_split(fractions, i, "k").do_include_vectorized(
+        {"k": names}) for i in range(3)]
+    total = np.stack(masks).sum(axis=0)
+    assert (total == 1).all()  # partition: every row in exactly one subset
+    sizes = [m.mean() for m in masks]
+    for got, want in zip(sizes, fractions):
+        assert abs(got - want) < 0.05, (got, want)
+    # deterministic across instances
+    again = in_pseudorandom_split(fractions, 0, "k").do_include_vectorized(
+        {"k": names})
+    assert (again == masks[0]).all()
+
+
+def test_in_pseudorandom_split_validation():
+    with pytest.raises(PetastormTpuError, match="out of range"):
+        in_pseudorandom_split([0.5, 0.5], 2, "k")
+    with pytest.raises(PetastormTpuError, match="sum"):
+        in_pseudorandom_split([0.9, 0.9], 0, "k")
+
+
+def test_row_fallback_matches_vectorized():
+    preds = [in_set({2, 4}, "a"),
+             in_intersection({2, 4}, ["a", "b"]),
+             in_negate(in_set({2}, "a")),
+             in_reduce([in_set({2, 4}, "a"), in_set({2, 4}, "b")])]
+    for p in preds:
+        vec = p.do_include_vectorized(COLS)
+        rows = [p.do_include({k: COLS[k][i] for k in p.get_fields()})
+                for i in range(5)]
+        assert vec.tolist() == rows, type(p).__name__
