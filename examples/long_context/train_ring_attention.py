@@ -20,7 +20,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from petastorm_tpu.etl.writer import write_dataset
 from petastorm_tpu.jax import JaxDataLoader
-from petastorm_tpu.ops import ring_attention
+from petastorm_tpu.ops import ring_attention, ulysses_attention
 from petastorm_tpu.reader import make_reader
 from petastorm_tpu.schema import Field, Schema
 
@@ -37,7 +37,10 @@ def generate_dataset(url: str, rows: int, seq_len: int, vocab: int,
 
 def train(dataset_url: str, steps: int, global_batch: int, seq_len: int,
           vocab: int, heads: int = 4, head_dim: int = 16,
-          data_par: int = 2):
+          data_par: int = 2, strategy: str = "ring"):
+    # both context-parallel strategies consume the same sequence-sharded
+    # loader delivery; 'ulysses' needs heads divisible by the seq axis
+    attend = ring_attention if strategy == "ring" else ulysses_attention
     n_dev = len(jax.devices())
     seq_par = max(n_dev // data_par, 1)
     mesh = Mesh(np.asarray(jax.devices()[:data_par * seq_par])
@@ -55,7 +58,7 @@ def train(dataset_url: str, steps: int, global_batch: int, seq_len: int,
         b, s = tokens.shape
         x = p["embed"][tokens]
         x = x.reshape(b, s, heads, head_dim).transpose(0, 2, 1, 3)
-        o = ring_attention(x, x, x, mesh=mesh, causal=True)
+        o = attend(x, x, x, mesh=mesh, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d_model)
         logits = o[:, :-1] @ p["out"]
         targets = jax.nn.one_hot(tokens[:, 1:], vocab)
@@ -88,7 +91,9 @@ if __name__ == "__main__":
     parser.add_argument("--vocab", type=int, default=256)
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--global-batch", type=int, default=8)
+    parser.add_argument("--strategy", choices=("ring", "ulysses"), default="ring")
     args = parser.parse_args()
     url = tempfile.mkdtemp(prefix="longctx_tpu_") + "/seqs"
     generate_dataset(url, args.rows, args.seq_len, args.vocab)
-    train(url, args.steps, args.global_batch, args.seq_len, args.vocab)
+    train(url, args.steps, args.global_batch, args.seq_len, args.vocab,
+          strategy=args.strategy)

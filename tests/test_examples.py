@@ -68,3 +68,7 @@ def test_long_context_smoke(tmp_path):
     losses = train(url, steps=3, global_batch=4, seq_len=32, vocab=64,
                    heads=2, head_dim=8, data_par=2)
     assert all(np.isfinite(v) for v in losses)
+    # the Ulysses variant trains on the same delivery (heads=4 divides seq=4)
+    losses = train(url, steps=2, global_batch=4, seq_len=32, vocab=64,
+                   heads=4, head_dim=8, data_par=2, strategy="ulysses")
+    assert all(np.isfinite(v) for v in losses)

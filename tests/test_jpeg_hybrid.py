@@ -297,3 +297,21 @@ def test_decode_placement_validation_errors(jpeg_ds):
     with pytest.raises(PetastormTpuError, match="not being read"):
         make_batch_reader(jpeg_ds, schema_fields=["idx"],
                           decode_placement={"image": "device"})
+
+
+def test_progressive_jpeg_hybrid_decode():
+    """jpeg_read_coefficients runs the full entropy decode, so progressive
+    streams (multi-scan) work identically to baseline."""
+    from petastorm_tpu.ops.jpeg import decode_jpeg_column
+
+    img = _smooth_rgb(64, 96)
+    prog = int(getattr(cv2, "IMWRITE_JPEG_PROGRESSIVE", -1))
+    if prog < 0:
+        pytest.skip("cv2 build lacks progressive encoding control")
+    ok, enc = cv2.imencode(".jpeg", cv2.cvtColor(img, cv2.COLOR_RGB2BGR),
+                           [int(cv2.IMWRITE_JPEG_QUALITY), 90, prog, 1])
+    assert ok
+    buf = enc.tobytes()
+    ours = np.asarray(decode_jpeg_column([buf]))[0]
+    ref = _cv2_decode(buf)
+    assert np.abs(ours.astype(int) - ref.astype(int)).max() <= 6
