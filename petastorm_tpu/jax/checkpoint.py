@@ -10,12 +10,14 @@ jobs resume both compute and data position together.
 Semantics inherited from the reader cursor (petastorm_tpu/reader.py docstring):
 the cursor counts *completed* work items, which can run ahead of what the
 loader delivered by the in-flight window (executor queues + loader prefetch +
-shuffling buffer) - including across a delivered-epoch boundary when
-``num_epochs > 1`` (the reader prefetches into the next epoch).  The cursor is
-strictly exact only when the reader is fully exhausted (a completed
-``num_epochs=1`` run); everywhere else resume skips at most the in-flight
-window.  To bound that window tightly, use ``shuffling_queue_capacity=0``,
-``prefetch=1`` and a small results queue.
+shuffling buffer + the HBM device shuffle buffer, whose ``capacity`` batches
+count toward the window in full) - including across a delivered-epoch
+boundary when ``num_epochs > 1`` (the reader prefetches into the next epoch).
+The cursor is strictly exact only when the reader is fully exhausted (a
+completed ``num_epochs=1`` run); everywhere else resume skips at most the
+in-flight window.  To bound that window tightly, use
+``shuffling_queue_capacity=0``, ``device_shuffle_capacity=0``, ``prefetch=1``
+and a small results queue.
 """
 
 from __future__ import annotations

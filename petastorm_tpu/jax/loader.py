@@ -505,7 +505,9 @@ class JaxDataLoader:
         ``make_reader(..., resume_from=...)`` / ``resume_reader_kwargs``);
         ``delivered_batches`` counts device batches handed to the consumer.
         Mid-epoch the reader cursor can run ahead of deliveries by the
-        in-flight window (see petastorm_tpu.jax.checkpoint module docs).
+        in-flight window - which includes ALL ``device_shuffle_capacity``
+        resident batches - so keep buffers small (or zero) when tight resume
+        matters (see petastorm_tpu.jax.checkpoint module docs).
         """
         if not hasattr(self._reader, "state_dict"):
             raise PetastormTpuError(
