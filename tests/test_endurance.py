@@ -1,0 +1,82 @@
+"""Leak guards: RSS must stay bounded over many epochs.
+
+Covers the paths with manual resource management: the C++ shm arena
+(process pool), the in-memory decoded-batch cache, and loader construction/
+teardown cycles.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.schema import Field, Schema
+
+
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+@pytest.fixture(scope="module")
+def small_ds(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp("endure") / "ds")
+    rng = np.random.default_rng(0)
+    write_dataset(url, Schema("E", [Field("id", np.int64),
+                                    Field("img", np.uint8, (32, 32, 3))]),
+                  [{"id": i, "img": rng.integers(0, 255, (32, 32, 3),
+                                                dtype=np.uint8)}
+                   for i in range(64)], row_group_size_rows=16)
+    return url
+
+
+def test_many_epochs_thread_pool_rss_bounded(small_ds):
+    with make_reader(small_ds, num_epochs=None, cache_type="memory") as r:
+        it = iter(r)
+        for _ in range(256):
+            next(it)
+        gc.collect()
+        base = _rss_mb()
+        for _ in range(64 * 40):  # 40 more epochs
+            next(it)
+    gc.collect()
+    growth = _rss_mb() - base
+    assert growth < 150, f"RSS grew {growth:.0f} MB over 40 epochs"
+
+
+def test_reader_construct_teardown_cycles_rss_bounded(small_ds):
+    for _ in range(3):  # warm allocator pools
+        with make_reader(small_ds, num_epochs=1) as r:
+            sum(1 for _ in r)
+    gc.collect()
+    base = _rss_mb()
+    for _ in range(15):
+        with make_reader(small_ds, num_epochs=1) as r:
+            sum(1 for _ in r)
+    gc.collect()
+    growth = _rss_mb() - base
+    assert growth < 100, f"RSS grew {growth:.0f} MB over 15 reader lifecycles"
+
+
+def test_process_pool_shm_arena_reclaims(small_ds):
+    """Repeated process-pool readers must not leak shm segments."""
+    import glob
+
+    def shm_count():
+        return len(glob.glob("/dev/shm/*"))
+
+    with make_reader(small_ds, reader_pool_type="process", workers_count=2,
+                     num_epochs=1) as r:
+        sum(1 for _ in r)
+    base = shm_count()
+    for _ in range(3):
+        with make_reader(small_ds, reader_pool_type="process", workers_count=2,
+                         num_epochs=1) as r:
+            assert sum(1 for _ in r) == 64
+    gc.collect()
+    assert shm_count() <= base + 1, "shared-memory segments leaked"

@@ -315,3 +315,19 @@ def test_progressive_jpeg_hybrid_decode():
     ours = np.asarray(decode_jpeg_column([buf]))[0]
     ref = _cv2_decode(buf)
     assert np.abs(ours.astype(int) - ref.astype(int)).max() <= 6
+
+
+def test_device_decode_with_process_pool(jpeg_ds):
+    """Raw jpeg-bytes columns survive the process pool's shm transport."""
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    with make_batch_reader(jpeg_ds, shuffle_row_groups=False, num_epochs=1,
+                           reader_pool_type="process", workers_count=2,
+                           decode_placement={"image": "device"}) as r:
+        with JaxDataLoader(r, batch_size=8, fields=["idx", "image"]) as loader:
+            batches = list(loader)
+    assert len(batches) == 4
+    assert all(b["image"].shape == (8, 64, 96, 3) for b in batches)
+    seen = sorted(int(i) for b in batches for i in np.asarray(b["idx"]))
+    assert seen == list(range(32))
