@@ -99,7 +99,11 @@ class JaxDataLoader:
         self._reader = reader
         self._mesh = mesh
         self._specs = shardings
-        self._pad_shapes = dict(pad_shapes or {})
+        # each entry: one target tuple, or a LIST of bucket tuples - the
+        # smallest bucket fitting the batch is chosen per batch, bounding XLA
+        # recompiles to the bucket count (SURVEY.md section 7 hard part (d))
+        self._pad_shapes = {name: _normalize_buckets(name, spec)
+                            for name, spec in (pad_shapes or {}).items()}
         self._pad_values = pad_values
         self._drop_last = drop_last
         self._keep_wide = keep_wide_dtypes
@@ -142,6 +146,12 @@ class JaxDataLoader:
                     " host_fields: host-side values cannot live in the HBM"
                     " buffer. Use the host shuffling buffer"
                     " (shuffling_queue_capacity) instead.")
+            bucketed = [n for n, b in self._pad_shapes.items() if len(b) > 1]
+            if bucketed:
+                raise PetastormTpuError(
+                    f"device_shuffle_capacity needs uniform batch shapes, but"
+                    f" {bucketed} use multi-bucket pad_shapes; give them a"
+                    " single pad target instead.")
             from petastorm_tpu.jax.device_buffer import DeviceShufflingBuffer
 
             self._device_buffer = DeviceShufflingBuffer(
@@ -232,8 +242,8 @@ class JaxDataLoader:
         for name in self._fields + self._host_fields:
             col = batch.columns[name]
             if name in self._pad_shapes:
-                col = _pad_to(col, self._pad_shapes[name],
-                              self._pad_value_for(name),
+                target = _pick_bucket(col, self._pad_shapes[name])
+                col = _pad_to(col, target, self._pad_value_for(name),
                               self._schema[name].dtype)
             cols[name] = col
         return ColumnBatch(cols, batch.num_rows)
@@ -590,6 +600,36 @@ def make_jax_loader(dataset_url: str,
         reader.stop()
         reader.join()
         raise
+
+
+def _normalize_buckets(name: str, spec) -> list:
+    """pad_shapes entry -> non-empty list of equal-rank bucket tuples, sorted
+    by total size (so 'smallest fitting bucket' is a linear scan)."""
+    buckets = [tuple(spec)] if spec and not isinstance(spec[0], (list, tuple)) \
+        else [tuple(b) for b in spec]
+    if not buckets:
+        raise PetastormTpuError(f"pad_shapes[{name!r}] is empty")
+    ranks = {len(b) for b in buckets}
+    if len(ranks) != 1:
+        raise PetastormTpuError(
+            f"pad_shapes[{name!r}] buckets must share one rank, got {buckets}")
+    return sorted(buckets, key=lambda b: (int(np.prod(b)), b))
+
+
+def _pick_bucket(col: np.ndarray, buckets: list) -> Tuple[int, ...]:
+    """Smallest bucket that fits every row of this batch (largest otherwise -
+    rows are then clipped, same semantics as a single too-small target)."""
+    if len(buckets) == 1:
+        return buckets[0]
+    if col.dtype != object:
+        need = col.shape[1:]
+    else:
+        shapes = np.array([np.asarray(r).shape for r in col])
+        need = tuple(shapes.max(axis=0)) if len(shapes) else buckets[0]
+    for b in buckets:
+        if len(b) == len(need) and all(t >= n for t, n in zip(b, need)):
+            return b
+    return buckets[-1]
 
 
 def _pad_to(col: np.ndarray, target: Tuple[int, ...], pad_value, dtype) -> np.ndarray:

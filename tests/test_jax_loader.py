@@ -422,3 +422,53 @@ def test_device_shuffle_tail_batch_stays_last(num_ds, devices):
         n = b.get("_valid_rows", b["idx"].shape[0])
         seen.extend(int(v) for v in np.asarray(b["idx"])[:n])
     assert sorted(seen) == list(range(64))
+
+
+def test_pad_to_bucket_bounded_shapes(tmp_path):
+    """Multi-bucket pad policy (SURVEY.md section 7 hard part (d)): each batch
+    lands on the smallest fitting bucket, bounding XLA recompiles."""
+    schema = Schema("B", [Field("idx", np.int64),
+                          Field("pts", np.float32, (None, 2))])
+    url = str(tmp_path / "buckets")
+    rng = np.random.default_rng(2)
+    # cluster lengths per rowgroup (8 rows) so batches land in different
+    # buckets: groups cycle small (<=8), mid (<=16), large (<=32)
+    caps = [8, 16, 32]
+    lengths = [int(rng.integers(1, caps[(i // 8) % 3] + 1)) for i in range(64)]
+    write_dataset(url, schema,
+                  [{"idx": i,
+                    "pts": np.full((lengths[i], 2), i, dtype=np.float32)}
+                   for i in range(64)], row_group_size_rows=8)
+    buckets = [(8, 2), (16, 2), (32, 2)]
+    reader = make_reader(url, shuffle_row_groups=False)
+    with JaxDataLoader(reader, batch_size=8,
+                       pad_shapes={"pts": buckets}, pad_values=-1.0) as loader:
+        seen_shapes = set()
+        for b in loader:
+            shape = tuple(b["pts"].shape[1:])
+            seen_shapes.add(shape)
+            assert shape in set(buckets)
+            # each row's real prefix is intact, padding is the pad value
+            for k, i in enumerate(np.asarray(b["idx"])):
+                row = np.asarray(b["pts"][k])
+                n = lengths[int(i)]
+                assert (row[:n] == float(i)).all()
+                assert (row[n:] == -1.0).all()
+    assert len(seen_shapes) > 1  # multiple buckets actually exercised
+
+
+def test_pad_bucket_validation(num_ds):
+    url, _ = num_ds
+    reader = make_reader(url, schema_fields=["idx"])
+    with pytest.raises(PetastormTpuError, match="share one rank"):
+        JaxDataLoader(reader, batch_size=8,
+                      pad_shapes={"idx": [(4,), (4, 2)]})
+    reader.stop(); reader.join()
+
+    reader2 = make_reader(url, shuffle_row_groups=False,
+                          schema_fields=["idx", "vec"])
+    with pytest.raises(PetastormTpuError, match="uniform batch shapes"):
+        JaxDataLoader(reader2, batch_size=8,
+                      pad_shapes={"vec": [(6,), (8,)]},
+                      device_shuffle_capacity=2)
+    reader2.stop(); reader2.join()
