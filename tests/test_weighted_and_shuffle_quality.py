@@ -127,3 +127,37 @@ def test_device_buffer_shuffle_quality(tmp_path):
     assert abs(rank_correlation(np.arange(256))) > 0.99  # sequential baseline
     shuffled = abs(rank_correlation(read_order(8)))
     assert shuffled < 0.5, shuffled
+
+
+def test_weighted_mixing_feeds_jax_loader(tmp_path):
+    """WeightedSamplingReader satisfies the loader's reader contract: mixed
+    datasets flow to the device as one stream."""
+    import numpy as np
+
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+    from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+    schema = Schema("M", [Field("source", np.int64), Field("v", np.float32)])
+
+    def make(name, source, n):
+        url = str(tmp_path / name)
+        write_dataset(url, schema,
+                      [{"source": source, "v": float(i)} for i in range(n)],
+                      row_group_size_rows=8)
+        return url
+
+    ra = make_batch_reader(make("a", 0, 64), num_epochs=None,
+                           reader_pool_type="serial")
+    rb = make_batch_reader(make("b", 1, 64), num_epochs=None,
+                           reader_pool_type="serial")
+    mixed = WeightedSamplingReader([ra, rb], [0.7, 0.3], seed=4)
+    sources = []
+    with JaxDataLoader(mixed, batch_size=16) as loader:
+        it = iter(loader)
+        for _ in range(24):
+            sources.extend(int(v) for v in np.asarray(next(it)["source"]))
+    frac_b = np.mean(np.asarray(sources) == 1)
+    assert 0.15 < frac_b < 0.45, frac_b  # ~0.3 mixing ratio reaches the device
