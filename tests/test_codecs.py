@@ -208,3 +208,45 @@ def test_scalar_decode_column_nullable_int_preserves_none():
 def test_scalar_list_registered_from_codecs_module():
     from petastorm_tpu.codecs import ScalarListCodec
     assert codec_from_json({"codec": "scalar_list"}) == ScalarListCodec()
+
+
+def test_ndarray_batched_decode_owns_its_data():
+    """Single-row and multi-row batched decodes return writable copies that
+    do NOT alias the arrow buffer (regression: n==1 relaxed-strides view)."""
+    import pyarrow as pa
+
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.schema import Field
+
+    nd = NdarrayCodec()
+    field = Field("v", np.float32, (4, 4), nd)
+    src = [np.full((4, 4), float(i), np.float32) for i in range(3)]
+    for rows in (src[:1], src):  # n==1 and n>1
+        col = pa.array([nd.encode(field, v) for v in rows], type=pa.binary())
+        out = nd.decode_column(field, col)
+        assert out.shape == (len(rows), 4, 4)
+        assert out.flags.writeable and out.base is None
+        out[0, 0, 0] = 999.0  # mutating the result...
+        again = nd.decode_column(field, col)
+        assert again[0, 0, 0] == 0.0  # ...must not corrupt the column
+
+
+def test_ndarray_batched_decode_sliced_and_mixed_lengths():
+    import pyarrow as pa
+
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.schema import Field
+
+    nd = NdarrayCodec()
+    field = Field("v", np.float32, (8,), nd)
+    src = [np.arange(8, dtype=np.float32) + i for i in range(20)]
+    col = pa.array([nd.encode(field, v) for v in src], type=pa.binary())
+    out = nd.decode_column(field, col.slice(5, 10))
+    assert np.array_equal(out, np.stack(src[5:15]))
+    # a variable-shape field (unequal cell lengths) falls back per-cell
+    vfield = Field("w", np.float32, (None,), nd)
+    vsrc = [np.arange(n, dtype=np.float32) for n in (3, 5, 2)]
+    vcol = pa.array([nd.encode(vfield, v) for v in vsrc], type=pa.binary())
+    vout = nd.decode_column(vfield, vcol)
+    assert vout.dtype == object
+    assert all(np.array_equal(a, b) for a, b in zip(vout, vsrc))
