@@ -389,10 +389,29 @@ class ScalarListCodec(Codec):
         return np.asarray(value, dtype=field.dtype)
 
     def decode_column(self, field, column: pa.Array) -> np.ndarray:
-        # Fast path: fixed-width lists vstack to a matrix; ragged stays object.
+        # Fast path: fixed-width numeric lists reshape straight from the
+        # arrow values buffer (one vectorized astype-copy, no per-element
+        # python); ragged or nullable columns fall back per cell.
+        n = len(column)
+        if (n and column.null_count == 0
+                and field.dtype.kind not in ("U", "S", "O")):
+            try:
+                lengths = np.unique(
+                    pa.compute.list_value_length(column).to_numpy())
+                if len(lengths) == 1:
+                    arr = (column.combine_chunks()
+                           if isinstance(column, pa.ChunkedArray) else column)
+                    flat = arr.flatten().to_numpy(zero_copy_only=False)
+                    # astype with copy=True: owning, writable, never aliasing
+                    # the arrow buffer (reshape first so the copy is the
+                    # final, base-less array)
+                    return flat.reshape(n, int(lengths[0])).astype(
+                        field.dtype, copy=True)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                pass
         pylist = column.to_pylist()
-        lengths = {len(v) for v in pylist if v is not None}
-        if len(lengths) == 1 and None not in pylist:
+        lens = {len(v) for v in pylist if v is not None}
+        if len(lens) == 1 and None not in pylist:
             return np.asarray(pylist, dtype=field.dtype)
         out = np.empty(len(pylist), dtype=object)
         for i, v in enumerate(pylist):

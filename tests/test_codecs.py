@@ -250,3 +250,28 @@ def test_ndarray_batched_decode_sliced_and_mixed_lengths():
     vout = nd.decode_column(vfield, vcol)
     assert vout.dtype == object
     assert all(np.array_equal(a, b) for a, b in zip(vout, vsrc))
+
+
+def test_scalar_list_vectorized_decode():
+    import pyarrow as pa
+
+    from petastorm_tpu.codecs import ScalarListCodec
+    from petastorm_tpu.schema import Field
+
+    sc = ScalarListCodec()
+    field = Field("v", np.float32, (None,), sc)
+    src = [np.arange(16, dtype=np.float32) + i for i in range(64)]
+    col = pa.array([v.tolist() for v in src])
+    out = sc.decode_column(field, col)
+    assert out.shape == (64, 16) and out.dtype == np.float32
+    assert out.flags.writeable and out.base is None
+    assert np.allclose(out, np.stack(src))
+    # slice-aware, chunk-aware, ragged and nullable fallbacks
+    assert np.allclose(sc.decode_column(field, col.slice(10, 5)),
+                       np.stack(src[10:15]))
+    chunked = pa.chunked_array([col.slice(0, 32), col.slice(32, 32)])
+    assert np.allclose(sc.decode_column(field, chunked), np.stack(src))
+    ragged = sc.decode_column(field, pa.array([[1.0], [1.0, 2.0]]))
+    assert ragged.dtype == object
+    withnull = sc.decode_column(field, pa.array([[1.0, 2.0], None]))
+    assert withnull[1] is None
