@@ -231,7 +231,20 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
             files = sorted(f.path for f in fs.get_file_info(selector)
                            if f.type == pafs.FileType.File and _is_data_file(f.path))
     else:
-        files = sorted(path_or_paths)
+        files = []
+        for p in path_or_paths:
+            info = fs.get_file_info(p)
+            if info.type == pafs.FileType.NotFound:
+                raise MetadataError(f"Dataset path not found: {p!r}")
+            if info.type == pafs.FileType.File:
+                files.append(p)
+            else:  # a directory in the list: expand it (reference contract is
+                # file lists; accepting dirs beats pyarrow's obscure OSError)
+                selector = pafs.FileSelector(p, recursive=True)
+                files.extend(f.path for f in fs.get_file_info(selector)
+                             if f.type == pafs.FileType.File
+                             and _is_data_file(f.path))
+        files = sorted(files)
         # dataset root = longest common directory prefix, then strip any trailing
         # hive 'key=value' segments - so partition values survive both for lists
         # spanning partitions AND for a list drawn from a single partition, and

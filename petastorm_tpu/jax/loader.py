@@ -37,6 +37,7 @@ import logging
 import os
 import queue
 import threading
+import time
 from typing import Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
@@ -175,6 +176,10 @@ class JaxDataLoader:
         self._finished = False
         self._failure: Optional[BaseException] = None
         self._delivered_batches = 0
+        #: cumulative seconds the consumer spent blocked waiting for a batch
+        #: (the live device-idle signal; see also the throughput CLI's
+        #: --simulated-step-ms for an offline measurement)
+        self._consumer_wait_s = 0.0
         #: when set, a jax.profiler trace (device + host ingest activity,
         #: viewable in TensorBoard/Perfetto) brackets the loader's lifetime
         self._trace_dir = trace_dir
@@ -446,6 +451,7 @@ class JaxDataLoader:
         out = {"prefetch_depth": depth,
                "prefetch_capacity": self._out.maxsize,
                "delivered_batches": self._delivered_batches,
+               "consumer_wait_s": self._consumer_wait_s,
                "finished": self._finished}
         reader_diag = getattr(self._reader, "diagnostics", None)
         if isinstance(reader_diag, dict):
@@ -474,9 +480,11 @@ class JaxDataLoader:
             raise StopIteration  # repeatable after exhaustion (iterator protocol)
         if not self._started:
             iter(self)
+        wait_start = time.perf_counter()
         while True:
             try:
                 value = self._out.get(timeout=_QUEUE_POLL_S)
+                self._consumer_wait_s += time.perf_counter() - wait_start
                 break
             except queue.Empty:
                 if self._stop_event.is_set():

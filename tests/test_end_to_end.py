@@ -442,3 +442,22 @@ def test_memory_cache_object_column_sizing():
     cache.get("k", fill)
     cache.get("k", fill)
     assert calls["n"] == 2
+
+
+def test_batch_reader_over_multiple_urls(tmp_path):
+    """make_batch_reader accepts a homogeneous URL list (reference:
+    dataset_url_or_urls, reader.py:179)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.reader import make_batch_reader
+
+    for name, lo in (("p1", 0), ("p2", 100)):
+        d = tmp_path / name
+        d.mkdir()
+        pq.write_table(pa.table({"a": list(range(lo, lo + 10))}),
+                       str(d / "x.parquet"))
+    urls = [str(tmp_path / "p1"), str(tmp_path / "p2")]
+    with make_batch_reader(urls, shuffle_row_groups=False, num_epochs=1) as r:
+        got = sorted(int(v) for b in r for v in b.a)
+    assert got == list(range(10)) + list(range(100, 110))
