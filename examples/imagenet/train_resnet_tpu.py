@@ -25,7 +25,7 @@ from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
 from petastorm_tpu.etl.writer import write_dataset
 from petastorm_tpu.jax import JaxDataLoader
 from petastorm_tpu.models import ResNet50
-from petastorm_tpu.ops import normalize_images
+from petastorm_tpu.ops import normalize_images, random_flip
 from petastorm_tpu.reader import make_reader
 from petastorm_tpu.schema import Field, Schema
 
@@ -64,9 +64,10 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
     opt_state = tx.init(params)
 
     @jax.jit
-    def train_step(params, opt_state, image_u8, label):
+    def train_step(params, opt_state, image_u8, label, key):
         def loss_fn(p):
-            x = normalize_images(image_u8)  # on-chip uint8 -> bf16 + scale
+            imgs = random_flip(image_u8, key)   # on-chip augmentation
+            x = normalize_images(imgs)          # on-chip uint8 -> bf16 + scale
             logits = model.apply(p, x)
             onehot = jax.nn.one_hot(label, num_classes)
             return -(jax.nn.log_softmax(logits) * onehot).sum(-1).mean()
@@ -91,14 +92,17 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
                        shardings={"image": P("data"), "label": P("data")}) as loader:
         it = iter(loader)
         # warmup (compile)
+        aug_key = jax.random.PRNGKey(17)
         batch = next(it)
         params, opt_state, loss = train_step(params, opt_state,
-                                             batch["image"], batch["label"])
+                                             batch["image"], batch["label"],
+                                             aug_key)
         jax.block_until_ready(loss)
         t0 = time.perf_counter()
         for batch in it:
             params, opt_state, loss = train_step(params, opt_state,
-                                                 batch["image"], batch["label"])
+                                                 batch["image"], batch["label"],
+                                                 jax.random.fold_in(aug_key, step))
             step += 1
             if step >= steps:
                 break

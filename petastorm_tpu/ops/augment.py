@@ -1,0 +1,57 @@
+"""On-device batched image augmentation: random crop + horizontal flip.
+
+The standard ImageNet training transforms, run ON-CHIP after delivery (or
+after the hybrid jpeg decode) instead of on host workers: uint8 in, uint8
+out, fully batched under ``jit`` with per-image randomness derived from one
+key.  Host workers stay decode-only, the augmentation costs no host CPU and
+no extra host->device bytes, and XLA fuses the gather/flip into whatever
+follows (normalize, first conv).
+
+Reference analog: none - the reference leaves augmentation to the consumer
+framework (torchvision/tf.image on host).  Keeping it device-side is the
+TPU-first translation of that stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("crop_hw",))
+def random_crop(images: jax.Array, key: jax.Array,
+                crop_hw: Tuple[int, int]) -> jax.Array:
+    """Per-image random crop of an (N, H, W, C) batch to (N, ch, cw, C)."""
+    n, h, w, _ = images.shape
+    ch, cw = crop_hw
+    if ch > h or cw > w:
+        raise ValueError(f"crop {crop_hw} larger than image {(h, w)}")
+    ky, kx = jax.random.split(key)
+    ys = jax.random.randint(ky, (n,), 0, h - ch + 1)
+    xs = jax.random.randint(kx, (n,), 0, w - cw + 1)
+
+    def crop_one(img, y, x):
+        return jax.lax.dynamic_slice(img, (y, x, 0),
+                                     (ch, cw, img.shape[-1]))
+
+    return jax.vmap(crop_one)(images, ys, xs)
+
+
+@jax.jit
+def random_flip(images: jax.Array, key: jax.Array) -> jax.Array:
+    """Per-image horizontal flip with probability 0.5, (N, H, W, C)."""
+    flip = jax.random.bernoulli(key, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+@functools.partial(jax.jit, static_argnames=("crop_hw",))
+def random_crop_flip(images: jax.Array, key: jax.Array,
+                     crop_hw: Optional[Tuple[int, int]] = None) -> jax.Array:
+    """Crop (when ``crop_hw`` is set) then flip - the ImageNet train pair."""
+    k1, k2 = jax.random.split(key)
+    if crop_hw is not None:
+        images = random_crop(images, k1, crop_hw)
+    return random_flip(images, k2)

@@ -95,3 +95,46 @@ def test_graft_entry_shapes():
     fn, args = entry()
     shape = jax.eval_shape(fn, *args)
     assert shape.shape == (8, 1000)
+
+
+def test_random_crop_flip_augmentation():
+    """On-device batched augmentation: correct geometry, per-image
+    randomness, deterministic per key, pixels preserved (no interpolation)."""
+    from petastorm_tpu.ops import random_crop, random_crop_flip, random_flip
+
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.integers(0, 255, (16, 12, 10, 3), dtype=np.uint8))
+    key = jax.random.PRNGKey(7)
+
+    crops = random_crop(imgs, key, (8, 6))
+    assert crops.shape == (16, 8, 6, 3) and crops.dtype == jnp.uint8
+    # every crop is a contiguous window of its source image
+    src = np.asarray(imgs)
+    for i, c in enumerate(np.asarray(crops)):
+        found = any(np.array_equal(src[i, y:y + 8, x:x + 6], c)
+                    for y in range(5) for x in range(5))
+        assert found, i
+    # distinct offsets across the batch (overwhelmingly likely)
+    assert len({c.tobytes() for c in np.asarray(crops)}) > 1
+
+    flipped = random_flip(imgs, key)
+    f = np.asarray(flipped)
+    states = {True: 0, False: 0}
+    for i in range(16):
+        if np.array_equal(f[i], src[i]):
+            states[False] += 1
+        else:
+            assert np.array_equal(f[i], src[i, :, ::-1])
+            states[True] += 1
+    assert states[True] > 0 and states[False] > 0  # both outcomes occur
+
+    both = random_crop_flip(imgs, key, crop_hw=(8, 6))
+    assert both.shape == (16, 8, 6, 3)
+    # deterministic per key, and the key actually drives the outcome
+    assert np.array_equal(np.asarray(both),
+                          np.asarray(random_crop_flip(imgs, key, crop_hw=(8, 6))))
+    other = random_crop_flip(imgs, jax.random.PRNGKey(8), crop_hw=(8, 6))
+    assert not np.array_equal(np.asarray(both), np.asarray(other))
+
+    with pytest.raises(ValueError, match="larger than"):
+        random_crop(imgs, key, (20, 6))
