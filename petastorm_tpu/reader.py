@@ -29,6 +29,7 @@ Design differences (TPU-first):
 from __future__ import annotations
 
 import logging
+import os
 import queue
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -57,7 +58,7 @@ _DEFAULT_RESULTS_QUEUE_BATCHES = 10  # batches are whole rowgroups; keep RAM bou
 def make_reader(dataset_url: str,
                 schema_fields: Optional[Sequence] = None,
                 reader_pool_type: str = "thread",
-                workers_count: int = 4,
+                workers_count: Union[int, str] = 4,
                 results_queue_size: int = _DEFAULT_RESULTS_QUEUE_BATCHES,
                 shuffle_row_groups: bool = True,
                 shuffle_row_drop_partitions: int = 1,
@@ -124,7 +125,7 @@ def elastic_resume(states: Sequence[dict]) -> dict:
 def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       schema_fields: Optional[Sequence] = None,
                       reader_pool_type: str = "thread",
-                      workers_count: int = 4,
+                      workers_count: Union[int, str] = 4,
                       results_queue_size: int = _DEFAULT_RESULTS_QUEUE_BATCHES,
                       shuffle_row_groups: bool = True,
                       shuffle_row_drop_partitions: int = 1,
@@ -293,6 +294,14 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    verify_checksums=verify_checksums,
                                    raw_fields=device_fields)
 
+    if workers_count == "auto":
+        # size to the usable cores (cgroup/affinity-aware), one left for the
+        # consumer, capped at the reference's default pool size of 10
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:
+            cores = os.cpu_count() or 1
+        workers_count = max(1, min(10, cores - 1))
     executor = make_executor(reader_pool_type, workers_count, results_queue_size)
     start_item = 0
     if resume_from is not None and "elastic" not in resume_from:

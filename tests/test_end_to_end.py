@@ -461,3 +461,26 @@ def test_batch_reader_over_multiple_urls(tmp_path):
     with make_batch_reader(urls, shuffle_row_groups=False, num_epochs=1) as r:
         got = sorted(int(v) for b in r for v in b.a)
     assert got == list(range(10)) + list(range(100, 110))
+
+
+def test_workers_count_auto(tmp_path):
+    """'auto' sizes the pool to usable cores (affinity-aware), capped at the
+    reference's default of 10, leaving one core for the consumer."""
+    import os
+
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    url = str(tmp_path / "ds")
+    write_dataset(url, Schema("A", [Field("id", np.int64)]),
+                  [{"id": i} for i in range(16)], row_group_size_rows=8)
+    with make_batch_reader(url, workers_count="auto", num_epochs=1) as r:
+        got = sorted(int(v) for b in r.iter_batches() for v in b.columns["id"])
+        workers = r.diagnostics["workers_count"]
+    assert got == list(range(16))
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    assert workers == max(1, min(10, cores - 1))
