@@ -89,3 +89,43 @@ def test_random_schema_roundtrip(tmp_path, seed):
                 assert np.asarray(have) == np.asarray(want), (seed, f.name, i)
             else:
                 assert np.array_equal(np.asarray(have), want), (seed, f.name, i)
+
+
+@pytest.mark.parametrize("seed", [101, 130])
+def test_random_schema_roundtrip_batch_path(tmp_path, seed):
+    """Same property through make_batch_reader's columnar assembly."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    rng = np.random.default_rng(seed)
+    n_fields = int(rng.integers(2, 7))
+    fields = [Field("id", np.int64)] + [_random_field(rng, i)
+                                        for i in range(n_fields)]
+    schema = Schema(f"FuzzB{seed}", fields)
+    rows = []
+    for i in range(24):
+        row = {"id": i}
+        for f in fields[1:]:
+            row[f.name] = (None if (f.nullable and rng.integers(0, 4) == 0)
+                           else _random_value(rng, f))
+        rows.append(row)
+    url = str(tmp_path / f"dsb{seed}")
+    write_dataset(url, schema, rows, row_group_size_rows=8)
+    seen = {}
+    with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1) as r:
+        for b in r.iter_batches():
+            for k, i in enumerate(b.columns["id"]):
+                seen[int(i)] = {f.name: b.columns[f.name][k]
+                                for f in fields[1:]}
+    assert sorted(seen) == list(range(24))
+    for i, src in enumerate(rows):
+        for f in fields[1:]:
+            want, have = src[f.name], seen[i][f.name]
+            if want is None:
+                assert have is None or (isinstance(have, float)
+                                        and np.isnan(have)), (seed, f.name, i)
+            elif isinstance(want, str):
+                assert have == want, (seed, f.name, i)
+            elif np.ndim(want) == 0:
+                assert np.asarray(have) == np.asarray(want), (seed, f.name, i)
+            else:
+                assert np.array_equal(np.asarray(have), want), (seed, f.name, i)
