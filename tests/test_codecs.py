@@ -275,3 +275,19 @@ def test_scalar_list_vectorized_decode():
     assert ragged.dtype == object
     withnull = sc.decode_column(field, pa.array([[1.0, 2.0], None]))
     assert withnull[1] is None
+
+
+def test_ndarray_batched_decode_truncated_cell_raises():
+    """A corrupt/truncated npy cell in a fixed-shape column must raise, not
+    silently decode garbage through the vectorized fast path."""
+    import pyarrow as pa
+
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.schema import Field
+
+    nd = NdarrayCodec()
+    field = Field("v", np.float32, (4,), nd)
+    good = nd.encode(field, np.zeros(4, np.float32))
+    col = pa.array([good, good[:-3]], type=pa.binary())
+    with pytest.raises(Exception):
+        nd.decode_column(field, col)
