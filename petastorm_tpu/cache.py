@@ -22,6 +22,8 @@ from typing import Any, Callable
 
 logger = logging.getLogger(__name__)
 
+_MISSING = object()  # sentinel: cache miss vs a legitimately-None entry
+
 
 class CacheBase(ABC):
     @abstractmethod
@@ -113,17 +115,23 @@ class InMemoryCache(CacheBase):
         return _copy.deepcopy(value)
 
     def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
+        # copy OUTSIDE the lock: entries are immutable once stored (eviction
+        # only drops references), and the defensive copy of a big image batch
+        # is exactly the work that must not serialize all pool workers
         with self._lock:
-            if key in self._entries:
+            entry = self._entries.get(key, _MISSING)
+            if entry is not _MISSING:
                 self._entries.move_to_end(key)
-                return self._copy_value(self._entries[key])
+        if entry is not _MISSING:
+            return self._copy_value(entry)
         value = fill_cache_func()
         size = self._estimate_size(value)
         if size > self._size_limit:
             return value  # single entry over the cap: serve uncached
+        stored = self._copy_value(value)
         with self._lock:
             if key not in self._entries:
-                self._entries[key] = self._copy_value(value)
+                self._entries[key] = stored
                 self._sizes[key] = size
                 self._total += size
                 while self._total > self._size_limit and len(self._entries) > 1:

@@ -34,21 +34,20 @@ TPU-specific behavior:
 from __future__ import annotations
 
 import logging
-import os
 import queue
 import threading
 import time
 from typing import Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from petastorm_tpu.batch import ColumnBatch
 from petastorm_tpu.dtypes import jax_feed_dtype
 from petastorm_tpu.errors import PetastormTpuError
-from petastorm_tpu.parallel.mesh import local_data_slice, sharding_for_batch
+from petastorm_tpu.native.image import COEF_COLUMN_SEP as _COEF_SEP
+from petastorm_tpu.parallel.mesh import local_data_slice
 from petastorm_tpu.shuffle import (NoopShufflingBuffer, RandomShufflingBuffer,
                                    iter_batched)
 
@@ -124,6 +123,13 @@ class JaxDataLoader:
         if unknown:
             raise PetastormTpuError(f"Unknown fields {unknown}; schema has"
                                     f" {[f.name for f in schema]}")
+        host_device = [f for f in self._host_fields if f in self._device_decode]
+        if host_device:
+            raise PetastormTpuError(
+                f"fields {host_device} use decode_placement='device' (the"
+                " worker ships coefficient planes, not pixels) and cannot be"
+                " delivered host-side; use decode_placement='host' or drop"
+                " them from host_fields")
         if not self._fields:
             raise PetastormTpuError(
                 "JaxDataLoader needs at least one device-deliverable field"
@@ -245,6 +251,13 @@ class JaxDataLoader:
     def _prepare(self, batch: ColumnBatch) -> ColumnBatch:
         cols: Dict[str, np.ndarray] = {}
         for name in self._fields + self._host_fields:
+            if name in self._device_decode:
+                # the worker shipped the field as derived coefficient-plane
+                # columns ('<name>#...'); pass them through batch assembly
+                for key, col in batch.columns.items():
+                    if key.startswith(name + _COEF_SEP):
+                        cols[key] = col
+                continue
             col = batch.columns[name]
             if name in self._pad_shapes:
                 target = _pick_bucket(col, self._pad_shapes[name])
@@ -291,16 +304,16 @@ class JaxDataLoader:
             self._sentinel_pending = True
 
     def _emit(self, host_batch: ColumnBatch) -> None:
-        cols = {n: host_batch.columns[n] for n in self._fields}
-        # raw jpeg-bytes columns go through the hybrid decode path, not the
-        # generic pad/transfer below (object arrays cannot be zero-padded)
-        raw_cols = {n: cols.pop(n) for n in self._device_decode if n in cols}
+        cols = {n: host_batch.columns[n] for n in self._fields
+                if n not in self._device_decode}
         if self._transform_fn is not None:
             cols = self._transform_fn(cols)
         device_batch = {}
         valid_rows = host_batch.num_rows
-        for name, raw in raw_cols.items():
-            device_batch[name] = self._decode_on_device(name, raw)
+        for name in self._device_decode:
+            if name in self._fields:
+                device_batch[name] = self._decode_on_device(
+                    name, host_batch.columns)
         if self._mesh is not None and valid_rows < self._local_rows:
             # partial final batch on a mesh: zero-pad to the static local batch so
             # the global shape (and the consumer's jit signature) never changes -
@@ -339,49 +352,36 @@ class JaxDataLoader:
             return
         self._push(device_batch)
 
-    def _decode_on_device(self, name: str, raw_col: np.ndarray) -> jax.Array:
-        """Hybrid jpeg decode of one raw-bytes column (decode_placement='device').
+    def _decode_on_device(self, name: str, columns: Dict[str, np.ndarray]
+                          ) -> jax.Array:
+        """Finish the hybrid jpeg decode of one field (decode_placement='device').
 
-        Host runs only libjpeg's entropy decoder (one GIL-released C call);
-        the coefficient planes ship to the device(s) batch-sharded and the
-        FLOP-heavy dequant + IDCT + upsample + color runs on-chip, sharded,
-        with no cross-shard communication (petastorm_tpu/ops/jpeg.py).
+        The entropy half already ran in the pool workers - ``columns`` holds
+        the field's derived coefficient-plane columns ('<name>#...', see
+        native/image.py pack_coef_columns).  Here the planes ship to the
+        device(s) batch-sharded and the FLOP-heavy dequant + IDCT + upsample +
+        color runs on-chip, sharded, with no cross-shard communication
+        (petastorm_tpu/ops/jpeg.py).
         """
         from petastorm_tpu.errors import CodecError
-        from petastorm_tpu.native.image import read_jpeg_coefficients_column
+        from petastorm_tpu.native.image import unpack_coef_columns
         from petastorm_tpu.ops.jpeg import decode_coefficients, decode_from_layout
 
         field = self._schema[name]
-        cells = list(raw_col)
-        # the entropy half runs in this (single) producer thread: fan out the
-        # batched C call over cores on real TPU host VMs (GIL released);
-        # sched_getaffinity respects cgroup/affinity limits where available
-        try:
-            cores = len(os.sched_getaffinity(0))
-        except AttributeError:
-            cores = os.cpu_count() or 1
-        nthreads = max(1, min(8, cores - 1))
-        try:
-            planes, qtabs, layout = read_jpeg_coefficients_column(
-                cells, nthreads=nthreads)
-        except CodecError as exc:
-            # mixed subsampling/geometry inside one batch (e.g. encoder
-            # settings changed mid-dataset): decode this batch on host
-            logger.warning("device decode of %r fell back to host for one"
-                           " batch: %s", name, exc)
-            return self._host_decode_fallback(field, cells)
+        planes, qtabs, layout = unpack_coef_columns(name, columns)
         if (layout.height, layout.width) != tuple(field.shape[:2]):
             raise CodecError(
                 f"field {name!r}: stored jpeg is {layout.height}x{layout.width},"
                 f" schema says {tuple(field.shape[:2])}")
         sampling = tuple((h, v) for (h, v, _, _) in layout.components)
+        n = len(qtabs)
         if self._mesh is None:
             out = decode_from_layout(planes, qtabs, layout)
         else:
-            if len(cells) < self._local_rows:
+            if n < self._local_rows:
                 # zero coefficient blocks decode to flat gray padding rows
                 # ('_valid_rows' marks how many are real, as for host fields)
-                pad = self._local_rows - len(cells)
+                pad = self._local_rows - n
                 planes = [np.concatenate(
                     [p, np.zeros((pad,) + p.shape[1:], p.dtype)]) for p in planes]
                 qtabs = np.concatenate(
@@ -403,20 +403,6 @@ class JaxDataLoader:
         if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 3:
             out = out[..., None]  # honor a declared (H, W, 1) grayscale shape
         return out
-
-    def _host_decode_fallback(self, field, cells) -> jax.Array:
-        """Per-image host decode of one batch (mixed-geometry escape hatch)."""
-        out = np.stack([field.codec.decode(field, c) for c in cells])
-        if self._mesh is None:
-            return jax.device_put(out)
-        if len(cells) < self._local_rows:
-            pad = self._local_rows - len(cells)
-            out = np.concatenate(
-                [out, np.zeros((pad,) + out.shape[1:], out.dtype)])
-        sharding, sl, global_shape = self._placement_for(field.name,
-                                                         out.shape[1:])
-        return jax.make_array_from_process_local_data(
-            sharding, out[(slice(None),) + sl[1:]], global_shape)
 
     def _placement_for(self, name: str, trailing: Tuple[int, ...]
                        ) -> Tuple[NamedSharding, Tuple[slice, ...], Tuple[int, ...]]:

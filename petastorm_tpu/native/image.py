@@ -216,6 +216,75 @@ def _layout_meta(layout: JpegCoefLayout) -> np.ndarray:
     return meta
 
 
+#: separator for derived coefficient-plane column names: a device-decode
+#: field ``img`` travels the pipeline as ``img#p0..img#p{ncomp-1}`` (int16
+#: block planes), ``img#q`` (uint16 quant tables) and ``img#m`` (int32 layout
+#: meta, identical per row).  Fixed-shape numpy columns ride the shuffle
+#: buffers, the rebatcher and the shm arena like any other column - the
+#: entropy half of the decode runs in pool workers, not the loader thread.
+COEF_COLUMN_SEP = "#"
+
+
+def pack_coef_columns(name: str, column, field=None, nthreads: int = 1) -> dict:
+    """Entropy-decode a jpeg column into its derived plane columns.
+
+    Worker side of the device-decode path: one GIL-released C call per
+    rowgroup; the output dict's arrays are all fixed-shape per geometry, so
+    downstream batching/shuffling/shm transport treat them as ordinary
+    columns.  ``field`` (optional Schema field) enables the early
+    schema-shape check.  Raises CodecError with migration guidance when the
+    dataset's jpeg geometry is not uniform - the device path compiles the
+    on-chip decode once per geometry, so mixed-subsampling datasets belong
+    on decode_placement='host'.
+    """
+    from petastorm_tpu.errors import CodecError
+
+    try:
+        planes, qtabs, layout = read_jpeg_coefficients_column(
+            column, nthreads=nthreads)
+    except CodecError as exc:
+        raise CodecError(
+            f"decode_placement='device' field {name!r}: {exc}. The device"
+            " decode path requires every stored jpeg to share one geometry"
+            " and subsampling (XLA compiles the on-chip decode per"
+            " geometry); re-encode the column uniformly or use"
+            " decode_placement='host'.") from exc
+    if field is not None and (layout.height, layout.width) != tuple(field.shape[:2]):
+        raise CodecError(
+            f"field {name!r}: stored jpeg is {layout.height}x{layout.width},"
+            f" schema says {tuple(field.shape[:2])}")
+    n = len(qtabs)
+    out = {f"{name}{COEF_COLUMN_SEP}p{c}": p for c, p in enumerate(planes)}
+    out[f"{name}{COEF_COLUMN_SEP}q"] = qtabs
+    out[f"{name}{COEF_COLUMN_SEP}m"] = np.broadcast_to(
+        _layout_meta(layout), (n, _JPEG_META_LEN))
+    return out
+
+
+def unpack_coef_columns(name: str, columns: dict):
+    """Consumer side: derived columns of one assembled batch ->
+    ``(planes, qtabs, layout)``.  Verifies the rows share one geometry -
+    batch assembly may have concatenated different rowgroups."""
+    from petastorm_tpu.errors import CodecError
+
+    meta_col = columns[f"{name}{COEF_COLUMN_SEP}m"]
+    if len(meta_col) == 0:
+        raise CodecError(f"field {name!r}: empty coefficient batch")
+    if not (meta_col == meta_col[0]).all():
+        raise CodecError(
+            f"field {name!r}: jpeg geometry changes between rowgroups of"
+            " this dataset; the device decode path needs one uniform"
+            " geometry - use decode_placement='host'.")
+    meta = meta_col[0]
+    ncomp = int(meta[0])
+    comps = tuple(tuple(int(v) for v in meta[3 + 4 * c: 7 + 4 * c])
+                  for c in range(ncomp))
+    layout = JpegCoefLayout(int(meta[1]), int(meta[2]), comps)
+    planes = [columns[f"{name}{COEF_COLUMN_SEP}p{c}"] for c in range(ncomp)]
+    qtabs = columns[f"{name}{COEF_COLUMN_SEP}q"]
+    return planes, qtabs, layout
+
+
 def read_jpeg_coefficients_column(column, nthreads: int = 1):
     """Entropy-decode a column of same-geometry JPEGs into stacked planes.
 

@@ -45,6 +45,10 @@ class ShmBatchRef:
     num_rows: int
     #: name -> ("shm", dtype_str, shape, rel_offset) | ("inline", ndarray/list)
     columns: Dict[str, Tuple]
+    #: ventilation ordinal carried across the shm hop so the Reader's
+    #: exact-contiguous-prefix resume cursor survives the process-pool
+    #: transport (ColumnBatch.ordinal semantics, batch.py:22-26)
+    ordinal: Optional[int] = None
 
 
 class _Lease:
@@ -121,7 +125,7 @@ def encode_batch(arena: SharedArena, batch: Any,
         np.copyto(dst, col)
     del dst, view  # drop buffer exports so a later arena.close() can unmap
     return ShmBatchRef(offset=offset, total_bytes=total, num_rows=batch.num_rows,
-                       columns=meta)
+                       columns=meta, ordinal=batch.ordinal)
 
 
 def decode_batch(arena: SharedArena, ref: Any) -> Any:
@@ -141,7 +145,7 @@ def decode_batch(arena: SharedArena, ref: Any) -> Any:
                                        offset=rel).reshape(shape)
         else:
             cols[name] = entry[1]
-    return ColumnBatch(cols, ref.num_rows)
+    return ColumnBatch(cols, ref.num_rows, ordinal=ref.ordinal)
 
 
 class _ShmEncodingFn:

@@ -114,17 +114,28 @@ def distributed_write_dataset(url: str,
             # peers check this marker after the barrier instead of writing
             # into a dirty/rejected target and hanging at the next barrier
             _drop_fail_marker(fs, root, "preflight")
+    peer_error: Optional[BaseException] = None
     try:
         sync("petastorm_tpu:distributed_write:preflight")
+        if process_index != 0 and fs.get_file_info(
+                posixpath.join(root, f"{_FAIL_MARKER}.preflight")
+                ).type == pafs.FileType.File:
+            peer_error = PetastormTpuError(
+                f"distributed write to {url!r} aborted: preflight failed on"
+                " host 0 (see its log)")
+        # second barrier: every host has now observed (or not) the preflight
+        # marker, so host 0 can remove it before raising - a mode='error'
+        # rerun against a healthy dataset must not leave failure debris behind
+        sync("petastorm_tpu:distributed_write:preflight-observed")
     finally:
+        # raise-in-finally deliberately outranks a barrier failure: the
+        # actionable preflight/peer message must win over a sync timeout, and
+        # the marker must be cleared even when a peer crashed mid-barrier
         if preflight_error is not None:
-            raise preflight_error
-    if process_index != 0 and fs.get_file_info(
-            posixpath.join(root, f"{_FAIL_MARKER}.preflight")
-            ).type == pafs.FileType.File:
-        raise PetastormTpuError(
-            f"distributed write to {url!r} aborted: preflight failed on"
-            " host 0 (see its log)")
+            _clear_fail_marker(fs, root, "preflight")
+            raise preflight_error  # noqa: B012
+        if peer_error is not None:
+            raise peer_error  # noqa: B012
 
     # phase 2 - every host writes its own part files (append is safe now:
     # the only files present are peers' parts from this same job).  A failed
@@ -199,3 +210,10 @@ def _drop_fail_marker(fs: pafs.FileSystem, root: str, idx) -> None:
             f.write(b"")
     except Exception as exc:  # noqa: BLE001 - marker is best-effort
         logger.warning("could not write failure marker: %s", exc)
+
+
+def _clear_fail_marker(fs: pafs.FileSystem, root: str, idx) -> None:
+    try:
+        fs.delete_file(posixpath.join(root, f"{_FAIL_MARKER}.{idx}"))
+    except Exception as exc:  # noqa: BLE001 - cleanup is best-effort
+        logger.warning("could not remove failure marker: %s", exc)

@@ -124,6 +124,11 @@ class DataLoader(LoaderBase):
     ``shuffling_queue_capacity`` > 0 enables the row-level random buffer with a
     ``min_after_retrieve`` decorrelation floor at half capacity (reference
     shuffling_queue_capacity/min_after_dequeue, pytorch.py:143-189).
+
+    NGram readers yield nested ``{offset: {field: tensor}}`` window batches
+    (reference collates window dicts the same way, pytorch.py:130-254);
+    ``stack_timesteps=True`` readers keep the flat dict - their stacked
+    fields are already ``(batch, k, ...)`` tensors.
     """
 
     def __init__(self, reader, batch_size: int = 1,
@@ -131,10 +136,12 @@ class DataLoader(LoaderBase):
                  seed: Optional[int] = None,
                  collate_fn: Optional[Callable[[Dict], Dict]] = None):
         super().__init__()
-        if getattr(reader, "ngram", None) is not None:
+        if getattr(reader, "device_decode_fields", None):
             raise PetastormTpuError(
-                "NGram readers are not supported by the torch loaders: use the"
-                " row path (iterate the reader) or the jax loader")
+                f"fields {reader.device_decode_fields} use"
+                " decode_placement='device' (raw jpeg bytes finished on-chip"
+                " by the jax loader); torch loaders need"
+                " decode_placement='host'")
         if batch_size < 1:
             raise PetastormTpuError("batch_size must be >= 1")
         self.reader = reader
@@ -142,6 +149,12 @@ class DataLoader(LoaderBase):
         self.shuffling_queue_capacity = shuffling_queue_capacity
         self._seed = seed
         self._collate_fn = collate_fn
+        #: non-stacked ngram readers emit '<offset>/<field>' columns; collate
+        #: them back into {offset: {field: tensor}} like the reference's row
+        #: collate does for window dicts (pytorch.py:130-254, collate :72-94)
+        ngram = getattr(reader, "ngram", None)
+        self._ngram_offsets = (ngram.offsets if ngram is not None
+                               and not ngram.stack_timesteps else None)
 
     # -- engine ---------------------------------------------------------------
 
@@ -164,6 +177,14 @@ class DataLoader(LoaderBase):
     def _emit(self, batch: ColumnBatch) -> Dict:
         out = {name: _column_to_torch(name, col)
                for name, col in batch.columns.items()}
+        if self._ngram_offsets is not None:
+            from petastorm_tpu.ngram import NGRAM_KEY_SEP
+
+            nested: Dict[int, Dict] = {off: {} for off in self._ngram_offsets}
+            for key, value in out.items():
+                off, _, field = key.partition(NGRAM_KEY_SEP)
+                nested[int(off)][field] = value
+            out = nested
         if self._collate_fn is not None:
             out = self._collate_fn(out)
         return self._transform_batch(out)

@@ -332,9 +332,11 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
                                transform_spec, ngram) -> list:
     """Check a decode_placement mapping; returns the 'device' field names.
 
-    Device placement = the worker skips the codec and ships raw JPEG bytes;
-    the jax loader runs entropy decode on host and the FLOP-heavy rest
-    (dequant + IDCT + upsample + color) on the TPU (ops/jpeg.py).
+    Device placement = the pool worker runs only libjpeg's entropy decode and
+    ships fixed-shape coefficient-plane columns; the jax loader runs the
+    FLOP-heavy rest (dequant + IDCT + upsample + color) on the TPU
+    (ops/jpeg.py).  Requires uniform jpeg geometry/subsampling across the
+    dataset (XLA compiles the on-chip decode once per geometry).
     """
     if not decode_placement:
         return []
@@ -422,6 +424,8 @@ class Reader:
         self._num_epochs = num_epochs
         self._stopped = False
         self.last_row_consumed = False
+        #: set by make_reader after construction (decode_placement='device')
+        self.device_decode_fields: list = []
 
         self._start_item = start_item
         self._consumed_items = 0
@@ -452,6 +456,17 @@ class Reader:
     def __next__(self):
         if self._stopped:
             raise ReaderClosedError("Reader is stopped")
+        if self.device_decode_fields:
+            # the worker shipped raw jpeg bytes for these fields; only the
+            # jax loader (ops/jpeg.py) finishes the decode on-chip.  Yielding
+            # here would hand out object-dtype bytes where the schema
+            # promises (H, W, C) uint8 pixels.
+            raise PetastormTpuError(
+                f"fields {self.device_decode_fields} use"
+                " decode_placement='device': their batches carry raw jpeg"
+                " bytes, not pixels. Consume this reader through"
+                " petastorm_tpu.jax.JaxDataLoader (which decodes on-chip),"
+                " or use decode_placement='host' for row/tf/pytorch access.")
         if self.batched_output:
             batch = self._next_batch()
             return self._namedtuple_type(**{n: batch.columns[n]
@@ -574,7 +589,11 @@ class Reader:
         position = (self._prefix if self._ordinals_seen
                     else self._start_item + self._consumed_items)
         state = {"position": position,
-                 "items_per_epoch": self._ventilator.items_per_epoch}
+                 "items_per_epoch": self._ventilator.items_per_epoch,
+                 # False means batches arrived without ventilation ordinals
+                 # (a transport dropped them) and the cursor degraded to the
+                 # count-based position - exact only under in-order pools
+                 "ordinal_exact": self._ordinals_seen or self._consumed_items == 0}
         if isinstance(self._plan, ElasticResumePlan):
             # rebased coordinates: record the translation so this cursor can
             # itself be resumed (plainly or elastically) once past the

@@ -161,7 +161,11 @@ class RowGroupDecoderWorker:
 
     def _cache_key(self, item: WorkItem, span: tuple) -> str:
         start, stop = span
-        tag = ",".join(self._read_fields) + "|raw:" + ",".join(sorted(self._raw_fields))
+        # 'rawcoef1' versions the stored form of raw/device fields (coefficient
+        # plane columns); bump it whenever that format changes, or a warm
+        # persistent cache from an older version poisons the pipeline
+        tag = (",".join(self._read_fields)
+               + "|rawcoef1:" + ",".join(sorted(self._raw_fields)))
         fields_tag = hashlib.md5(tag.encode()).hexdigest()[:8]
         return (f"{self._cache_prefix}:{item.row_group.path}:{item.row_group.row_group}"
                 f":{start}:{stop}:{fields_tag}")
@@ -199,9 +203,14 @@ class RowGroupDecoderWorker:
             field = self._schema[name]
             chunk = table.column(name).combine_chunks()
             if name in self._raw_fields:
-                col = np.empty(n, dtype=object)
-                col[:] = chunk.to_pylist()
-                columns[name] = col
+                # decode_placement='device': run the entropy half HERE, in the
+                # pool worker, and ship fixed-shape coefficient planes (which
+                # batch/shuffle/shm-transport like ordinary columns); the
+                # FLOP-heavy IDCT+upsample+color runs on-chip in the jax
+                # loader.  Parallelism comes from the pool, so nthreads=1.
+                from petastorm_tpu.native.image import pack_coef_columns
+
+                columns.update(pack_coef_columns(name, chunk, field))
             else:
                 columns[name] = field.codec.decode_column(field, chunk)
         pvals = dict(item.row_group.partition_values)
@@ -254,6 +263,16 @@ class RowGroupDecoderWorker:
                        **rest.columns}
         else:
             columns = {f: pred_batch.columns[f][mask] for f in pred_fields}
-        # keep only requested output fields, in schema order
-        columns = {f: columns[f] for f in self._read_fields if f in columns}
-        return ColumnBatch(columns, int(mask.sum()))
+        # keep only requested output fields, in schema order (raw/device
+        # fields travel as their derived '<name>#...' coefficient columns)
+        from petastorm_tpu.native.image import COEF_COLUMN_SEP
+
+        kept: Dict[str, np.ndarray] = {}
+        for f in self._read_fields:
+            if f in columns:
+                kept[f] = columns[f]
+            elif f in self._raw_fields:
+                for key, col in columns.items():
+                    if key.startswith(f + COEF_COLUMN_SEP):
+                        kept[key] = col
+        return ColumnBatch(kept, int(mask.sum()))

@@ -124,6 +124,11 @@ def test_rerun_mode_error_fails_everywhere_without_duplicates(tmp_path):
     assert all(isinstance(e, PetastormTpuError) for e in errors.values())
     with make_reader(url, num_epochs=1) as r:
         assert len(list(r)) == 16  # original data intact, no duplicates
+    # the refused rerun must not leave failure-marker debris in the healthy
+    # dataset (host 0 removes its preflight marker after peers observe it)
+    import os
+    assert not any(f.startswith("_distributed_write_failed")
+                   for f in os.listdir(url))
 
     # explicit overwrite replaces cleanly
     def rewrite(idx, sync):

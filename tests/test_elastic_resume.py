@@ -172,3 +172,22 @@ def test_thread_pool_resume_never_loses_items(ds):
         counts = collections.Counter(phase1 + phase2)
         assert sorted(counts) == list(range(ROWS)), "items lost on resume"
         assert max(counts.values()) <= 2  # dups bounded by in-flight window
+
+
+def test_process_pool_resume_never_loses_items(ds):
+    """Process-pool analog of the thread-pool test: the shm transport (default
+    data plane when the native lib builds) must preserve batch ordinals, or
+    state_dict() degrades to a count-based cursor and resume skips items."""
+    with make_batch_reader(ds, reader_pool_type="process", workers_count=2,
+                           shuffle_seed=SEED, num_epochs=1) as r:
+        phase1 = _consume(r, n_items=5)
+        state = r.state_dict()
+    assert state.get("ordinal_exact", True), \
+        "ordinals were dropped across the process-pool transport"
+    with make_batch_reader(ds, reader_pool_type="process", workers_count=2,
+                           shuffle_seed=SEED, num_epochs=1,
+                           resume_from=state) as r:
+        phase2 = _consume(r)
+    counts = collections.Counter(phase1 + phase2)
+    assert sorted(counts) == list(range(ROWS)), "items lost on resume"
+    assert max(counts.values()) <= 2  # dups bounded by in-flight window

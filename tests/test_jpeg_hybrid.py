@@ -231,6 +231,23 @@ def test_device_decode_rejects_png(tmp_path):
         make_batch_reader(url, decode_placement={"image": "chip"})
 
 
+def test_device_decode_rejects_non_jax_consumption(jpeg_ds):
+    """Row iteration and the torch loaders would yield object-dtype jpeg bytes
+    where the schema promises pixels; both must refuse loudly."""
+    from petastorm_tpu.errors import PetastormTpuError
+    from petastorm_tpu.pytorch import DataLoader
+    from petastorm_tpu.reader import make_batch_reader, make_reader
+
+    with make_reader(jpeg_ds, num_epochs=1,
+                     decode_placement={"image": "device"}) as r:
+        with pytest.raises(PetastormTpuError, match="JaxDataLoader"):
+            next(r)
+    with make_batch_reader(jpeg_ds, num_epochs=1,
+                           decode_placement={"image": "device"}) as r:
+        with pytest.raises(PetastormTpuError, match="decode_placement='host'"):
+            DataLoader(r, batch_size=4)
+
+
 def test_grayscale_hw1_field_keeps_rank(tmp_path):
     from petastorm_tpu.codecs import CompressedImageCodec
     from petastorm_tpu.etl.writer import write_dataset
@@ -250,26 +267,41 @@ def test_grayscale_hw1_field_keeps_rank(tmp_path):
     assert b["image"].shape == (8, 32, 48, 1)  # schema rank honored
 
 
-def test_wrong_size_jpeg_raises_clear_error(jpeg_ds):
+def test_wrong_size_jpeg_raises_clear_error(jpeg_ds, tmp_path):
+    """Stored jpegs that contradict the schema shape fail loudly in the
+    worker's entropy half, not with a silent wrong-shape batch."""
+    import shutil
+
+    from petastorm_tpu.codecs import CompressedImageCodec
     from petastorm_tpu.errors import CodecError
+    from petastorm_tpu.etl.writer import stamp_dataset_metadata
     from petastorm_tpu.jax import JaxDataLoader
     from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
 
-    with make_batch_reader(jpeg_ds, num_epochs=1,
+    url = str(tmp_path / "ds")
+    shutil.copytree(jpeg_ds, url)
+    lying = Schema("JpegDs", [
+        Field("idx", np.int64),
+        Field("image", np.uint8, (32, 96, 3), CompressedImageCodec("jpeg"))])
+    stamp_dataset_metadata(url, lying)  # stored jpegs are really 64x96
+    from petastorm_tpu.errors import PetastormTpuError
+
+    with make_batch_reader(url, num_epochs=1,
                            decode_placement={"image": "device"}) as r:
         with JaxDataLoader(r, batch_size=4, fields=["image"]) as loader:
-            bad = np.empty(4, dtype=object)
-            bad[:] = [_encode(_smooth_rgb(32, 96))] * 4  # 32x96, schema 64x96
-            with pytest.raises(CodecError, match="schema says"):
-                loader._decode_on_device("image", bad)
+            # worker failures surface as WorkerError(PetastormTpuError)
+            # carrying the remote CodecError traceback in the message
+            with pytest.raises(PetastormTpuError, match="schema says"):
+                list(loader)
 
 
-def test_mixed_geometry_falls_back_to_host(jpeg_ds, monkeypatch, caplog):
-    import logging
-
+def test_mixed_geometry_rejected_with_guidance(jpeg_ds, monkeypatch):
+    """Non-uniform jpeg geometry cannot take the device path (the on-chip
+    decode compiles per geometry); the worker refuses with migration
+    guidance instead of silently degrading."""
     from petastorm_tpu.errors import CodecError
     from petastorm_tpu.jax import JaxDataLoader
-    from petastorm_tpu.jax import loader as loader_mod
     from petastorm_tpu.reader import make_batch_reader
 
     def boom(cells, **kw):
@@ -277,15 +309,13 @@ def test_mixed_geometry_falls_back_to_host(jpeg_ds, monkeypatch, caplog):
 
     monkeypatch.setattr("petastorm_tpu.native.image.read_jpeg_coefficients_column",
                         boom)
+    from petastorm_tpu.errors import PetastormTpuError
+
     with make_batch_reader(jpeg_ds, shuffle_row_groups=False, num_epochs=1,
                            decode_placement={"image": "device"}) as r:
         with JaxDataLoader(r, batch_size=8, fields=["idx", "image"]) as loader:
-            with caplog.at_level(logging.WARNING, logger=loader_mod.logger.name):
-                batches = list(loader)
-    assert len(batches) == 4  # iteration survives; host fallback decoded
-    assert batches[0]["image"].shape == (8, 64, 96, 3)
-    assert np.asarray(batches[0]["image"]).std() > 10
-    assert any("fell back to host" in rec.message for rec in caplog.records)
+            with pytest.raises(PetastormTpuError, match="decode_placement='host'"):
+                list(loader)
 
 
 def test_decode_placement_validation_errors(jpeg_ds):
@@ -318,7 +348,8 @@ def test_progressive_jpeg_hybrid_decode():
 
 
 def test_device_decode_with_process_pool(jpeg_ds):
-    """Raw jpeg-bytes columns survive the process pool's shm transport."""
+    """Coefficient-plane columns ride the process pool's shm transport
+    zero-copy (fixed-shape int16/uint16/int32 arrays)."""
     from petastorm_tpu.jax import JaxDataLoader
     from petastorm_tpu.reader import make_batch_reader
 

@@ -90,6 +90,7 @@ def _batch(n=10):
 
 def test_encode_decode_roundtrip(arena):
     src = _batch()
+    src.ordinal = 17
     ref = encode_batch(arena, src)
     assert isinstance(ref, ShmBatchRef)
     assert ref.columns["s"][0] == "inline"  # object dtype falls back
@@ -97,6 +98,9 @@ def test_encode_decode_roundtrip(arena):
     np.testing.assert_array_equal(out.columns["x"], src.columns["x"])
     np.testing.assert_array_equal(out.columns["i"], src.columns["i"])
     assert list(out.columns["s"]) == list(src.columns["s"])
+    # the ventilation ordinal must survive the shm hop or the Reader's
+    # exact-prefix resume cursor silently degrades under process pools
+    assert out.ordinal == 17
 
 
 def test_decode_is_zero_copy_and_frees_on_gc(arena):

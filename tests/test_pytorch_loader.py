@@ -129,6 +129,74 @@ def test_variable_shape_becomes_list(tmp_path):
     assert batch["pts"][2].shape == (3, 2)
 
 
+@pytest.fixture(scope="module")
+def ngram_dataset(tmp_path_factory):
+    url = str(tmp_path_factory.mktemp("torch_ngram") / "ds")
+    schema = Schema("Seq", [
+        Field("ts", np.int64),
+        Field("cam", np.float32, (4, 4), NdarrayCodec()),
+        Field("label", np.int64),
+    ])
+    rows = [{"ts": i, "cam": np.full((4, 4), i, np.float32), "label": i % 3}
+            for i in range(32)]
+    write_dataset(url, schema, rows, row_group_size_rows=16)
+    return url
+
+
+def test_ngram_loader_yields_per_offset_tensor_dicts(ngram_dataset):
+    """Reference parity: DataLoader collates ngram window dicts into
+    {offset: {field: tensor}} batches (pytorch.py:130-254, collate :72-94)."""
+    from petastorm_tpu.ngram import NGram
+
+    ng = NGram({0: ["ts", "cam"], 1: ["ts", "cam", "label"]},
+               delta_threshold=1, timestamp_field="ts")
+    with make_reader(ngram_dataset, ngram=ng, shuffle_row_groups=False,
+                     reader_pool_type="serial", num_epochs=1) as r:
+        with DataLoader(r, batch_size=5) as loader:
+            batches = list(loader)
+    first = batches[0]
+    assert set(first) == {0, 1}
+    assert set(first[0]) == {"ts", "cam"}
+    assert set(first[1]) == {"ts", "cam", "label"}
+    assert first[0]["cam"].shape == (5, 4, 4)
+    # offset-1 rows are exactly offset-0's successors, per window
+    assert torch.equal(first[1]["ts"], first[0]["ts"] + 1)
+    assert torch.equal(first[1]["cam"][0],
+                       torch.full((4, 4), float(first[1]["ts"][0])))
+    # each rowgroup of 16 consecutive ts yields 15 windows -> 30 total
+    total = sum(len(b[0]["ts"]) for b in batches)
+    assert total == 30
+
+
+def test_ngram_loader_shuffling_keeps_windows_intact(ngram_dataset):
+    from petastorm_tpu.ngram import NGram
+
+    ng = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    with make_reader(ngram_dataset, ngram=ng, shuffle_row_groups=False,
+                     reader_pool_type="serial", num_epochs=1) as r:
+        with DataLoader(r, batch_size=6, shuffling_queue_capacity=16,
+                        seed=3) as loader:
+            batches = list(loader)
+    starts = torch.cat([b[0]["ts"] for b in batches])
+    nexts = torch.cat([b[1]["ts"] for b in batches])
+    assert torch.equal(nexts, starts + 1)          # windows never split
+    assert sorted(starts.tolist()) == [*range(15), *range(16, 31)]
+    assert starts.tolist() != sorted(starts.tolist())  # actually shuffled
+
+
+def test_ngram_stacked_loader_keeps_flat_dict(ngram_dataset):
+    from petastorm_tpu.ngram import NGram
+
+    ng = NGram({0: ["ts", "cam"], 1: ["ts", "cam"]}, delta_threshold=1,
+               timestamp_field="ts", stack_timesteps=True)
+    with make_reader(ngram_dataset, ngram=ng, shuffle_row_groups=False,
+                     reader_pool_type="serial", num_epochs=1) as r:
+        with DataLoader(r, batch_size=5) as loader:
+            batch = next(iter(loader))
+    assert batch["cam"].shape == (5, 2, 4, 4)      # (batch, k, ...) stacked
+    assert torch.equal(batch["ts"][:, 1], batch["ts"][:, 0] + 1)
+
+
 def test_decimal_friendly_collate():
     rows = [{"d": decimal.Decimal("1.5"), "x": torch.tensor(1)},
             {"d": decimal.Decimal("2.5"), "x": torch.tensor(2)}]
