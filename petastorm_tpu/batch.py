@@ -58,6 +58,20 @@ class ColumnBatch:
         batches = [b for b in batches if b.num_rows]
         if not batches:
             return ColumnBatch({}, 0)
+        if len(batches) == 1:
+            # pass through without copying: the hot ingest path (rowgroup size
+            # aligned to batch size) would otherwise memcpy every batch.
+            # Read-only columns (zero-copy arrow views over mmap'd files) must
+            # still be copied - concat always produced writable arrays, and
+            # consumers mutate batches in place (e.g. torch normalize).
+            b = batches[0]
+            frozen = {n for n, c in b.columns.items()
+                      if isinstance(c, np.ndarray) and not c.flags.writeable}
+            if not frozen:
+                return b
+            return ColumnBatch(
+                {n: (c.copy() if n in frozen else c)
+                 for n, c in b.columns.items()}, b.num_rows, ordinal=b.ordinal)
         names = batches[0].field_names
         out = {}
         for name in names:

@@ -34,6 +34,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="emulate an N-ms training step between batches;"
                              " the report's input_stall_percent then reads as"
                              " device-idle%% (--method jax only)")
+    parser.add_argument("--decode-device", nargs="+", default=(),
+                        metavar="FIELD",
+                        help="decode these jpeg fields on-chip"
+                             " (decode_placement='device'; --method jax only)")
+    parser.add_argument("--prefetch", type=int, default=2,
+                        help="loader queue depth per producer stage"
+                             " (--method jax only)")
     parser.add_argument("--no-shuffle", action="store_true",
                         help="disable rowgroup shuffling")
     parser.add_argument("--json", action="store_true",
@@ -60,7 +67,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             pool_type=args.pool_type, workers_count=args.workers_count,
             field_regex=args.field_regex,
             shuffle_row_groups=not args.no_shuffle,
-            simulated_step_s=args.simulated_step_ms / 1000.0)
+            simulated_step_s=args.simulated_step_ms / 1000.0,
+            device_decode_fields=args.decode_device,
+            prefetch=args.prefetch)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(

@@ -128,7 +128,9 @@ def jax_loader_throughput(dataset_url: str,
                           field_regex: Optional[Sequence[str]] = None,
                           shuffle_row_groups: bool = True,
                           storage_options: Optional[dict] = None,
-                          simulated_step_s: float = 0.0) -> BenchmarkResult:
+                          simulated_step_s: float = 0.0,
+                          device_decode_fields: Sequence[str] = (),
+                          prefetch: int = 2) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
@@ -151,9 +153,11 @@ def jax_loader_throughput(dataset_url: str,
         dataset_url, schema_fields=list(field_regex) if field_regex else None,
         reader_pool_type=pool_type, workers_count=workers_count,
         shuffle_row_groups=shuffle_row_groups,
-        num_epochs=None, storage_options=storage_options)
+        num_epochs=None, storage_options=storage_options,
+        decode_placement=({f: "device" for f in device_decode_fields}
+                          if device_decode_fields else None))
     try:
-        loader = JaxDataLoader(reader, batch_size=batch_size)
+        loader = JaxDataLoader(reader, batch_size=batch_size, prefetch=prefetch)
     except Exception:
         # the reader's executor threads would poll forever otherwise
         reader.stop()

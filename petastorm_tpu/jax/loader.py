@@ -13,10 +13,12 @@ buffer -> collate -> torch tensors, pytorch.py:130-367) and tf_utils
                                     with an explicit NamedSharding: each host
                                     feeds exactly its slice of the global batch;
                                     XLA moves shards over ICI/DCN
-* tf py_func/queue runners       -> a plain python producer thread + bounded
-                                    device-transfer queue (depth ``prefetch``,
-                                    default 2 = double buffering; jax transfers
-                                    are async so host prep overlaps device step)
+* tf py_func/queue runners       -> a two-stage producer (assembly thread ->
+                                    bounded host queue -> transfer thread ->
+                                    bounded device queue, each depth
+                                    ``prefetch``): the blocking host->device
+                                    copy overlaps the next batch's numpy
+                                    assembly, and both overlap the device step
 
 TPU-specific behavior:
 
@@ -175,9 +177,18 @@ class JaxDataLoader:
             self._make_buffer = NoopShufflingBuffer
 
         self._out: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+        # two-stage producer: the assembly thread does the numpy work (batch
+        # formation, shuffle, pad) and the transfer thread does the device
+        # dispatch (make_array/device_put BLOCKS for the host->device copy,
+        # several ms of IO per batch) - so transfers overlap the next batch's
+        # host prep instead of serializing with it
+        self._host_q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
         self._stop_event = threading.Event()
-        self._thread = threading.Thread(target=self._produce, daemon=True,
-                                        name="petastorm-tpu-jax-loader")
+        self._thread = threading.Thread(target=self._assemble, daemon=True,
+                                        name="petastorm-tpu-jax-assembly")
+        self._transfer_thread = threading.Thread(
+            target=self._transfer, daemon=True,
+            name="petastorm-tpu-jax-transfer")
         self._started = False
         self._finished = False
         self._failure: Optional[BaseException] = None
@@ -271,7 +282,8 @@ class JaxDataLoader:
             return self._pad_values.get(name, 0)
         return self._pad_values
 
-    def _produce(self) -> None:
+    def _assemble(self) -> None:
+        """Stage 1: reader batches -> host-assembled local batches."""
         try:
             local_bs = self._local_rows
 
@@ -286,7 +298,28 @@ class JaxDataLoader:
                     break
                 if out.num_rows < local_bs and self._drop_last:
                     continue  # partial tail batch dropped
-                self._emit(out)
+                self._host_push(out)
+            self._host_push(_Done())
+        except BaseException as exc:  # noqa: BLE001 - forwarded downstream
+            self._host_push(_Error(exc))
+
+    def _transfer(self) -> None:
+        """Stage 2: host batches -> device dispatch -> consumer queue."""
+        try:
+            while not self._stop_event.is_set():
+                try:
+                    item = self._host_q.get(timeout=_QUEUE_POLL_S)
+                except queue.Empty:
+                    continue
+                if isinstance(item, _Error):
+                    self._push(item)
+                    self._sentinel_pending = True
+                    return
+                if isinstance(item, _Done):
+                    break
+                self._emit(item)
+            else:
+                return  # stopped
             if self._device_buffer is not None:
                 for resident in self._device_buffer.drain():
                     if self._stop_event.is_set():
@@ -302,6 +335,14 @@ class JaxDataLoader:
         except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
             self._push(_Error(exc))
             self._sentinel_pending = True
+
+    def _host_push(self, value) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self._host_q.put(value, timeout=_QUEUE_POLL_S)
+                return
+            except queue.Full:
+                continue
 
     def _emit(self, host_batch: ColumnBatch) -> None:
         cols = {n: host_batch.columns[n] for n in self._fields
@@ -323,6 +364,7 @@ class JaxDataLoader:
             cols = {name: np.concatenate(
                 [col, np.zeros((pad,) + col.shape[1:], dtype=col.dtype)])
                 for name, col in cols.items()}
+        staged: Dict[str, np.ndarray] = {}
         for name, col in cols.items():
             arr = np.ascontiguousarray(col)
             feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
@@ -334,7 +376,17 @@ class JaxDataLoader:
                 device_batch[name] = jax.make_array_from_process_local_data(
                     sharding, arr, global_shape)
             else:
-                device_batch[name] = jax.device_put(arr)
+                staged[name] = arr
+        if staged:
+            # ONE device_put for all fields: each call pays a fixed dispatch
+            # cost (an RPC on tunneled TPU runtimes), so a small label column
+            # must not cost as much as the image column it rides with
+            device_batch.update(jax.device_put(staged))
+        # commit the transfers HERE, in the transfer thread: the consumer then
+        # never blocks on a half-copied array, and its readiness query never
+        # queues behind the next batch's dispatch (serialized device RPC
+        # channels would otherwise surface that contention as input stall)
+        jax.block_until_ready(device_batch)
         for name in self._host_fields:
             device_batch[name] = host_batch.columns[name]
         if self._mesh is not None and valid_rows < self._local_rows:
@@ -363,20 +415,21 @@ class JaxDataLoader:
         color runs on-chip, sharded, with no cross-shard communication
         (petastorm_tpu/ops/jpeg.py).
         """
-        from petastorm_tpu.errors import CodecError
         from petastorm_tpu.native.image import unpack_coef_columns
-        from petastorm_tpu.ops.jpeg import decode_coefficients, decode_from_layout
+        from petastorm_tpu.ops.jpeg import decode_coefficients
 
         field = self._schema[name]
+        # (shape vs schema was already checked worker-side in pack_coef_columns)
         planes, qtabs, layout = unpack_coef_columns(name, columns)
-        if (layout.height, layout.width) != tuple(field.shape[:2]):
-            raise CodecError(
-                f"field {name!r}: stored jpeg is {layout.height}x{layout.width},"
-                f" schema says {tuple(field.shape[:2])}")
         sampling = tuple((h, v) for (h, v, _, _) in layout.components)
         n = len(qtabs)
         if self._mesh is None:
-            out = decode_from_layout(planes, qtabs, layout)
+            # one batched transfer for all planes + qtabs (fixed dispatch
+            # cost per device_put call), then the on-chip half
+            jp, jq = jax.device_put((tuple(planes), qtabs))
+            out = decode_coefficients(jp, jq,
+                                      image_size=(layout.height, layout.width),
+                                      sampling=sampling)
         else:
             if n < self._local_rows:
                 # zero coefficient blocks decode to flat gray padding rows
@@ -436,6 +489,7 @@ class JaxDataLoader:
             depth = max(depth - 1, 0)
         out = {"prefetch_depth": depth,
                "prefetch_capacity": self._out.maxsize,
+               "host_queue_depth": self._host_q.qsize(),
                "delivered_batches": self._delivered_batches,
                "consumer_wait_s": self._consumer_wait_s,
                "finished": self._finished}
@@ -448,6 +502,7 @@ class JaxDataLoader:
         if not self._started:
             self._started = True
             self._thread.start()
+            self._transfer_thread.start()
             if self._trace_dir:
                 try:
                     jax.profiler.start_trace(self._trace_dir)
@@ -476,7 +531,7 @@ class JaxDataLoader:
                 if self._stop_event.is_set():
                     self._finished = True
                     raise StopIteration
-                if not self._thread.is_alive():
+                if not self._transfer_thread.is_alive():
                     # the producer may have pushed its sentinel between our
                     # timeout and this liveness check - drain before concluding
                     try:
@@ -509,9 +564,10 @@ class JaxDataLoader:
         ``make_reader(..., resume_from=...)`` / ``resume_reader_kwargs``);
         ``delivered_batches`` counts device batches handed to the consumer.
         Mid-epoch the reader cursor can run ahead of deliveries by the
-        in-flight window - which includes ALL ``device_shuffle_capacity``
-        resident batches - so keep buffers small (or zero) when tight resume
-        matters (see petastorm_tpu.jax.checkpoint module docs).
+        in-flight window - both producer-stage queues (2x ``prefetch``) plus
+        ALL ``device_shuffle_capacity`` resident batches - so keep buffers
+        small (or zero) when tight resume matters (see
+        petastorm_tpu.jax.checkpoint module docs).
         """
         if not hasattr(self._reader, "state_dict"):
             raise PetastormTpuError(
@@ -539,6 +595,7 @@ class JaxDataLoader:
     def join(self) -> None:
         if self._started:
             self._thread.join(timeout=10)
+            self._transfer_thread.join(timeout=10)
         self._reader.join()
 
     def __enter__(self):

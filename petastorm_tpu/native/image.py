@@ -172,6 +172,11 @@ def jpeg_coef_layout(buf: bytes) -> Optional["JpegCoefLayout"]:
         from petastorm_tpu.errors import CodecError
 
         raise CodecError(f"not a decodable JPEG (rc={rc})")
+    return _layout_from_meta(meta)
+
+
+def _layout_from_meta(meta) -> "JpegCoefLayout":
+    """Inverse of ``_layout_meta``: int32 meta vector -> JpegCoefLayout."""
     ncomp = int(meta[0])
     comps = tuple(tuple(int(v) for v in meta[3 + 4 * c: 7 + 4 * c])
                   for c in range(ncomp))
@@ -275,11 +280,8 @@ def unpack_coef_columns(name: str, columns: dict):
             f"field {name!r}: jpeg geometry changes between rowgroups of"
             " this dataset; the device decode path needs one uniform"
             " geometry - use decode_placement='host'.")
-    meta = meta_col[0]
-    ncomp = int(meta[0])
-    comps = tuple(tuple(int(v) for v in meta[3 + 4 * c: 7 + 4 * c])
-                  for c in range(ncomp))
-    layout = JpegCoefLayout(int(meta[1]), int(meta[2]), comps)
+    layout = _layout_from_meta(meta_col[0])
+    ncomp = len(layout.components)
     planes = [columns[f"{name}{COEF_COLUMN_SEP}p{c}"] for c in range(ncomp)]
     qtabs = columns[f"{name}{COEF_COLUMN_SEP}q"]
     return planes, qtabs, layout

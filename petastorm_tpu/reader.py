@@ -286,7 +286,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    else dataset_url[0], storage_options,
                                    filesystem=filesystem)
     device_fields = _validate_decode_placement(decode_placement, full_schema,
-                                               read_fields, transform_spec, ngram)
+                                               read_fields, transform_spec,
+                                               ngram, worker_predicate)
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
                                    predicate=worker_predicate,
                                    transform=transform_spec, cache=cache,
@@ -329,7 +330,7 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
 
 
 def _validate_decode_placement(decode_placement, schema, read_fields,
-                               transform_spec, ngram) -> list:
+                               transform_spec, ngram, predicate=None) -> list:
     """Check a decode_placement mapping; returns the 'device' field names.
 
     Device placement = the pool worker runs only libjpeg's entropy decode and
@@ -389,6 +390,11 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
                 " transform_spec: the transform would see raw jpeg bytes, not"
                 " pixels. Decode on host, or transform on device after the"
                 " loader.")
+        if predicate is not None and name in predicate.get_fields():
+            raise PetastormTpuError(
+                f"predicate field {name!r} uses decode_placement='device':"
+                " the predicate would see coefficient planes, not pixels."
+                " Decode it on host, or predicate on other fields.")
         if name not in read_fields:
             raise PetastormTpuError(
                 f"decode_placement='device' field {name!r} is not being read"

@@ -327,6 +327,10 @@ def test_decode_placement_validation_errors(jpeg_ds):
     with pytest.raises(PetastormTpuError, match="not being read"):
         make_batch_reader(jpeg_ds, schema_fields=["idx"],
                           decode_placement={"image": "device"})
+    from petastorm_tpu.predicates import in_lambda
+    with pytest.raises(PetastormTpuError, match="coefficient planes"):
+        make_batch_reader(jpeg_ds, decode_placement={"image": "device"},
+                          predicate=in_lambda(["image"], lambda image: True))
 
 
 def test_progressive_jpeg_hybrid_decode():
