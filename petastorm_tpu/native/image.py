@@ -249,11 +249,8 @@ def pack_coef_columns(name: str, column, field=None, nthreads: int = 1) -> dict:
             column, nthreads=nthreads)
     except CodecError as exc:
         raise CodecError(
-            f"decode_placement='device' field {name!r}: {exc}. The device"
-            " decode path requires every stored jpeg to share one geometry"
-            " and subsampling (XLA compiles the on-chip decode per"
-            " geometry); re-encode the column uniformly or use"
-            " decode_placement='host'.") from exc
+            f"decode_placement='device' field {name!r}:"
+            f" {_diagnose_coef_failure(column, exc)}") from exc
     if field is not None and (layout.height, layout.width) != tuple(field.shape[:2]):
         raise CodecError(
             f"field {name!r}: stored jpeg is {layout.height}x{layout.width},"
@@ -264,6 +261,39 @@ def pack_coef_columns(name: str, column, field=None, nthreads: int = 1) -> dict:
     out[f"{name}{COEF_COLUMN_SEP}m"] = np.broadcast_to(
         _layout_meta(layout), (n, _JPEG_META_LEN))
     return out
+
+
+_MIXED_GEOMETRY_GUIDANCE = (
+    "the device decode path requires every stored jpeg to share one geometry"
+    " and subsampling (XLA compiles the on-chip decode per geometry);"
+    " re-encode the column uniformly or use decode_placement='host'")
+
+
+def _diagnose_coef_failure(column, exc) -> str:
+    """Turn a batch coefficient-read failure into actionable guidance:
+    distinguish a corrupt cell (host decode would fail too) from mixed
+    geometry (host decode would work - point at decode_placement='host')."""
+    from petastorm_tpu.errors import CodecError
+
+    cells = column if isinstance(column, (list, tuple)) else column.to_pylist()
+    first = None
+    try:
+        for i, cell in enumerate(cells):
+            try:
+                lay = jpeg_coef_layout(bytes(cell))
+            except CodecError:
+                return (f"cell {i} is not a decodable jpeg (corrupt or"
+                        f" truncated stream): {exc}")
+            if first is None:
+                first = lay
+            elif lay != first:
+                return (f"cell {i} has geometry {lay} but cell 0 has {first}:"
+                        f" {_MIXED_GEOMETRY_GUIDANCE}")
+    except Exception:  # noqa: BLE001 - diagnosis is best-effort
+        pass
+    # headers parse and agree: entropy-level corruption, or the simulated
+    # failure injected by tests
+    return f"{exc}. If the dataset mixes jpeg geometries: {_MIXED_GEOMETRY_GUIDANCE}."
 
 
 def unpack_coef_columns(name: str, columns: dict):

@@ -117,12 +117,23 @@ def distributed_write_dataset(url: str,
     peer_error: Optional[BaseException] = None
     try:
         sync("petastorm_tpu:distributed_write:preflight")
-        if process_index != 0 and fs.get_file_info(
-                posixpath.join(root, f"{_FAIL_MARKER}.preflight")
-                ).type == pafs.FileType.File:
-            peer_error = PetastormTpuError(
-                f"distributed write to {url!r} aborted: preflight failed on"
-                " host 0 (see its log)")
+        if process_index != 0:
+            # the marker check must NOT raise past the next barrier: a
+            # transient FS error on one host would strand every other host
+            # in 'preflight-observed' (which has no timeout)
+            try:
+                marker = fs.get_file_info(
+                    posixpath.join(root, f"{_FAIL_MARKER}.preflight")
+                    ).type == pafs.FileType.File
+            except Exception as exc:  # noqa: BLE001 - surfaced after barrier
+                peer_error = PetastormTpuError(
+                    f"distributed write to {url!r}: could not check the"
+                    f" preflight marker: {exc}")
+            else:
+                if marker:
+                    peer_error = PetastormTpuError(
+                        f"distributed write to {url!r} aborted: preflight"
+                        " failed on host 0 (see its log)")
         # second barrier: every host has now observed (or not) the preflight
         # marker, so host 0 can remove it before raising - a mode='error'
         # rerun against a healthy dataset must not leave failure debris behind

@@ -318,6 +318,93 @@ def test_mixed_geometry_rejected_with_guidance(jpeg_ds, monkeypatch):
                 list(loader)
 
 
+def _write_raw_jpeg_ds(tmp_path, bufs, rows_per_group):
+    """Dataset with hand-encoded jpeg bytes (writer would re-encode), so
+    tests can control per-cell subsampling."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.etl.writer import stamp_dataset_metadata
+    from petastorm_tpu.schema import Field, Schema
+
+    schema = Schema("Mixed", [
+        Field("idx", np.int64),
+        Field("image", np.uint8, (64, 96, 3), CompressedImageCodec("jpeg"))])
+    url = str(tmp_path / "mixed_ds")
+    os.makedirs(url)
+    table = pa.Table.from_pylist(
+        [{"idx": i, "image": b} for i, b in enumerate(bufs)],
+        schema=schema.as_arrow_schema())
+    pq.write_table(table, os.path.join(url, "part-00000.parquet"),
+                   row_group_size=rows_per_group)
+    stamp_dataset_metadata(url, schema)
+    return url
+
+
+def test_mixed_geometry_within_rowgroup_diagnosed(tmp_path):
+    """A rowgroup mixing 4:2:0 and 4:4:4 jpegs fails in the worker with the
+    offending cell named and host-decode guidance - not an opaque rc."""
+    s444 = getattr(cv2, "IMWRITE_JPEG_SAMPLING_FACTOR_444", None)
+    if s444 is None:
+        pytest.skip("cv2 build lacks sampling-factor control")
+    from petastorm_tpu.errors import PetastormTpuError
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    bufs = [_encode(_smooth_rgb(64, 96, seed=i)) for i in range(6)]
+    bufs[3] = _encode(_smooth_rgb(64, 96, seed=3), sampling=s444)
+    url = _write_raw_jpeg_ds(tmp_path, bufs, rows_per_group=6)
+    with make_batch_reader(url, num_epochs=1,
+                           decode_placement={"image": "device"}) as r:
+        with JaxDataLoader(r, batch_size=6, fields=["image"]) as loader:
+            with pytest.raises(PetastormTpuError,
+                               match=r"cell 3 has geometry.*"
+                                     r"decode_placement='host'"):
+                list(loader)
+
+
+def test_mixed_geometry_across_rowgroups_guided(tmp_path):
+    """Uniform rowgroups with different subsampling: batch assembly spanning
+    the boundary must raise the guided error, not a numpy shape mismatch."""
+    s444 = getattr(cv2, "IMWRITE_JPEG_SAMPLING_FACTOR_444", None)
+    if s444 is None:
+        pytest.skip("cv2 build lacks sampling-factor control")
+    from petastorm_tpu.errors import CodecError
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    bufs = ([_encode(_smooth_rgb(64, 96, seed=i)) for i in range(4)]
+            + [_encode(_smooth_rgb(64, 96, seed=i), sampling=s444)
+               for i in range(4, 8)])
+    url = _write_raw_jpeg_ds(tmp_path, bufs, rows_per_group=4)
+    with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
+                           decode_placement={"image": "device"}) as r:
+        with JaxDataLoader(r, batch_size=8, fields=["image"]) as loader:
+            with pytest.raises(CodecError, match="mixes jpeg"):
+                list(loader)
+
+
+def test_corrupt_jpeg_cell_diagnosed(tmp_path):
+    """A truncated jpeg cell is reported as corruption (host decode would
+    fail too), NOT as a geometry-uniformity problem."""
+    from petastorm_tpu.errors import PetastormTpuError
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    bufs = [_encode(_smooth_rgb(64, 96, seed=i)) for i in range(4)]
+    bufs[2] = bufs[2][:40]  # truncate mid-header
+    url = _write_raw_jpeg_ds(tmp_path, bufs, rows_per_group=4)
+    with make_batch_reader(url, num_epochs=1,
+                           decode_placement={"image": "device"}) as r:
+        with JaxDataLoader(r, batch_size=4, fields=["image"]) as loader:
+            with pytest.raises(PetastormTpuError,
+                               match="corrupt or truncated"):
+                list(loader)
+
+
 def test_decode_placement_validation_errors(jpeg_ds):
     from petastorm_tpu.errors import PetastormTpuError
     from petastorm_tpu.reader import make_batch_reader
