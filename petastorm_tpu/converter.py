@@ -10,12 +10,14 @@ Rank-consistency of ``cur_shard/shard_count`` is checked against launcher env
 vars, warning only (124-163); S3 eventual consistency is handled by waiting for
 files (565-595); a median-file-size advisory flags tiny files (598-617).
 
-TPU-first differences: no JVM anywhere - input is a pandas DataFrame or pyarrow
-Table (a Spark DataFrame is accepted only as a convenience if pyspark happens to
-be importable, via ``toPandas``); dedup is by content fingerprint (sha256 over
-schema + column buffers + write params) instead of a Spark query plan; and the
-first-class consumer is ``make_jax_loader`` (mesh-sharded device batches) with
-the torch loader kept for parity.
+TPU-first differences: the JVM-free path is first-class - input is a pandas
+DataFrame or pyarrow Table, deduped by content fingerprint (sha256 over schema
++ column buffers + write params) - and the first-class consumer is
+``make_jax_loader`` (mesh-sharded device batches) with the torch loader kept
+for parity.  A Spark DataFrame (when pyspark is importable) materializes ON
+THE EXECUTORS via ``df.write.parquet`` with query-plan dedup and MLlib
+vector->array conversion, exactly like the reference (:546-562,:496-529,
+:448-484) - the driver never collects the data.
 """
 
 from __future__ import annotations
@@ -69,12 +71,18 @@ def _cleanup_at_exit() -> None:
 atexit.register(_cleanup_at_exit)
 
 
+def _is_spark_dataframe(data) -> bool:
+    """Duck-typed: a pyspark.sql.DataFrame has a JVM-backed writer and schema.
+    (No isinstance - pyspark may be absent, and tests exercise the path with
+    stand-ins, the same approach as tests/test_interop.py.)"""
+    return (hasattr(data, "write") and hasattr(data, "schema")
+            and hasattr(data, "toPandas"))
+
+
 def _to_arrow_table(data, dtype: Optional[str]) -> pa.Table:
     """Normalize supported inputs to a pyarrow Table, applying float precision."""
     if isinstance(data, pa.Table):
         table = data
-    elif hasattr(data, "toPandas"):  # pyspark.sql.DataFrame, if present
-        table = pa.Table.from_pandas(data.toPandas(), preserve_index=False)
     elif hasattr(data, "columns") and hasattr(data, "dtypes"):  # pandas
         table = pa.Table.from_pandas(data, preserve_index=False)
     else:
@@ -103,6 +111,196 @@ def _to_arrow_table(data, dtype: Optional[str]) -> pa.Table:
     if not changed:
         return table
     return table.cast(pa.schema(fields))
+
+
+def _spark_prepare_df(df, dtype: Optional[str]):
+    """Spark-side column normalization, on executors at write time.
+
+    Reference behavior (spark_dataset_converter.py:496-529): MLlib
+    ``VectorUDT`` columns convert to arrays (with a warning - the conversion
+    loses sparsity), and float precision is normalized per ``dtype``.
+    Everything happens through Spark column expressions, so nothing is
+    collected to the driver.
+    """
+    if dtype not in (None, "float32", "float64"):
+        raise PetastormTpuError(f"dtype must be 'float32', 'float64' or None,"
+                                f" got {dtype!r}")
+    target_scalar = {"float32": "float", "float64": "double"}.get(dtype)
+    source_scalar = {"float32": "DoubleType", "float64": "FloatType"}.get(dtype)
+    for field in df.schema.fields:
+        type_name = type(field.dataType).__name__
+        if type_name == "VectorUDT":
+            from pyspark.ml.functions import vector_to_array
+            from pyspark.sql.functions import col
+
+            warnings.warn(
+                f"Column {field.name!r} is an MLlib vector; converting to an"
+                f" array of {dtype or 'float64'} (sparse vectors densify)")
+            df = df.withColumn(field.name, vector_to_array(
+                col(field.name), dtype=dtype or "float64"))
+        elif dtype is not None and type_name == source_scalar:
+            from pyspark.sql.functions import col
+
+            df = df.withColumn(field.name,
+                               col(field.name).cast(target_scalar))
+        elif dtype is not None and type_name == "ArrayType" and \
+                type(field.dataType.elementType).__name__ == source_scalar:
+            from pyspark.sql.functions import col
+
+            df = df.withColumn(field.name, col(field.name).cast(
+                f"array<{target_scalar}>"))
+    return df
+
+
+def _spark_fingerprint(df, params: Dict) -> str:
+    """Dedup key for a Spark DataFrame: its analyzed logical plan + params
+    (the reference's cache key, spark_dataset_converter.py:448-484).  Content
+    hashing would require collecting the data - the thing this path avoids."""
+    try:
+        plan = df._jdf.queryExecution().analyzed().toString()  # noqa: SLF001
+    except Exception:  # noqa: BLE001 - non-JVM stand-ins in tests
+        plan = None
+    if not plan:
+        try:
+            plan = f"{df.schema.json()}|semantic:{df.semanticHash()}"
+        except Exception:  # noqa: BLE001
+            # no stable identity available: a fresh dir per conversion
+            # (correct, just no dedup)
+            plan = f"{df.schema.json()}|uuid:{uuid.uuid4().hex}"
+    digest = hashlib.sha256()
+    digest.update(plan.encode())
+    digest.update(repr(sorted(params.items())).encode())
+    return digest.hexdigest()[:20]
+
+
+def _materialize_spark_df(df, ds_url: str, cache_dir_url: str,
+                          fs: pafs.FileSystem, root: str,
+                          compression_codec: str,
+                          row_group_size_mb: float) -> None:
+    """Executor-side materialization: ``df.write.parquet`` into a temp dir,
+    then an atomic rename publishes it (the arrow path's scheme) - a crashed
+    job leaves only an unadopted ``.tmp-*`` dir, never a partial dataset at
+    the cache URL, and concurrent converters of the same plan race benignly.
+    The driver never holds the data, so DataFrames larger than driver RAM
+    convert fine (reference spark_dataset_converter.py:546-562, incl. the
+    ``parquet.block.size`` option at :553-555)."""
+    tag = posixpath.basename(root)
+    tmp_url = posixpath.join(cache_dir_url, f".tmp-{tag}-{uuid.uuid4().hex[:8]}")
+    _, tmp_root = get_filesystem_and_path(tmp_url)
+    (df.write.mode("overwrite")
+       .option("compression", compression_codec)
+       .option("parquet.block.size", int(row_group_size_mb * 2**20))
+       .parquet(tmp_url))
+    wrote = [i.path for i in fs.get_file_info(pafs.FileSelector(tmp_root))
+             if i.type == pafs.FileType.File and i.path.endswith(".parquet")]
+    if not wrote:
+        fs.delete_dir(tmp_root)
+        raise PetastormTpuError(
+            f"Spark wrote no parquet files for {ds_url!r} (empty DataFrame?)")
+    try:
+        fs.move(tmp_root, root)
+    except OSError:
+        # lost the race: another process published the same plan first
+        fs.delete_dir(tmp_root)
+
+
+def _share_live_handle(ds_url: str, delete_at_exit: bool):
+    """Same content converted earlier in this process: share the handle, so
+    one delete() cannot destroy the dataset under another reference.
+    Persistence wins on disagreement: if any caller asked to keep the cache
+    (delete_at_exit=False), un-register the exit cleanup."""
+    live = _converters_by_url.get(ds_url)
+    if live is None or live._deleted:  # noqa: SLF001
+        return None
+    if not delete_at_exit and live._owns_cache:  # noqa: SLF001
+        live._owns_cache = False
+        if live in _registered_converters:
+            _registered_converters.remove(live)
+    elif delete_at_exit and not live._owns_cache:  # noqa: SLF001
+        warnings.warn(
+            f"Cache {ds_url} was already created with delete_at_exit=False;"
+            " it will be kept despite this call's delete_at_exit=True.")
+    return live
+
+
+def _register_converter(conv: "DatasetConverter", delete_at_exit: bool) -> None:
+    _converters_by_url[conv.cache_url] = conv
+    if delete_at_exit:
+        _registered_converters.append(conv)
+
+
+def _make_spark_converter(df, cache_dir_url: str, *, dtype, compression_codec,
+                          row_group_size_mb, delete_at_exit,
+                          storage_options) -> "DatasetConverter":
+    """Spark-DataFrame input: materialize ON THE EXECUTORS via
+    ``df.write.parquet`` (reference spark_dataset_converter.py:546-562) - the
+    driver never collects the data, so frames larger than driver RAM convert.
+    MLlib vector columns convert to arrays first (:496-529); dedup is by
+    analyzed query plan + params (:448-484)."""
+    df = _spark_prepare_df(df, dtype)
+    compression_codec = compression_codec or "snappy"
+    params = {"codec": compression_codec, "rg_mb": row_group_size_mb,
+              "v": 2, "engine": "spark"}
+    tag = _spark_fingerprint(df, params)
+    ds_url = posixpath.join(cache_dir_url, f"converted-{tag}")
+    fs, root = get_filesystem_and_path(ds_url, storage_options)
+
+    live = _share_live_handle(ds_url, delete_at_exit)
+    if live is not None:
+        return live
+
+    def _published_files():
+        """Parquet files of a COMPLETE materialization only: published dirs
+        arrive whole via the atomic rename and carry _SUCCESS (Spark's
+        committer) or _common_metadata (our stamp); a bare dir of part files
+        is a crashed/foreign write and must not be silently adopted."""
+        info = fs.get_file_info(root)
+        if info.type != pafs.FileType.Directory:
+            return []
+        entries = [i for i in fs.get_file_info(pafs.FileSelector(root))
+                   if i.type == pafs.FileType.File]
+        names = {posixpath.basename(i.path) for i in entries}
+        if not ({"_SUCCESS", "_common_metadata"} & names):
+            return []
+        return [i.path for i in entries if i.path.endswith(".parquet")]
+
+    files = _published_files()
+    if not files:
+        if fs.get_file_info(root).type == pafs.FileType.Directory:
+            # leftovers of a crashed pre-atomic-rename writer (or a foreign
+            # dir): clear so the fresh rename below can land
+            logger.warning("Clearing incomplete materialization at %s", ds_url)
+            fs.delete_dir(root)
+        _materialize_spark_df(df, ds_url, cache_dir_url, fs, root,
+                              compression_codec, row_group_size_mb)
+        files = _published_files()
+        if not files:
+            raise PetastormTpuError(
+                f"Materialized Spark dataset at {ds_url!r} has no complete"
+                " parquet output (committer wrote no _SUCCESS marker?)")
+    else:
+        logger.info("Reusing cached converted dataset %s", ds_url)
+
+    # eventual-consistency wait BEFORE any footer read (module header;
+    # reference spark_dataset_converter.py:565-595)
+    _wait_files_available(fs, files)
+    # schema + row count come from the written footers - never from the driver
+    num_rows = 0
+    arrow_schema = None
+    for path in files:
+        with fs.open_input_file(path) as f:
+            meta = pq.ParquetFile(f)
+            num_rows += meta.metadata.num_rows
+            if arrow_schema is None:
+                arrow_schema = meta.schema_arrow
+    schema = Schema.from_arrow_schema(arrow_schema, name=f"Converted_{tag[:8]}")
+    stamp_dataset_metadata(ds_url, schema, storage_options=storage_options)
+    _advise_on_file_sizes(fs, files)
+    conv = DatasetConverter(ds_url, files, num_rows, schema,
+                            _owns_cache=delete_at_exit,
+                            storage_options=storage_options)
+    _register_converter(conv, delete_at_exit)
+    return conv
 
 
 def _fingerprint(table: pa.Table, params: Dict) -> str:
@@ -336,6 +534,13 @@ def make_converter(data,
             " petastorm.spark.converter.parentCacheDirUrl)")
     cache_dir_url = normalize_dir_url(cache_dir_url)
 
+    if _is_spark_dataframe(data):
+        return _make_spark_converter(data, cache_dir_url, dtype=dtype,
+                                     compression_codec=compression_codec,
+                                     row_group_size_mb=row_group_size_mb,
+                                     delete_at_exit=delete_at_exit,
+                                     storage_options=storage_options)
+
     table = _to_arrow_table(data, dtype)
     # "snappy" is what the write below actually uses when codec is None; the
     # params dict must record the same value or an explicit codec='snappy'
@@ -348,20 +553,8 @@ def make_converter(data,
     fs, root = get_filesystem_and_path(ds_url, storage_options)
     schema = Schema.from_arrow_schema(table.schema, name=f"Converted_{tag[:8]}")
 
-    live = _converters_by_url.get(ds_url)
-    if live is not None and not live._deleted:
-        # same content converted earlier in this process: share the handle, so
-        # one delete() cannot destroy the dataset under another reference.
-        # Persistence wins on disagreement: if any caller asked to keep the
-        # cache (delete_at_exit=False), un-register the exit cleanup.
-        if not delete_at_exit and live._owns_cache:
-            live._owns_cache = False
-            if live in _registered_converters:
-                _registered_converters.remove(live)
-        elif delete_at_exit and not live._owns_cache:
-            warnings.warn(
-                f"Cache {ds_url} was already created with delete_at_exit=False;"
-                " it will be kept despite this call's delete_at_exit=True.")
+    live = _share_live_handle(ds_url, delete_at_exit)
+    if live is not None:
         return live
 
     existing = fs.get_file_info(root)
@@ -375,9 +568,7 @@ def make_converter(data,
             conv = DatasetConverter(ds_url, files, table.num_rows, schema,
                                     _owns_cache=delete_at_exit,
                                     storage_options=storage_options)
-            _converters_by_url[ds_url] = conv
-            if delete_at_exit:
-                _registered_converters.append(conv)
+            _register_converter(conv, delete_at_exit)
             return conv
 
     # write to a temp dir then rename: concurrent converters of the same
@@ -409,7 +600,5 @@ def make_converter(data,
     conv = DatasetConverter(ds_url, files, table.num_rows, schema,
                             _owns_cache=delete_at_exit,
                             storage_options=storage_options)
-    _converters_by_url[ds_url] = conv
-    if delete_at_exit:
-        _registered_converters.append(conv)
+    _register_converter(conv, delete_at_exit)
     return conv

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import hashlib
 from abc import ABC, abstractmethod
-from typing import Callable, Dict, Iterable, List, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -153,12 +153,24 @@ class in_pseudorandom_split(PredicateBase):
     are stable properties of the data, not of the run.
     """
 
-    def __init__(self, fractions: Sequence[float], subset_index: int, field_name: str):
+    def __init__(self, fractions: Sequence[float], subset_index: int,
+                 field_name: str, compat: Optional[str] = None):
+        """``compat='reference'`` reproduces the original petastorm's bucket
+        membership bit-exactly (md5-of-str mod sys.maxsize against
+        fraction*(sys.maxsize-1) bounds, reference predicates.py:39-41,
+        171-182) so an existing train/val/test split migrates with identical
+        row assignment.  Default (None) uses this library's native bucketing
+        (md5-first-8-hex / 2^32) - same statistics, different membership.
+        """
         if not 0 <= subset_index < len(fractions):
             raise PetastormTpuError(f"subset_index {subset_index} out of range")
         if sum(fractions) > 1.0 + 1e-9:
             raise PetastormTpuError(f"fractions sum to {sum(fractions)} > 1")
+        if compat not in (None, "reference"):
+            raise PetastormTpuError(
+                f"compat must be None or 'reference', got {compat!r}")
         self._field = field_name
+        self._compat = compat == "reference"
         lo = float(sum(fractions[:subset_index]))
         hi = lo + float(fractions[subset_index])
         self._lo, self._hi = lo, hi
@@ -171,7 +183,24 @@ class in_pseudorandom_split(PredicateBase):
         digest = hashlib.md5(str(value).encode()).hexdigest()[:8]
         return int(digest, 16) / float(0xFFFFFFFF)
 
+    @staticmethod
+    def _reference_bucket(value) -> int:
+        """Reference ``_string_to_bucket`` (predicates.py:39-41)."""
+        import sys as _sys
+
+        return int(hashlib.md5(str(value).encode("utf-8")).hexdigest(),
+                   16) % _sys.maxsize
+
     def do_include_vectorized(self, columns: Dict[str, np.ndarray]) -> np.ndarray:
         col = columns[self._field]
+        if self._compat:
+            import sys as _sys
+
+            # exact reference arithmetic: float bounds, full-int bucket
+            # (reference predicates.py:171-182)
+            lo = self._lo * (_sys.maxsize - 1)
+            hi = self._hi * (_sys.maxsize - 1)
+            return np.fromiter((lo <= self._reference_bucket(v) < hi
+                                for v in col), dtype=bool, count=len(col))
         h = np.fromiter((self._hash01(v) for v in col), dtype=np.float64, count=len(col))
         return (h >= self._lo) & (h < self._hi)

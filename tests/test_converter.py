@@ -219,3 +219,239 @@ def test_loader_factory_failure_does_not_leak_reader(tmp_path):
         time.sleep(0.1)
         deadline -= 1
     assert threading.active_count() <= before
+
+
+# -- Spark DataFrame input (mocked pyspark, same approach as test_interop) ----
+#
+# The fake DataFrame's toPandas() raises: the converter's Spark path must
+# materialize on the "executors" (df.write.parquet) and never collect to the
+# driver (reference spark_dataset_converter.py:546-562).
+
+
+class _FakeVector:
+    def __init__(self, values):
+        self._values = np.asarray(values, dtype=np.float64)
+
+    def toArray(self):
+        return self._values
+
+
+class _FakeType:
+    def __init__(self, name, element=None):
+        self._name = name
+        self.elementType = element
+
+    @property
+    def type_name(self):
+        return self._name
+
+
+def _fake_type(name, element=None):
+    t = _FakeType(name, element)
+    t.__class__ = type(name, (_FakeType,), {})  # type(x).__name__ drives code
+    return t
+
+
+class _FakeField:
+    def __init__(self, name, data_type):
+        self.name = name
+        self.dataType = data_type
+
+
+class _FakeSchema:
+    def __init__(self, fields):
+        self.fields = fields
+
+    def json(self):
+        return "|".join(f"{f.name}:{type(f.dataType).__name__}"
+                        for f in self.fields)
+
+
+class _FakeCol:
+    def __init__(self, name):
+        self.name = name
+
+    def cast(self, target):
+        return ("cast", self.name, target)
+
+
+def _install_fake_pyspark(monkeypatch):
+    import sys
+    import types
+
+    root = types.ModuleType("pyspark")
+    sql = types.ModuleType("pyspark.sql")
+    sqlf = types.ModuleType("pyspark.sql.functions")
+    ml = types.ModuleType("pyspark.ml")
+    mlf = types.ModuleType("pyspark.ml.functions")
+    sqlf.col = _FakeCol
+    mlf.vector_to_array = lambda col, dtype="float64": ("v2a", col.name, dtype)
+    for name, mod in (("pyspark", root), ("pyspark.sql", sql),
+                      ("pyspark.sql.functions", sqlf), ("pyspark.ml", ml),
+                      ("pyspark.ml.functions", mlf)):
+        monkeypatch.setitem(sys.modules, name, mod)
+
+
+class _FakeSparkDataFrame:
+    """Pandas-backed stand-in: withColumn applies the fake expressions, write
+    splits into two 'executor' part files, toPandas() is forbidden."""
+
+    def __init__(self, pdf, schema, plan_tag):
+        self._pdf = pdf
+        self.schema = schema
+        self._plan_tag = plan_tag
+
+        class _QE:
+            def queryExecution(self_inner):
+                class _A:
+                    def analyzed(self2):
+                        class _S:
+                            def toString(self3):
+                                return plan_tag
+                        return _S()
+                return _A()
+        self._jdf = _QE()
+
+    def toPandas(self):
+        raise AssertionError("driver-side collection: the Spark path must"
+                            " materialize on executors")
+
+    def withColumn(self, name, expr):
+        pdf = self._pdf.copy()
+        fields = list(self.schema.fields)
+        idx = next(i for i, f in enumerate(fields) if f.name == name)
+        kind = expr[0]
+        if kind == "v2a":
+            _, src, dtype = expr
+            np_t = np.float32 if dtype == "float32" else np.float64
+            pdf[name] = [np.asarray(v.toArray(), dtype=np_t)
+                         for v in pdf[src]]
+            fields[idx] = _FakeField(name, _fake_type(
+                "ArrayType", _fake_type(
+                    "FloatType" if dtype == "float32" else "DoubleType")))
+        elif kind == "cast":
+            _, src, target = expr
+            if target in ("float", "double"):
+                np_t = np.float32 if target == "float" else np.float64
+                pdf[name] = pdf[src].astype(np_t)
+                fields[idx] = _FakeField(name, _fake_type(
+                    "FloatType" if target == "float" else "DoubleType"))
+            else:  # array<float> / array<double>
+                np_t = np.float32 if "float" in target else np.float64
+                pdf[name] = [np.asarray(v, dtype=np_t) for v in pdf[src]]
+                fields[idx] = _FakeField(name, _fake_type(
+                    "ArrayType", _fake_type(
+                        "FloatType" if "float" in target else "DoubleType")))
+        else:
+            raise AssertionError(f"unknown fake expr {expr!r}")
+        return _FakeSparkDataFrame(pdf, _FakeSchema(fields),
+                                   self._plan_tag + f"+{name}:{kind}")
+
+    @property
+    def write(self):
+        df = self
+
+        class _Writer:
+            def mode(self_inner, m):
+                return self_inner
+
+            def option(self_inner, k, v):
+                return self_inner
+
+            def parquet(self_inner, url):
+                path = url[len("file://"):] if url.startswith("file://") else url
+                os.makedirs(path, exist_ok=True)
+                n = len(df._pdf)
+                for part, sl in enumerate((slice(0, n // 2), slice(n // 2, n))):
+                    table = pa.Table.from_pandas(df._pdf.iloc[sl],
+                                                 preserve_index=False)
+                    import pyarrow.parquet as pq
+                    pq.write_table(table,
+                                   os.path.join(path, f"part-{part:05d}.parquet"))
+                open(os.path.join(path, "_SUCCESS"), "w").close()
+        return _Writer()
+
+
+def _spark_frame(n=32):
+    pdf = pd.DataFrame({
+        "id": np.arange(n, dtype=np.int64),
+        "x": np.linspace(0, 1, n).astype(np.float64),
+        "vec": [_FakeVector([i, i + 0.5, i + 0.25]) for i in range(n)],
+    })
+    schema = _FakeSchema([
+        _FakeField("id", _fake_type("LongType")),
+        _FakeField("x", _fake_type("DoubleType")),
+        _FakeField("vec", _fake_type("VectorUDT")),
+    ])
+    return _FakeSparkDataFrame(pdf, schema, plan_tag=f"fake-plan-{n}")
+
+
+def test_spark_df_materializes_on_executors(tmp_path, monkeypatch):
+    _install_fake_pyspark(monkeypatch)
+    with pytest.warns(UserWarning, match="MLlib vector"):
+        conv = make_converter(_spark_frame(), cache_dir_url=str(tmp_path))
+    try:
+        assert len(conv) == 32
+        assert len(conv.file_urls) == 2  # one per "executor" part file
+        with conv.make_reader(reader_pool_type="serial", num_epochs=1,
+                              shuffle_row_groups=False) as r:
+            rows = list(r)
+        assert [row.id for row in rows] == list(range(32))
+        # VectorUDT -> float32 array (default dtype='float32'), values intact
+        v5 = np.asarray(rows[5].vec, dtype=np.float32)
+        np.testing.assert_allclose(v5, [5.0, 5.5, 5.25])
+        # DoubleType scalar downcast to float32 by dtype='float32'
+        assert conv.schema["x"].dtype == np.float32
+    finally:
+        conv.delete()
+
+
+def test_spark_df_crashed_write_not_adopted(tmp_path, monkeypatch):
+    """A cache dir with part files but no completeness marker (_SUCCESS /
+    _common_metadata) is a crashed write: it must be re-materialized, never
+    silently reused as a complete dataset."""
+    import warnings as _w
+
+    import pyarrow.parquet as pq
+
+    _install_fake_pyspark(monkeypatch)
+    df = _spark_frame()
+    # predict the cache dir, then plant a partial (marker-less) write there
+    from petastorm_tpu.converter import _spark_fingerprint, _spark_prepare_df
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        prepared = _spark_prepare_df(df, "float32")
+    tag = _spark_fingerprint(prepared, {"codec": "snappy", "rg_mb": 32.0,
+                                        "v": 2, "engine": "spark"})
+    stale = tmp_path / f"converted-{tag}"
+    stale.mkdir()
+    pq.write_table(pa.table({"id": [999]}), str(stale / "part-00000.parquet"))
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        conv = make_converter(_spark_frame(), cache_dir_url=str(tmp_path),
+                              row_group_size_mb=32.0)
+    try:
+        assert len(conv) == 32  # fresh materialization, not the stale row
+        with conv.make_reader(reader_pool_type="serial", num_epochs=1) as r:
+            ids = sorted(row.id for row in r)
+        assert ids == list(range(32))
+    finally:
+        conv.delete()
+
+
+def test_spark_df_plan_dedup_and_no_collection(tmp_path, monkeypatch):
+    _install_fake_pyspark(monkeypatch)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        a = make_converter(_spark_frame(), cache_dir_url=str(tmp_path))
+        b = make_converter(_spark_frame(), cache_dir_url=str(tmp_path))
+        c = make_converter(_spark_frame(16), cache_dir_url=str(tmp_path))
+    try:
+        assert b is a          # same analyzed plan -> same cache entry
+        assert c is not a      # different plan -> different entry
+    finally:
+        a.delete(), c.delete()
