@@ -1,0 +1,97 @@
+"""``petastorm-tpu-scaling``: worker-count scaling microbenchmark.
+
+Prints one line per worker count (samples/sec over a synthetic jpeg dataset)
+so operators can pick ``workers_count`` for THEIR host instead of trusting a
+default - on low-core hosts fewer threads usually wins (docs/operations.md),
+on real TPU host VMs the curve keeps climbing for a while.  Reference analog:
+the pool sizing advice the reference buries in benchmark/throughput.py flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from typing import List, Optional
+
+
+def build_dataset(url: str, rows: int, height: int, width: int) -> None:
+    import numpy as np
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.schema import Field, Schema
+
+    schema = Schema("Scaling", [
+        Field("id", np.int64),
+        Field("image", np.uint8, (height, width, 3),
+              CompressedImageCodec("jpeg", quality=85)),
+    ])
+    x, y = np.meshgrid(np.arange(width), np.arange(height))
+    rng = np.random.default_rng(0)
+
+    def img(i):
+        base = (np.stack([np.sin(x / (5 + i % 7)), np.cos(y / (6 + i % 5)),
+                          np.sin((x + y) / 11.0)], -1) + 1) * 110
+        return (base + rng.normal(0, 5, base.shape)).clip(0, 255).astype(np.uint8)
+
+    write_dataset(url, schema, [{"id": i, "image": img(i)} for i in range(rows)],
+                  row_group_size_rows=max(rows // 16, 1))
+
+
+def measure(url: str, pool_type: str, workers: int, epochs: int) -> dict:
+    from petastorm_tpu.reader import make_batch_reader
+
+    t0 = time.perf_counter()
+    n = 0
+    with make_batch_reader(url, reader_pool_type=pool_type,
+                           workers_count=workers, num_epochs=epochs,
+                           shuffle_row_groups=False) as r:
+        for batch in r.iter_batches():
+            n += batch.num_rows
+        diag = r.diagnostics
+    wall = time.perf_counter() - t0
+    return {"pool": pool_type, "workers": workers,
+            "samples_per_sec": round(n / wall, 2), "samples": n,
+            "wall_s": round(wall, 3),
+            "shm_transport": bool(diag.get("shm_transport", False))}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-scaling",
+        description="Measure reader throughput across worker counts")
+    parser.add_argument("--workers", nargs="+", type=int,
+                        default=[1, 2, 4, 8, 16])
+    parser.add_argument("--pool-type", default="thread",
+                        choices=("thread", "process"))
+    parser.add_argument("--rows", type=int, default=512)
+    parser.add_argument("--image-size", type=int, nargs=2, default=(128, 128),
+                        metavar=("H", "W"))
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--dataset-url", default=None,
+                        help="reuse an existing dataset instead of generating")
+    args = parser.parse_args(argv)
+
+    url = args.dataset_url
+    tmp = None
+    if url is None:
+        tmp = tempfile.mkdtemp(prefix="pst_scaling_")
+        url = tmp + "/ds"
+        build_dataset(url, args.rows, *args.image_size)
+    try:
+        for w in args.workers:
+            print(json.dumps(measure(url, args.pool_type, w, args.epochs)),
+                  flush=True)
+    finally:
+        if tmp is not None:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -557,6 +557,102 @@ class JaxDataLoader:
 
     # -- checkpoint/resume (reference gap: SURVEY.md section 5) ---------------
 
+    def drain(self, all_gather_counts=None):
+        """Quiesce ingest and return an iterator over every in-flight batch;
+        afterwards the loader is exhausted and ``state_dict()`` is an EXACT
+        cursor (zero re-read rows on resume) even with thread/process pools
+        and a device shuffle buffer active.
+
+        The preemption-checkpoint flow on a TPU pod::
+
+            for batch in loader.drain():   # train on what's already in flight
+                step(batch)
+            save(loader.state_dict())      # exact - no re-reads on restart
+
+        Quiesce happens EAGERLY in this call (not on first ``next``), so the
+        returned iterator must be consumed before ``state_dict()`` for the
+        exactness guarantee - an unconsumed drain leaves batches undelivered,
+        which ``state_dict()``'s re-read window then covers as usual.
+
+        Multi-host: each host freezes its pipeline at a timing-dependent
+        point, so hosts drain UNEQUAL batch counts - if ``step`` runs
+        pod-wide collectives, the pod would hang.  On a mesh with
+        ``jax.process_count() > 1`` the hosts therefore agree on the maximum
+        drained count (one small all-gather) and the shorter ones pad with
+        zero batches carrying ``'_valid_rows': 0`` - every host yields the
+        same number of steps.  ``all_gather_counts`` overrides the collective
+        (tests; custom coordination).
+
+        With ``drop_last=True`` a final partial batch's rows are dropped
+        exactly as they would be at an epoch end; training that checkpoints
+        mid-epoch should use ``drop_last=False`` (mesh consumers get the
+        zero-padded ``'_valid_rows'`` tail batch).
+        """
+        if not hasattr(self._reader, "quiesce"):
+            raise PetastormTpuError(
+                f"Reader {type(self._reader).__name__} does not support"
+                " quiesce(); drain-to-cursor needs a petastorm_tpu Reader")
+        self._reader.quiesce()
+
+        multihost = self._mesh is not None and (
+            all_gather_counts is not None or jax.process_count() > 1)
+        if not multihost:
+            def _local():
+                while True:
+                    try:
+                        yield next(self)
+                    except StopIteration:
+                        return
+            return _local()
+
+        # multi-host: drain locally first (bounded by the in-flight window),
+        # agree on the pod-wide max, pad the difference so every host steps
+        # the same number of times
+        local = []
+        while True:
+            try:
+                local.append(next(self))
+            except StopIteration:
+                break
+        if all_gather_counts is None:
+            from jax.experimental import multihost_utils
+
+            counts = multihost_utils.process_allgather(
+                np.asarray([len(local)], dtype=np.int32))
+            target = int(np.max(counts))
+        else:
+            target = int(max(all_gather_counts(len(local))))
+
+        def _aligned():
+            template = local[-1] if local else None
+            for batch in local:
+                yield batch
+            for _ in range(target - len(local)):
+                if template is None:
+                    raise PetastormTpuError(
+                        "drain() alignment needs at least one delivered batch"
+                        " on this host to shape the padding; this host drained"
+                        " zero batches while a peer drained some - checkpoint"
+                        " at a step boundary instead")
+                pad = {}
+                for name, value in template.items():
+                    if name == "_valid_rows":
+                        continue
+                    if isinstance(value, jax.Array):
+                        # zeros with the SAME global shape and sharding so
+                        # collectives in the consumer's step see identically
+                        # laid-out operands (callback form stays correct when
+                        # shards span processes)
+                        pad[name] = jax.make_array_from_callback(
+                            value.shape, value.sharding,
+                            lambda idx, _v=value: np.zeros(_v.shape,
+                                                           _v.dtype)[idx])
+                    else:
+                        pad[name] = value  # host fields pass through
+                pad["_valid_rows"] = 0
+                yield pad
+        return _aligned()
+
     def state_dict(self) -> Dict:
         """Data-position cursor to pair with a training checkpoint.
 
@@ -566,8 +662,8 @@ class JaxDataLoader:
         Mid-epoch the reader cursor can run ahead of deliveries by the
         in-flight window - both producer-stage queues (2x ``prefetch``) plus
         ALL ``device_shuffle_capacity`` resident batches - so keep buffers
-        small (or zero) when tight resume matters (see
-        petastorm_tpu.jax.checkpoint module docs).
+        small (or zero) when tight resume matters, or use ``drain()`` first
+        for an exact cursor (see petastorm_tpu.jax.checkpoint module docs).
         """
         if not hasattr(self._reader, "state_dict"):
             raise PetastormTpuError(

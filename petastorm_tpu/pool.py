@@ -43,6 +43,12 @@ class WorkerError(PetastormTpuError):
     """A worker failed; message includes the remote traceback."""
 
 
+class VentilationCancelled(Exception):
+    """An ``executor.put`` blocked on a full queue was withdrawn by its
+    cancel_event (Ventilator.pause_and_join with a saturated pipeline); the
+    item was NOT enqueued.  Internal control flow, never user-visible."""
+
+
 class _Failure:
     __slots__ = ("formatted",)
 
@@ -91,7 +97,10 @@ class ExecutorBase(ABC):
         ...
 
     @abstractmethod
-    def put(self, item: Any) -> None:
+    def put(self, item: Any, cancel_event=None) -> None:
+        """Enqueue a work item; blocks on a full input queue.  When
+        ``cancel_event`` is set while blocked, raises VentilationCancelled
+        WITHOUT having enqueued the item (quiesce with a full pipeline)."""
         ...
 
     @abstractmethod
@@ -134,13 +143,15 @@ class SerialExecutor(ExecutorBase):
     def start(self, worker_factory: WorkerFactory) -> None:
         self._fn = worker_factory()
 
-    def put(self, item: Any) -> None:
+    def put(self, item: Any, cancel_event=None) -> None:
         while not self._stopped:
             try:
                 self._items.put(item, timeout=_POLL_S)
                 self._ventilated += 1
                 return
             except queue.Full:
+                if cancel_event is not None and cancel_event.is_set():
+                    raise VentilationCancelled()
                 continue
         raise ReaderClosedError("Executor is stopped")
 
@@ -251,7 +262,7 @@ class ThreadedExecutor(ExecutorBase):
                 self._out_queue.put(value)
                 return
 
-    def put(self, item: Any) -> None:
+    def put(self, item: Any, cancel_event=None) -> None:
         if self._stopped:
             raise ReaderClosedError("Executor is stopped")
         while not self._stop_event.is_set():
@@ -259,6 +270,10 @@ class ThreadedExecutor(ExecutorBase):
                 self._in_queue.put(item)
                 self._ventilated += 1
                 return
+            if cancel_event is not None and cancel_event.is_set():
+                # caller withdrew the put while the queue was full (quiesce
+                # with a saturated pipeline); the item was NOT enqueued
+                raise VentilationCancelled()
         raise ReaderClosedError("Executor stopped while putting")
 
     def get(self, timeout: Optional[float] = None) -> Any:
@@ -391,7 +406,7 @@ class _ProcessExecutor(ExecutorBase):
             p.start()
             self._procs.append(p)
 
-    def put(self, item: Any) -> None:
+    def put(self, item: Any, cancel_event=None) -> None:
         if self._stopped:
             raise ReaderClosedError("Executor is stopped")
         while True:
@@ -402,6 +417,8 @@ class _ProcessExecutor(ExecutorBase):
             except queue.Full:
                 if self._stopped:
                     raise ReaderClosedError("Executor stopped while putting")
+                if cancel_event is not None and cancel_event.is_set():
+                    raise VentilationCancelled()
 
     def get(self, timeout: Optional[float] = None) -> Any:
         import time
@@ -488,6 +505,10 @@ class Ventilator:
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.items_per_epoch = len(plan.epoch_items(0))
+        #: absolute ordinal AFTER the last item actually handed to the
+        #: executor (== items guaranteed to flow through to the consumer);
+        #: exact once the thread is joined (see pause_and_join)
+        self.ventilated = start_item
 
     @property
     def total_items(self) -> Optional[int]:
@@ -518,10 +539,12 @@ class Ventilator:
                 if self._stop_event.is_set():
                     return
                 try:
-                    self._executor.put(VentilatedItem(ordinal, item))
-                except ReaderClosedError:
+                    self._executor.put(VentilatedItem(ordinal, item),
+                                       cancel_event=self._stop_event)
+                except (ReaderClosedError, VentilationCancelled):
                     return
                 ordinal += 1
+                self.ventilated = ordinal
             offset = 0
             epoch += 1
 
@@ -531,3 +554,12 @@ class Ventilator:
     def join(self) -> None:
         if self._thread is not None:
             self._thread.join()
+
+    def pause_and_join(self) -> int:
+        """Stop issuing new work items and wait for the thread; returns the
+        exact count of items ventilated (items already handed to the executor
+        still flow through to the consumer - nothing is retracted).  The
+        quiesce half of drain-to-cursor checkpointing (Reader.quiesce)."""
+        self._stop_event.set()
+        self.join()
+        return self.ventilated

@@ -582,6 +582,19 @@ class Reader:
 
     # -- resume support (reference gap: SURVEY.md section 5 checkpoint/resume) --
 
+    def quiesce(self) -> int:
+        """Stop issuing new work items; in-flight ones still deliver.
+
+        After calling this, iteration ends once the already-ventilated items
+        are consumed, at which point ``state_dict()`` is an EXACT cursor:
+        resuming re-reads zero rows.  The drain half lives in
+        ``JaxDataLoader.drain()``; plain readers just exhaust the iterator.
+        Returns the absolute ordinal the stream will stop at.
+        """
+        ventilated = self._ventilator.pause_and_join()
+        self._expected_items = max(ventilated - self._start_item, 0)
+        return ventilated
+
     def state_dict(self) -> dict:
         """Work-item cursor for ``make_reader(..., resume_from=state)``.
 

@@ -113,3 +113,131 @@ def test_state_dict_requires_real_reader():
     with JaxDataLoader(mock, batch_size=4) as loader:
         with pytest.raises(PetastormTpuError, match="state_dict"):
             loader.state_dict()
+
+
+def test_drain_to_cursor_exact_resume(ds):
+    """VERDICT round-1 #9: drain() + state_dict() is an exact cursor - resume
+    re-reads ZERO rows, with a thread pool and the HBM device shuffle buffer
+    both active."""
+    import collections
+
+    # enough rowgroups that the in-flight window cannot swallow the whole
+    # dataset before quiesce (bounded results queue keeps the reader behind)
+    url = ds + "_drain"
+    import os
+    if not os.path.exists(url):
+        rng = np.random.default_rng(1)
+        write_dataset(url, SCHEMA,
+                      [{"id": i, "x": rng.standard_normal(4).astype(np.float32)}
+                       for i in range(128)],
+                      row_group_size_rows=2)
+    ds = url
+    n_rows = 128
+
+    seen = []
+    with make_batch_reader(ds, reader_pool_type="thread", workers_count=4,
+                           results_queue_size=4,
+                           shuffle_seed=5, num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=8, drop_last=False,
+                           device_shuffle_capacity=3,
+                           device_shuffle_seed=0) as loader:
+            it = iter(loader)
+            for _ in range(2):  # a couple of training steps
+                b = next(it)
+                seen.extend(int(v) for v in np.asarray(b["id"]))
+            drained = list(loader.drain())  # preemption: flush in-flight work
+            for b in drained:
+                seen.extend(int(v) for v in np.asarray(b["id"]))
+            state = loader.state_dict()
+    assert state["reader"]["ordinal_exact"]
+
+    resumed = []
+    with make_batch_reader(ds, reader_pool_type="thread", workers_count=4,
+                           shuffle_seed=5, num_epochs=1,
+                           resume_from=state["reader"]) as r:
+        with JaxDataLoader(r, batch_size=8, drop_last=False) as loader:
+            for b in loader:
+                resumed.extend(int(v) for v in np.asarray(b["id"]))
+
+    counts = collections.Counter(seen + resumed)
+    assert sorted(counts) == list(range(n_rows)), "rows lost"
+    assert max(counts.values()) == 1, "rows re-read: cursor was not exact"
+    assert len(resumed) > 0  # the drain really stopped mid-stream
+
+
+def test_drain_after_exhaustion_is_empty(ds):
+    with make_batch_reader(ds, num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=8) as loader:
+            n = sum(1 for _ in loader)
+            assert n == 8
+            assert list(loader.drain()) == []
+
+
+def test_drain_with_saturated_pipeline_no_deadlock(ds):
+    """The preemption case: prefetch ran far ahead, every bounded queue is
+    full, the ventilator is blocked mid-put.  drain() must cancel that put
+    and flush cleanly instead of deadlocking (the put is withdrawn, so the
+    cursor stays exact)."""
+    import collections
+    import os
+    import time
+
+    url = ds + "_saturated"
+    if not os.path.exists(url):
+        rng = np.random.default_rng(2)
+        write_dataset(url, SCHEMA,
+                      [{"id": i, "x": rng.standard_normal(4).astype(np.float32)}
+                       for i in range(256)],
+                      row_group_size_rows=2)
+
+    seen = []
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=4,
+                           results_queue_size=4, shuffle_seed=3,
+                           num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=8, drop_last=False) as loader:
+            it = iter(loader)
+            seen.extend(int(v) for v in np.asarray(next(it)["id"]))
+            time.sleep(1.5)  # let every bounded stage fill to capacity
+            t0 = time.perf_counter()
+            for b in loader.drain():
+                seen.extend(int(v) for v in np.asarray(b["id"]))
+            assert time.perf_counter() - t0 < 30, "drain deadlocked"
+            state = loader.state_dict()
+    assert state["reader"]["ordinal_exact"]
+
+    resumed = []
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=4,
+                           shuffle_seed=3, num_epochs=1,
+                           resume_from=state["reader"]) as r:
+        with JaxDataLoader(r, batch_size=8, drop_last=False) as loader:
+            for b in loader:
+                resumed.extend(int(v) for v in np.asarray(b["id"]))
+    counts = collections.Counter(seen + resumed)
+    assert sorted(counts) == list(range(256)) and max(counts.values()) == 1
+    assert resumed  # saturation really left work for the resume
+
+
+def test_drain_multihost_alignment_pads_short_hosts(ds):
+    """With a mesh and a pod, hosts drain unequal counts; the shorter host
+    must pad with zero '_valid_rows' batches so collective steps align."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    with make_batch_reader(ds, reader_pool_type="thread", shuffle_seed=1,
+                           num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=8, mesh=mesh,
+                           shardings=PartitionSpec("data"),
+                           drop_last=False) as loader:
+            it = iter(loader)
+            next(it)
+            # pretend a peer host drained 3 more batches than we will
+            drained = list(loader.drain(
+                all_gather_counts=lambda mine: [mine, mine + 3]))
+    real = [b for b in drained if b.get("_valid_rows", b["id"].shape[0]) != 0]
+    pads = [b for b in drained if b.get("_valid_rows", -1) == 0]
+    assert len(pads) == 3
+    for p in pads:
+        assert p["id"].shape == real[-1]["id"].shape
+        assert str(p["id"].sharding.spec) == str(PartitionSpec("data"))
+        assert np.asarray(p["id"]).sum() == 0
