@@ -23,21 +23,17 @@ def build_dataset(url: str, rows: int, height: int, width: int) -> None:
     from petastorm_tpu.codecs import CompressedImageCodec
     from petastorm_tpu.etl.writer import write_dataset
     from petastorm_tpu.schema import Field, Schema
+    from petastorm_tpu.test_util.synthetic import synthetic_rgb_image
 
     schema = Schema("Scaling", [
         Field("id", np.int64),
         Field("image", np.uint8, (height, width, 3),
               CompressedImageCodec("jpeg", quality=85)),
     ])
-    x, y = np.meshgrid(np.arange(width), np.arange(height))
-    rng = np.random.default_rng(0)
-
-    def img(i):
-        base = (np.stack([np.sin(x / (5 + i % 7)), np.cos(y / (6 + i % 5)),
-                          np.sin((x + y) / 11.0)], -1) + 1) * 110
-        return (base + rng.normal(0, 5, base.shape)).clip(0, 255).astype(np.uint8)
-
-    write_dataset(url, schema, [{"id": i, "image": img(i)} for i in range(rows)],
+    write_dataset(url, schema,
+                  [{"id": i, "image": synthetic_rgb_image(i, height, width,
+                                                          noise=5.0)}
+                   for i in range(rows)],
                   row_group_size_rows=max(rows // 16, 1))
 
 

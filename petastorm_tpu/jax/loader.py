@@ -641,12 +641,14 @@ class JaxDataLoader:
                     if isinstance(value, jax.Array):
                         # zeros with the SAME global shape and sharding so
                         # collectives in the consumer's step see identically
-                        # laid-out operands (callback form stays correct when
-                        # shards span processes)
+                        # laid-out operands; allocate only shard-sized zeros
+                        # (a global-shape buffer per shard would spike host
+                        # memory exactly at preemption time)
+                        shard_shape = value.sharding.shard_shape(value.shape)
                         pad[name] = jax.make_array_from_callback(
                             value.shape, value.sharding,
-                            lambda idx, _v=value: np.zeros(_v.shape,
-                                                           _v.dtype)[idx])
+                            lambda idx, _s=shard_shape, _d=value.dtype:
+                                np.zeros(_s, _d))
                     else:
                         pad[name] = value  # host fields pass through
                 pad["_valid_rows"] = 0

@@ -32,6 +32,38 @@ TEST_SCHEMA = Schema("TestSchema", [
 ])
 
 
+def synthetic_rgb_image(i: int, height: int, width: int,
+                        noise: float = 6.0,
+                        rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Deterministic-ish smooth RGB test image (compresses like a photo, not
+    like random noise) - the one generator shared by the scaling/ops
+    benchmarks and stress tests instead of per-file copies."""
+    x, y = np.meshgrid(np.arange(width), np.arange(height))
+    base = (np.stack([np.sin(x / (7.0 + i % 5)), np.cos(y / 6.0),
+                      np.sin((x + y) / 11.0)], -1) + 1) * 110
+    if noise:
+        base = base + (rng or np.random.default_rng(i)).normal(0, noise,
+                                                               base.shape)
+    return base.clip(0, 255).astype(np.uint8)
+
+
+def synthetic_jpeg_bytes(n: int, height: int, width: int,
+                         quality: int = 90) -> List[bytes]:
+    """``n`` same-geometry jpeg streams of synthetic_rgb_image frames."""
+    import cv2
+
+    out = []
+    for i in range(n):
+        ok, enc = cv2.imencode(
+            ".jpeg", cv2.cvtColor(synthetic_rgb_image(i, height, width),
+                                  cv2.COLOR_RGB2BGR),
+            [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+        if not ok:
+            raise RuntimeError("cv2.imencode failed")
+        out.append(enc.tobytes())
+    return out
+
+
 def random_row(schema: Schema, rng: np.random.Generator, row_index: int) -> Dict:
     """One schema-conformant random row (reference: generator.py:21-47)."""
     row: Dict = {}

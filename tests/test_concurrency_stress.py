@@ -106,23 +106,13 @@ def test_shard_mode_epoch_oversubscribed_no_loss(ds):
 def test_native_decode_fanout_matches_single_thread(tmp_path):
     """The batched native decoder's internal thread fan-out (nthreads=16,
     oversubscribed here) must be bit-identical to nthreads=1."""
-    cv2 = pytest.importorskip("cv2")
+    pytest.importorskip("cv2")
     from petastorm_tpu.native import image as native_image
+    from petastorm_tpu.test_util.synthetic import synthetic_jpeg_bytes
 
     if not native_image.available():
         pytest.skip("native image library unavailable")
-    rng = np.random.default_rng(0)
-    x, y = np.meshgrid(np.arange(96), np.arange(64))
-    bufs = []
-    for i in range(64):
-        img = ((np.stack([np.sin(x / (5 + i % 7)), np.cos(y / 6.0),
-                          np.sin((x + y) / 9.0)], -1) + 1) * 110
-               ).clip(0, 255).astype(np.uint8)
-        ok, enc = cv2.imencode(".jpeg",
-                               cv2.cvtColor(img, cv2.COLOR_RGB2BGR),
-                               [int(cv2.IMWRITE_JPEG_QUALITY), 90])
-        assert ok
-        bufs.append(enc.tobytes())
+    bufs = synthetic_jpeg_bytes(64, 64, 96, quality=90)
     import pyarrow as pa
 
     col = pa.array(bufs, type=pa.binary())
