@@ -86,12 +86,12 @@ class ColumnBatch:
                         # different plane shapes - surface guidance, not a
                         # bare numpy shape error
                         from petastorm_tpu.errors import CodecError
+                        from petastorm_tpu.native.image import \
+                            _MIXED_GEOMETRY_GUIDANCE
                         raise CodecError(
                             f"column {name!r}: coefficient-plane shapes differ"
-                            " between rowgroups - the dataset mixes jpeg"
-                            " geometries/subsampling, which the device decode"
-                            " path cannot batch (XLA compiles per geometry)."
-                            " Use decode_placement='host'.") from exc
+                            f" between rowgroups: {_MIXED_GEOMETRY_GUIDANCE}"
+                            ) from exc
                     raise
             else:
                 merged = np.empty(sum(len(c) for c in cols), dtype=object)

@@ -42,7 +42,10 @@ def main() -> int:
     mean = np.array([0.485, 0.456, 0.406], np.float32)
     std = np.array([0.229, 0.224, 0.225], np.float32)
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    # the SAME backend test normalize_images uses (normalize.py:114: 'axon'
+    # is the tunneled TPU PJRT plugin), so engagement reporting cannot drift
+    # from what the op actually does
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     pallas_engaged = bool(on_tpu and _choose_block(B, H * W * C) is not None)
     print(json.dumps({"metric": "pallas_engaged", "value": pallas_engaged,
                       "backend": jax.default_backend()}), flush=True)

@@ -40,15 +40,18 @@ def build_dataset(url: str, rows: int, height: int, width: int) -> None:
 def measure(url: str, pool_type: str, workers: int, epochs: int) -> dict:
     from petastorm_tpu.reader import make_batch_reader
 
-    t0 = time.perf_counter()
     n = 0
     with make_batch_reader(url, reader_pool_type=pool_type,
                            workers_count=workers, num_epochs=epochs,
                            shuffle_row_groups=False) as r:
+        # timer starts AFTER reader/pool construction: process workers cost
+        # seconds of spawn each, and the startup scales with worker count -
+        # including it would invert the exact curve this tool exists to show
+        t0 = time.perf_counter()
         for batch in r.iter_batches():
             n += batch.num_rows
+        wall = time.perf_counter() - t0
         diag = r.diagnostics
-    wall = time.perf_counter() - t0
     return {"pool": pool_type, "workers": workers,
             "samples_per_sec": round(n / wall, 2), "samples": n,
             "wall_s": round(wall, 3),

@@ -314,6 +314,7 @@ class JaxDataLoader:
                 if isinstance(item, _Error):
                     self._push(item)
                     self._sentinel_pending = True
+                    self._abort_upstream()
                     return
                 if isinstance(item, _Done):
                     break
@@ -335,6 +336,20 @@ class JaxDataLoader:
         except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
             self._push(_Error(exc))
             self._sentinel_pending = True
+            self._abort_upstream()
+
+    def _abort_upstream(self) -> None:
+        """A producer stage failed terminally: wind down the OTHER producer
+        stage, the reader, its executor and ventilator - otherwise (without a
+        context manager) the assembly thread would spin on a full host queue
+        and the pool would burn wakeups until process exit.  The _Error is
+        already in the consumer queue, so ``__next__`` still surfaces it
+        (queue drain happens before the stopped-check's StopIteration)."""
+        self._stop_event.set()
+        try:
+            self._reader.stop()
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            logger.debug("reader stop during abort failed", exc_info=True)
 
     def _host_push(self, value) -> None:
         while not self._stop_event.is_set():

@@ -192,11 +192,11 @@ class RandomShufflingBuffer(ShufflingBufferBase):
             buf = self._columns[name]
             if buf.dtype != object and col.shape[1:] != buf.shape[1:]:
                 if "#" in name:
+                    from petastorm_tpu.native.image import \
+                        _MIXED_GEOMETRY_GUIDANCE
                     raise PetastormTpuError(
                         f"Column {name!r}: coefficient-plane shapes differ"
-                        " between rowgroups - the dataset mixes jpeg"
-                        " geometries/subsampling, which the device decode path"
-                        " cannot batch. Use decode_placement='host'.")
+                        f" between rowgroups: {_MIXED_GEOMETRY_GUIDANCE}")
                 raise PetastormTpuError(
                     f"Column {name!r} row-shape {col.shape[1:]} does not match"
                     f" buffer {buf.shape[1:]}; pad variable fields before shuffling")

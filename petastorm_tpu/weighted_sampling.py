@@ -38,6 +38,11 @@ class WeightedSamplingReader:
         self.ngram = getattr(first, "ngram", None)
         self.schema = first.schema
         self.output_schema = getattr(first, "output_schema", first.schema)
+        #: decode_placement='device' fields propagate so JaxDataLoader finds
+        #: and finishes the coefficient-plane columns; every sub-reader must
+        #: agree (mixing a planes stream with a pixels stream cannot batch)
+        self.device_decode_fields = list(
+            getattr(first, "device_decode_fields", ()) or ())
         for r in readers[1:]:
             if r.batched_output != self.batched_output:
                 raise PetastormTpuError("All readers must share batched_output mode")
@@ -49,6 +54,12 @@ class WeightedSamplingReader:
                 raise PetastormTpuError(
                     f"Schema mismatch: {list(r.schema.fields)} vs"
                     f" {list(self.schema.fields)}")
+            if list(getattr(r, "device_decode_fields", ()) or ()) != \
+                    self.device_decode_fields:
+                raise PetastormTpuError(
+                    "All readers must share the same decode_placement: one"
+                    f" ships {self.device_decode_fields or 'pixels'} and"
+                    f" another {getattr(r, 'device_decode_fields', []) or 'pixels'}")
 
     @property
     def last_row_consumed(self) -> bool:
@@ -58,6 +69,12 @@ class WeightedSamplingReader:
         return self
 
     def __next__(self):
+        if self.device_decode_fields:
+            raise PetastormTpuError(
+                f"fields {self.device_decode_fields} use"
+                " decode_placement='device' (coefficient planes, not pixels);"
+                " consume through petastorm_tpu.jax.JaxDataLoader or use"
+                " decode_placement='host'")
         while self._alive:
             weights = self._p[self._alive] / self._p[self._alive].sum()
             i = int(self._rng.choice(len(self._alive), p=weights))

@@ -383,7 +383,7 @@ def test_mixed_geometry_across_rowgroups_guided(tmp_path):
     with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
                            decode_placement={"image": "device"}) as r:
         with JaxDataLoader(r, batch_size=8, fields=["image"]) as loader:
-            with pytest.raises(CodecError, match="mixes jpeg"):
+            with pytest.raises(CodecError, match="decode_placement='host'"):
                 list(loader)
 
 
@@ -453,3 +453,59 @@ def test_device_decode_with_process_pool(jpeg_ds):
     assert all(b["image"].shape == (8, 64, 96, 3) for b in batches)
     seen = sorted(int(i) for b in batches for i in np.asarray(b["idx"]))
     assert seen == list(range(32))
+
+
+def test_weighted_sampling_propagates_device_decode(jpeg_ds):
+    """A weighted mix of device-decode readers feeds the jax loader (the
+    coefficient-plane columns need the loader's on-chip finish), and the
+    row path refuses, like a plain Reader."""
+    from petastorm_tpu.errors import PetastormTpuError
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+    r1 = make_batch_reader(jpeg_ds, num_epochs=1, shuffle_row_groups=False,
+                           decode_placement={"image": "device"})
+    r2 = make_batch_reader(jpeg_ds, num_epochs=1, shuffle_row_groups=False,
+                           decode_placement={"image": "device"})
+    mixed = WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=0)
+    assert mixed.device_decode_fields == ["image"]
+    with pytest.raises(PetastormTpuError, match="JaxDataLoader"):
+        next(mixed)
+    with mixed:
+        with JaxDataLoader(mixed, batch_size=8,
+                           fields=["idx", "image"]) as loader:
+            b = next(iter(loader))
+    assert np.asarray(b["image"]).shape == (8, 64, 96, 3)
+
+    # mismatched placement across sub-readers is refused up front
+    r3 = make_batch_reader(jpeg_ds, num_epochs=1,
+                           decode_placement={"image": "device"})
+    r4 = make_batch_reader(jpeg_ds, num_epochs=1)
+    try:
+        with pytest.raises(PetastormTpuError, match="decode_placement"):
+            WeightedSamplingReader([r3, r4], [0.5, 0.5])
+    finally:
+        for r in (r3, r4):
+            r.stop(); r.join()
+
+
+def test_producer_error_winds_down_pipeline(jpeg_ds):
+    """A terminal producer error must stop the reader/executor/assembly
+    threads even WITHOUT the context manager - no spinning threads left."""
+    import threading
+    import time
+
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    before = threading.active_count()
+    r = make_batch_reader(jpeg_ds, num_epochs=None, shuffle_row_groups=False)
+    loader = JaxDataLoader(r, batch_size=4, fields=["idx"],
+                           transform_fn=lambda cols: 1 / 0)
+    with pytest.raises(ZeroDivisionError):
+        next(iter(loader))
+    deadline = time.monotonic() + 20
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert threading.active_count() <= before, "producer threads kept running"
