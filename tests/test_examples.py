@@ -72,3 +72,16 @@ def test_long_context_smoke(tmp_path):
     losses = train(url, steps=2, global_batch=4, seq_len=32, vocab=64,
                    heads=4, head_dim=8, data_par=2, strategy="ulysses")
     assert all(np.isfinite(v) for v in losses)
+
+
+def test_preemption_example_exact_resume(tmp_path):
+    from examples.preemption.train_with_preemption import (generate_dataset,
+                                                           train)
+
+    url = str(tmp_path / "ds")
+    generate_dataset(url, rows=1024)
+    seen_a, seen_b, loss = train(url, batch_size=16, preempt_at=2,
+                                 verbose=False)
+    assert seen_a + seen_b == 1024      # every row exactly once across runs
+    assert seen_b > 0                   # the preemption really cut mid-epoch
+    assert np.isfinite(loss)
