@@ -155,3 +155,27 @@ def test_tf_tensors_batched_shuffling_rejected(tf_dataset_url):
         with make_batch_reader(tf_dataset_url, num_epochs=1) as reader:
             with pytest.raises(PetastormTpuError, match="rowgroup batches"):
                 tf_tensors(reader, shuffling_queue_capacity=100)
+
+
+def test_tf_function_autograph_consumption(tf_dataset_url):
+    """The dataset feeds a @tf.function training step (graph-compiled
+    iteration, reference tests/test_tf_autograph.py): reductions over our
+    generator-backed dataset must trace and run."""
+    with make_reader(tf_dataset_url, reader_pool_type="serial",
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        ds = make_petastorm_dataset(reader).map(
+            lambda row: {"id": row.id, "vec": row.vec}).batch(5)
+
+        @tf.function
+        def epoch_sum(dataset):
+            total = tf.constant(0, tf.int64)
+            vec_sum = tf.zeros((3,), tf.float32)
+            for batch in dataset:
+                total += tf.reduce_sum(batch["id"])
+                vec_sum += tf.reduce_sum(batch["vec"], axis=0)
+            return total, vec_sum
+
+        total, vec_sum = epoch_sum(ds)
+    assert int(total) == sum(range(20))
+    np.testing.assert_allclose(vec_sum.numpy(), np.full(3, sum(range(20)),
+                                                        np.float32))
