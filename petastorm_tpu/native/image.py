@@ -266,7 +266,8 @@ def pack_coef_columns(name: str, column, field=None, nthreads: int = 1) -> dict:
 _MIXED_GEOMETRY_GUIDANCE = (
     "the device decode path requires every stored jpeg to share one geometry"
     " and subsampling (XLA compiles the on-chip decode per geometry);"
-    " re-encode the column uniformly or use decode_placement='host'")
+    " re-encode uniformly (petastorm-tpu-copy-dataset re-encodes jpeg fields,"
+    " see --jpeg-quality) or use decode_placement='host'")
 
 
 def _diagnose_coef_failure(column, exc) -> str:

@@ -84,6 +84,12 @@ def make_reader(dataset_url: str,
     Reference: ``make_reader`` (reader.py:59-176).  Yields one namedtuple row per
     ``next()``; for the TPU feed path prefer ``make_batch_reader`` +
     ``petastorm_tpu.jax`` (columnar, batched, device-sharded).
+
+    ``decode_placement={'field': 'device'}`` routes a jpeg field's FLOP-heavy
+    decode on-chip: the workers run only the entropy half and ship coefficient
+    planes, which ONLY ``petastorm_tpu.jax.JaxDataLoader`` can finish - row
+    iteration and the torch/tf adapters refuse such readers (they would see
+    planes, not pixels).  Requires uniform jpeg geometry across the dataset.
     """
     return _make_reader_impl(dataset_url, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,

@@ -28,12 +28,19 @@ def copy_dataset(source_url: str,
                  partitions_count: Optional[int] = None,
                  row_group_size_mb: Optional[float] = None,
                  rows_per_file: Optional[int] = None,
+                 jpeg_quality: Optional[int] = None,
                  storage_options: Optional[dict] = None) -> int:
     """Copy ``source_url`` -> ``target_url``; returns rows copied.
 
     ``field_regex``: keep only fields matching any regex (reference
     copy_dataset.py:44-49).  ``not_null_fields``: drop rows where any named
     field is null (copy_dataset.py:51-54).
+
+    The copy decodes through the source codecs and re-encodes through the
+    target schema's, so jpeg fields come out with ONE uniform geometry and
+    subsampling - the migration path for datasets whose mixed encoder
+    settings block ``decode_placement='device'``.  ``jpeg_quality`` overrides
+    the stored quality of every jpeg field in the target.
     """
     from petastorm_tpu.etl.writer import write_dataset
 
@@ -48,6 +55,8 @@ def copy_dataset(source_url: str,
                      predicate=predicate, shuffle_row_groups=False,
                      num_epochs=1, storage_options=storage_options) as reader:
         schema = reader.schema
+        if jpeg_quality is not None:
+            schema = _with_jpeg_quality(schema, jpeg_quality)
         count = 0
 
         def rows():
@@ -64,6 +73,22 @@ def copy_dataset(source_url: str,
                       mode="overwrite" if overwrite_output else "error")
     logger.info("Copied %d rows from %s to %s", count, source_url, target_url)
     return count
+
+
+def _with_jpeg_quality(schema, quality: int):
+    """Source schema with every jpeg CompressedImageCodec's quality replaced."""
+    import dataclasses
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.schema import Schema
+
+    fields = [
+        dataclasses.replace(f, codec=CompressedImageCodec("jpeg",
+                                                          quality=quality))
+        if isinstance(f.codec, CompressedImageCodec)
+        and f.codec.image_codec == "jpeg" else f
+        for f in schema]
+    return Schema(schema.name, fields)
 
 
 def _not_null_mask(col):
@@ -87,6 +112,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--overwrite", action="store_true")
     parser.add_argument("--row-group-size-mb", type=float, default=None)
     parser.add_argument("--rows-per-file", type=int, default=None)
+    parser.add_argument("--jpeg-quality", type=int, default=None,
+                        help="re-encode jpeg fields at this quality (the copy"
+                             " always re-encodes uniformly - use this to"
+                             " migrate mixed-geometry datasets for"
+                             " decode_placement='device')")
     return parser
 
 
@@ -98,7 +128,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                      not_null_fields=args.not_null_fields,
                      overwrite_output=args.overwrite,
                      row_group_size_mb=args.row_group_size_mb,
-                     rows_per_file=args.rows_per_file)
+                     rows_per_file=args.rows_per_file,
+                     jpeg_quality=args.jpeg_quality)
     print(f"copied {n} rows")
     return 0
 

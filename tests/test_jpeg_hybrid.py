@@ -509,3 +509,34 @@ def test_producer_error_winds_down_pipeline(jpeg_ds):
     while threading.active_count() > before and time.monotonic() < deadline:
         time.sleep(0.1)
     assert threading.active_count() <= before, "producer threads kept running"
+
+
+def test_copy_dataset_migrates_mixed_geometry_for_device_decode(tmp_path):
+    """The guided migration actually works: a mixed-subsampling dataset that
+    the device path refuses reads fine after petastorm-tpu-copy-dataset
+    re-encodes it (uniform geometry), matching the original pixels."""
+    s444 = getattr(cv2, "IMWRITE_JPEG_SAMPLING_FACTOR_444", None)
+    if s444 is None:
+        pytest.skip("cv2 build lacks sampling-factor control")
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+
+    bufs = ([_encode(_smooth_rgb(64, 96, seed=i)) for i in range(4)]
+            + [_encode(_smooth_rgb(64, 96, seed=i), sampling=s444)
+               for i in range(4, 8)])
+    src = _write_raw_jpeg_ds(tmp_path, bufs, rows_per_group=4)
+    dst = str(tmp_path / "uniform_ds")
+    assert copy_dataset(src, dst, jpeg_quality=95) == 8
+
+    with make_batch_reader(dst, shuffle_row_groups=False, num_epochs=1,
+                           decode_placement={"image": "device"}) as r:
+        with JaxDataLoader(r, batch_size=8, fields=["idx", "image"]) as loader:
+            b = next(iter(loader))
+    imgs, idxs = np.asarray(b["image"]), np.asarray(b["idx"])
+    assert imgs.shape == (8, 64, 96, 3)
+    by_idx = {int(i): imgs[k] for k, i in enumerate(idxs)}
+    for i in range(8):
+        want = _smooth_rgb(64, 96, seed=i)
+        # two lossy hops (original jpeg + re-encode at q95): still close
+        assert np.abs(by_idx[i].astype(int) - want.astype(int)).mean() < 3.0
