@@ -29,6 +29,7 @@ def copy_dataset(source_url: str,
                  row_group_size_mb: Optional[float] = None,
                  rows_per_file: Optional[int] = None,
                  jpeg_quality: Optional[int] = None,
+                 encode_workers: int = 1,
                  storage_options: Optional[dict] = None) -> int:
     """Copy ``source_url`` -> ``target_url``; returns rows copied.
 
@@ -70,6 +71,7 @@ def copy_dataset(source_url: str,
                       row_group_size_mb=row_group_size_mb,
                       rows_per_file=rows_per_file,
                       storage_options=storage_options,
+                      encode_workers=encode_workers,
                       mode="overwrite" if overwrite_output else "error")
     logger.info("Copied %d rows from %s to %s", count, source_url, target_url)
     return count
@@ -117,6 +119,9 @@ def build_parser() -> argparse.ArgumentParser:
                              " always re-encodes uniformly - use this to"
                              " migrate mixed-geometry datasets for"
                              " decode_placement='device')")
+    parser.add_argument("--encode-workers", type=int, default=1,
+                        help="parallelize the re-encode across N threads"
+                             " (jpeg/png encoding releases the GIL)")
     return parser
 
 
@@ -129,7 +134,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                      overwrite_output=args.overwrite,
                      row_group_size_mb=args.row_group_size_mb,
                      rows_per_file=args.rows_per_file,
-                     jpeg_quality=args.jpeg_quality)
+                     jpeg_quality=args.jpeg_quality,
+                     encode_workers=args.encode_workers)
     print(f"copied {n} rows")
     return 0
 

@@ -85,7 +85,8 @@ class RowGroupDecoderWorker:
                 if len(open_files) >= _MAX_OPEN_FILES:
                     oldest = next(iter(open_files))
                     open_files.pop(oldest)[0].close()
-                if isinstance(fs, pafs.LocalFileSystem):
+                local = isinstance(fs, pafs.LocalFileSystem)
+                if local:
                     # memory-map local files: rowgroup reads skip a buffered
                     # copy (~30% faster on image-sized groups); arrow buffers
                     # hold a reference to the map, and a deleted-under-us file
@@ -93,7 +94,10 @@ class RowGroupDecoderWorker:
                     source = pa.memory_map(path)
                 else:
                     source = fs.open_input_file(path)
-                pf = pq.ParquetFile(source,
+                # remote stores: pre_buffer coalesces a rowgroup's column
+                # chunks into few large ranged reads issued up front, hiding
+                # per-request object-store latency (useless over mmap)
+                pf = pq.ParquetFile(source, pre_buffer=not local,
                                     page_checksum_verification=self._verify_checksums)
                 entry = (pf, set(pf.schema_arrow.names))
                 open_files[path] = entry
