@@ -204,6 +204,7 @@ def write_dataset(url: str,
 
         encode_pool = ThreadPoolExecutor(max_workers=encode_workers,
                                          thread_name_prefix="pst-encode")
+    failed = False
     try:
         for r in rows:
             for k in partition_by:
@@ -216,9 +217,23 @@ def write_dataset(url: str,
                 _flush(pv, final=False)
         for pv in list(pending):
             _flush(pv, final=True)
+    except BaseException:
+        failed = True
+        raise
     finally:
         if encode_pool is not None:
             encode_pool.shutdown(wait=True)
+        if failed:
+            # best-effort close so output streams/multipart uploads are not
+            # leaked when encoding or the caller's row generator raised (the
+            # happy path closes below, where a footer-write failure must
+            # still raise loudly)
+            for w in writers.values():
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001 - already failing
+                    logger.warning("could not close parquet writer after"
+                                   " failed write", exc_info=True)
 
     for w in writers.values():
         w.close()

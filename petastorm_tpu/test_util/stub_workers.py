@@ -52,3 +52,20 @@ class PidWorker:
 
     def __call__(self):
         return lambda _x: os.getpid()
+
+
+class HardCrashWorker:
+    """Simulates an OOM-kill/segfault: the worker PROCESS dies without a
+    traceback (os._exit bypasses exception handling entirely)."""
+
+    def __init__(self, trigger=7):
+        self.trigger = trigger
+
+    def __call__(self):
+        trigger = self.trigger
+
+        def fn(x):
+            if x == trigger:
+                os._exit(17)
+            return x
+        return fn

@@ -361,3 +361,40 @@ def test_parallel_encode_writes_identical_dataset(tmp_path):
     for x, y in zip(ra, rb):
         np.testing.assert_array_equal(x.img, y.img)
         np.testing.assert_array_equal(x.vec, y.vec)
+
+
+def test_write_failure_closes_open_writers(tmp_path):
+    """A mid-stream encode failure must not leak open parquet writers (their
+    output streams would hold unfinalized uploads on object stores)."""
+    import gc
+
+    import numpy as np
+
+    from petastorm_tpu.errors import SchemaError
+    from petastorm_tpu.etl import writer as writer_mod
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.schema import Field, Schema
+
+    closed = []
+    orig_writer = writer_mod.pq.ParquetWriter
+
+    class TrackingWriter(orig_writer):
+        def close(self):
+            closed.append(True)
+            return super().close()
+
+    writer_mod.pq.ParquetWriter = TrackingWriter
+    try:
+        schema = Schema("F", [Field("id", np.int64)])
+
+        def rows():
+            yield {"id": 0}
+            yield {"id": "not-an-int"}  # encode fails mid-stream
+
+        with pytest.raises(Exception):
+            write_dataset(str(tmp_path / "ds"), schema, rows(),
+                          row_group_size_rows=1)
+    finally:
+        writer_mod.pq.ParquetWriter = orig_writer
+    gc.collect()
+    assert closed, "no writer was closed on the failure path"

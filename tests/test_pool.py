@@ -147,3 +147,21 @@ def test_ventilator_backpressure():
         assert ex.diagnostics["ventilated"] <= 6
         _collect(ex, 50)
         vent.join()
+
+
+def test_process_pool_hard_crash_surfaces_not_hangs():
+    """A worker process dying WITHOUT a traceback (OOM-kill, segfault) must
+    surface as a WorkerError at the consumer, not an indefinite hang
+    (reference has no coverage for this; its zmq pool would wait forever)."""
+    from petastorm_tpu.test_util.stub_workers import HardCrashWorker
+
+    ex = make_executor("process", workers_count=2)
+    try:
+        ex.start(HardCrashWorker(trigger=7))
+        for _ in range(4):   # both workers eventually eat a poison item
+            ex.put(7)
+        with pytest.raises(WorkerError, match="died"):
+            _collect(ex, 4, timeout=60)
+    finally:
+        ex.stop()
+        ex.join()
