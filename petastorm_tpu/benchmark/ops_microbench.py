@@ -78,6 +78,30 @@ def main() -> int:
     print(json.dumps({"metric": "flip_plus_normalize_ms_per_256imgs",
                       "value": round(t_aug, 3)}), flush=True)
 
+    import itertools
+
+    from petastorm_tpu.ops import random_resized_crop
+
+    big = jax.device_put(np.random.randint(0, 255, (B, 256, 256, C),
+                                           dtype=np.uint8))
+    jax.block_until_ready(big)
+    ctr = itertools.count()
+
+    def _k():
+        return jax.random.fold_in(key, next(ctr))
+
+    t_rrc = _timeit(lambda: random_resized_crop(big, _k(), (224, 224)))
+    t_rrc_aa = _timeit(lambda: random_resized_crop(
+        big, _k(), (224, 224), antialias=True), n=5)
+    t_full = _timeit(lambda: normalize_images(
+        random_flip(random_resized_crop(big, _k(), (224, 224)), _k()),
+        mean, std))
+    print(json.dumps({"metric": "random_resized_crop_ms_per_256imgs_256to224",
+                      "value": round(t_rrc, 3),
+                      "antialiased": round(t_rrc_aa, 2),
+                      "crop_flip_normalize_chain": round(t_full, 3)}),
+          flush=True)
+
     try:
         import cv2
         import pyarrow as pa

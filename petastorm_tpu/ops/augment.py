@@ -55,3 +55,94 @@ def random_crop_flip(images: jax.Array, key: jax.Array,
     if crop_hw is not None:
         images = random_crop(images, k1, crop_hw)
     return random_flip(images, k2)
+
+
+def _restore_dtype(out: jax.Array, src_dtype) -> jax.Array:
+    """float32 resample result -> the source dtype (round+clip for ints)."""
+    if jnp.issubdtype(src_dtype, jnp.integer):
+        info = jnp.iinfo(src_dtype)
+        return jnp.clip(jnp.round(out), info.min, info.max).astype(src_dtype)
+    return out.astype(src_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_hw", "method", "antialias"))
+def resize_images(images: jax.Array, out_hw: Tuple[int, int],
+                  method: str = "bilinear", antialias: bool = True) -> jax.Array:
+    """Batched on-chip resize of (N, H, W, C) to (N, oh, ow, C).
+
+    Antialiased by default (``jax.image.resize`` semantics) - the scale here
+    is STATIC, so XLA specializes the filter support and the cost stays
+    small; uint8 inputs round-trip through float32 and come back uint8,
+    float inputs keep their dtype.
+    """
+    n, _, _, c = images.shape
+    oh, ow = out_hw
+    x = images.astype(jnp.float32)
+    out = jax.image.resize(x, (n, oh, ow, c), method=method,
+                           antialias=antialias)
+    return _restore_dtype(out, images.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("out_hw", "scale", "ratio", "method",
+                                    "antialias"))
+def random_resized_crop(images: jax.Array, key: jax.Array,
+                        out_hw: Tuple[int, int],
+                        scale: Tuple[float, float] = (0.08, 1.0),
+                        ratio: Tuple[float, float] = (3.0 / 4.0, 4.0 / 3.0),
+                        method: str = "bilinear",
+                        antialias: bool = False) -> jax.Array:
+    """torchvision-style RandomResizedCrop, fully on-chip and batched.
+
+    Per image: sample a crop area fraction in ``scale`` and an aspect ratio
+    log-uniform in ``ratio``, place the crop uniformly, and resize it to
+    ``out_hw``.  Crop geometry varies per image but every shape is STATIC:
+    the variable box becomes per-image scale/translation scalars fed to
+    ``jax.lax`` scale-and-translate under ``vmap``, so XLA compiles one
+    kernel for the whole batch (no dynamic shapes, no host round-trip).
+    uint8 in -> uint8 out.
+
+    ``antialias`` defaults OFF - plain bilinear sampling is the classic
+    ImageNet-training behavior (torchvision pre-v2).  For this op's
+    per-image traced scales the antialiased form measures near-parity on a
+    v5e chip (0.7 vs 0.4 ms per 256-image batch; see
+    benchmark/ops_microbench.py), so turning it on for torchvision-v2
+    quality parity is fine.  Beware hand-rolled variants whose crop scale
+    constant-folds at trace time: one such configuration measured 149 ms for
+    the same batch - keep the scale a traced value if you fork this.
+    """
+    n, h, w, c = images.shape
+    oh, ow = out_hw
+    k_area, k_ratio, k_y, k_x = jax.random.split(key, 4)
+    area_frac = jax.random.uniform(k_area, (n,), minval=scale[0],
+                                   maxval=scale[1])
+    log_r = jax.random.uniform(k_ratio, (n,),
+                               minval=jnp.log(ratio[0]),
+                               maxval=jnp.log(ratio[1]))
+    r = jnp.exp(log_r)
+    area = area_frac * (h * w)
+    crop_w = jnp.sqrt(area * r)
+    crop_h = jnp.sqrt(area / r)
+    # clamp to the image (torchvision retries then falls back to center;
+    # clamping keeps everything branch-free and on-chip)
+    crop_w = jnp.clip(crop_w, 1.0, float(w))
+    crop_h = jnp.clip(crop_h, 1.0, float(h))
+    y0 = jax.random.uniform(k_y, (n,)) * (h - crop_h)
+    x0 = jax.random.uniform(k_x, (n,)) * (w - crop_w)
+
+    src_dtype = images.dtype
+    x = images.astype(jnp.float32)
+
+    def one(img, ch, cw, yy, xx):
+        # map the crop box onto the (oh, ow) output grid: out = scale*in + t,
+        # with translation chosen so in-coordinate y0 lands at out 0
+        sy = oh / ch
+        sx = ow / cw
+        return jax.image.scale_and_translate(
+            img, (oh, ow, c), (0, 1),
+            jnp.stack([sy, sx]),
+            jnp.stack([-yy * sy, -xx * sx]),
+            method=method, antialias=antialias)
+
+    out = jax.vmap(one)(x, crop_h, crop_w, y0, x0)
+    return _restore_dtype(out, src_dtype)

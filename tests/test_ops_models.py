@@ -138,3 +138,69 @@ def test_random_crop_flip_augmentation():
 
     with pytest.raises(ValueError, match="larger than"):
         random_crop(imgs, key, (20, 6))
+
+
+# -- resize / random-resized-crop (on-chip ImageNet preprocessing) ------------
+
+
+def test_resize_images_uint8_roundtrip_and_identity():
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import resize_images
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, (3, 32, 48, 3), dtype=np.uint8)
+    out = resize_images(jnp.asarray(imgs), (16, 24))
+    assert out.shape == (3, 16, 24, 3) and out.dtype == jnp.uint8
+    # same-size resize is (near-)identity
+    same = np.asarray(resize_images(jnp.asarray(imgs), (32, 48)))
+    assert np.abs(same.astype(int) - imgs.astype(int)).max() <= 1
+    # constant image stays constant through antialiased resampling
+    flat = np.full((1, 32, 48, 3), 77, np.uint8)
+    out_flat = np.asarray(resize_images(jnp.asarray(flat), (20, 20)))
+    assert np.abs(out_flat.astype(int) - 77).max() <= 1
+
+
+def test_random_resized_crop_static_shapes_and_determinism():
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import random_resized_crop
+
+    rng = np.random.default_rng(1)
+    imgs = jnp.asarray(rng.integers(0, 255, (4, 40, 56, 3), dtype=np.uint8))
+    key = jax.random.PRNGKey(3)
+    a = random_resized_crop(imgs, key, (24, 24))
+    assert a.shape == (4, 24, 24, 3) and a.dtype == jnp.uint8
+    b = random_resized_crop(imgs, key, (24, 24))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same key
+    c = random_resized_crop(imgs, jax.random.PRNGKey(4), (24, 24))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))      # new key
+
+
+def test_random_resized_crop_full_scale_equals_resize():
+    """scale=(1,1), ratio=(1,1) on a square image pins the crop to the whole
+    frame: the op must agree with a plain antialiased resize."""
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import random_resized_crop, resize_images
+
+    rng = np.random.default_rng(2)
+    imgs = jnp.asarray(rng.integers(0, 255, (2, 32, 32, 3), dtype=np.uint8))
+    got = np.asarray(random_resized_crop(imgs, jax.random.PRNGKey(0), (16, 16),
+                                         scale=(1.0, 1.0), ratio=(1.0, 1.0),
+                                         antialias=True))
+    want = np.asarray(resize_images(imgs, (16, 16)))
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+
+def test_random_resized_crop_constant_image_constant_output():
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import random_resized_crop
+
+    flat = jnp.full((3, 48, 48, 3), 130, jnp.uint8)
+    out = np.asarray(random_resized_crop(flat, jax.random.PRNGKey(9), (20, 20)))
+    assert np.abs(out.astype(int) - 130).max() <= 1
