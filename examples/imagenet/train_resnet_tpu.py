@@ -25,7 +25,8 @@ from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
 from petastorm_tpu.etl.writer import write_dataset
 from petastorm_tpu.jax import JaxDataLoader
 from petastorm_tpu.models import ResNet50
-from petastorm_tpu.ops import normalize_images, random_flip
+from petastorm_tpu.ops import (normalize_images, random_flip,
+                               random_resized_crop)
 from petastorm_tpu.reader import make_reader
 from petastorm_tpu.schema import Field, Schema
 
@@ -66,7 +67,13 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
     @jax.jit
     def train_step(params, opt_state, image_u8, label, key):
         def loss_fn(p):
-            imgs = random_flip(image_u8, key)   # on-chip augmentation
+            k1, k2 = jax.random.split(key)
+            # the full ImageNet train transform, ON-CHIP: per-image
+            # RandomResizedCrop (scale/ratio sampling, one static-shape
+            # kernel), flip, then uint8 -> bf16 normalize - host workers
+            # stay decode-only
+            imgs = random_resized_crop(image_u8, k1, (side, side))
+            imgs = random_flip(imgs, k2)
             x = normalize_images(imgs)          # on-chip uint8 -> bf16 + scale
             logits = model.apply(p, x)
             onehot = jax.nn.one_hot(label, num_classes)
