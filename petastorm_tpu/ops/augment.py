@@ -57,6 +57,58 @@ def random_crop_flip(images: jax.Array, key: jax.Array,
     return random_flip(images, k2)
 
 
+@functools.partial(jax.jit, static_argnames=("alpha",))
+def mixup(images: jax.Array, labels: jax.Array, key: jax.Array,
+          alpha: float = 0.2):
+    """Batch mixup (Zhang et al. 2017), on-chip: blend each image with a
+    permuted partner using one Beta(alpha, alpha) lambda per batch.
+
+    Returns ``(mixed_images, labels, permuted_labels, lam)``; compute the
+    loss as ``lam * ce(logits, labels) + (1 - lam) * ce(logits,
+    permuted_labels)``.  uint8 images mix in float32 and come back uint8;
+    float images keep their dtype.
+    """
+    k_lam, k_perm = jax.random.split(key)
+    lam = jax.random.beta(k_lam, alpha, alpha)
+    lam = jnp.maximum(lam, 1.0 - lam)  # keep the dominant image first
+    perm = jax.random.permutation(k_perm, images.shape[0])
+    x = images.astype(jnp.float32)
+    mixed = lam * x + (1.0 - lam) * x[perm]
+    return _restore_dtype(mixed, images.dtype), labels, labels[perm], lam
+
+
+@functools.partial(jax.jit, static_argnames=("alpha",))
+def cutmix(images: jax.Array, labels: jax.Array, key: jax.Array,
+           alpha: float = 1.0):
+    """Batch CutMix (Yun et al. 2019), on-chip: paste one random box from a
+    permuted partner into every image; one box per batch (the paper's
+    formulation), so the patch becomes a static-shape masked blend.
+
+    Returns ``(mixed_images, labels, permuted_labels, lam)`` with ``lam``
+    the kept-area fraction, recomputed from the actual box.  Dtype is
+    preserved exactly (pure selection, no resampling).
+    """
+    n, h, w, _ = images.shape
+    k_lam, k_perm, k_y, k_x = jax.random.split(key, 4)
+    lam0 = jax.random.beta(k_lam, alpha, alpha)
+    cut = jnp.sqrt(1.0 - lam0)
+    bh = (cut * h).astype(jnp.int32)
+    bw = (cut * w).astype(jnp.int32)
+    cy = jax.random.randint(k_y, (), 0, h)
+    cx = jax.random.randint(k_x, (), 0, w)
+    y0 = jnp.clip(cy - bh // 2, 0, h)
+    y1 = jnp.clip(cy + bh // 2, 0, h)
+    x0 = jnp.clip(cx - bw // 2, 0, w)
+    x1 = jnp.clip(cx + bw // 2, 0, w)
+    rows = jnp.arange(h)[None, :, None, None]
+    cols = jnp.arange(w)[None, None, :, None]
+    in_box = ((rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1))
+    perm = jax.random.permutation(k_perm, n)
+    mixed = jnp.where(in_box, images[perm], images)
+    lam = 1.0 - ((y1 - y0) * (x1 - x0)) / (h * w)
+    return mixed, labels, labels[perm], lam
+
+
 def _restore_dtype(out: jax.Array, src_dtype) -> jax.Array:
     """float32 resample result -> the source dtype (round+clip for ints)."""
     if jnp.issubdtype(src_dtype, jnp.integer):

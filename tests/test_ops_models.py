@@ -204,3 +204,69 @@ def test_random_resized_crop_constant_image_constant_output():
     flat = jnp.full((3, 48, 48, 3), 130, jnp.uint8)
     out = np.asarray(random_resized_crop(flat, jax.random.PRNGKey(9), (20, 20)))
     assert np.abs(out.astype(int) - 130).max() <= 1
+
+
+def test_mixup_blend_and_label_pairing():
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import mixup
+
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.standard_normal((8, 8, 8, 3)).astype(np.float32))
+    labels = jnp.arange(8)
+    key = jax.random.PRNGKey(1)
+    mixed, la, lb, lam = mixup(imgs, labels, key, alpha=0.4)
+    lam_f = float(lam)
+    assert 0.5 <= lam_f <= 1.0  # dominant-first convention
+    # la is the original labels; lb is a permutation of them
+    np.testing.assert_array_equal(np.asarray(la), np.arange(8))
+    assert sorted(np.asarray(lb).tolist()) == list(range(8))
+    # the blend is exactly lam*a + (1-lam)*b for the paired images
+    b_idx = np.asarray(lb)  # the permutation used
+    want = lam_f * np.asarray(imgs) + (1 - lam_f) * np.asarray(imgs)[b_idx]
+    np.testing.assert_allclose(np.asarray(mixed), want, rtol=1e-5, atol=1e-5)
+    # deterministic per key
+    mixed2, _, _, _ = mixup(imgs, labels, key, alpha=0.4)
+    np.testing.assert_array_equal(np.asarray(mixed), np.asarray(mixed2))
+
+
+def test_mixup_uint8_roundtrip():
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import mixup
+
+    imgs = jnp.asarray(np.random.default_rng(1).integers(
+        0, 255, (4, 6, 6, 3), dtype=np.uint8))
+    mixed, _, _, _ = mixup(imgs, jnp.arange(4), jax.random.PRNGKey(0))
+    assert mixed.dtype == jnp.uint8 and mixed.shape == imgs.shape
+
+
+def test_cutmix_box_area_matches_lambda():
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.ops import cutmix
+
+    n, h, w = 6, 32, 32
+    imgs = jnp.asarray(np.random.default_rng(2).integers(
+        0, 255, (n, h, w, 3), dtype=np.uint8))
+    labels = jnp.arange(n)
+    mixed, la, lb, lam = cutmix(imgs, labels, jax.random.PRNGKey(7))
+    assert mixed.dtype == jnp.uint8
+    perm = np.asarray(lb)
+    src, dst = np.asarray(imgs), np.asarray(mixed)
+    # count pixels equal to the partner but not to self (unambiguous on
+    # random uint8 content): that fraction is the pasted box = 1 - lam.
+    # Fixed points of the permutation (partner IS self) carry no signal -
+    # exclude those images from the measurement entirely.
+    moved = perm != np.arange(n)
+    assert moved.any()
+    partner = src[perm]
+    in_box = ((dst == partner).all(axis=-1)
+              & ~(partner == src).all(axis=-1))[moved]
+    frac = in_box.sum() / in_box.size
+    assert abs((1 - float(lam)) - frac) < 0.05
+    np.testing.assert_array_equal(np.asarray(la), np.arange(n))
+    assert sorted(perm.tolist()) == list(range(n))
