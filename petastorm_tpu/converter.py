@@ -173,6 +173,56 @@ def _spark_fingerprint(df, params: Dict) -> str:
     return digest.hexdigest()[:20]
 
 
+def _publish_dir(fs: pafs.FileSystem, tmp_root: str, root: str) -> None:
+    """Atomically publish ``tmp_root`` at ``root``.  A lost rename race -
+    another process published the same fingerprinted content first - is
+    benign: keep theirs, drop ours.  The race is recognized by the OUTCOME
+    (a dataset with parquet data now exists at ``root``), not the exception
+    type, because filesystems surface the collision differently (OSError,
+    ArrowInvalid, backend-specific errors).  A bare debris directory at
+    ``root`` does NOT count as a winner: deleting our complete tmp output in
+    its favor would silently yield an empty dataset."""
+    def _parquet_count(path: str) -> int:
+        try:
+            return sum(1 for i in fs.get_file_info(pafs.FileSelector(path))
+                       if i.type == pafs.FileType.File
+                       and i.path.endswith(".parquet"))
+        except (OSError, FileNotFoundError):
+            return 0
+
+    ours = _parquet_count(tmp_root)
+    try:
+        fs.move(tmp_root, root)
+    except Exception:  # noqa: BLE001 - re-raised unless the race is confirmed
+        # the winner must look at least as complete as what we tried to
+        # publish: on filesystems where move is per-file copy+delete, OUR
+        # OWN failed half-move must not read as a winning peer (deleting
+        # tmp_root would then destroy the only complete copy)
+        won = (fs.get_file_info(root).type == pafs.FileType.Directory
+               and _parquet_count(root) >= max(ours, 1))
+        if not won:
+            raise
+        logger.info("Lost publish race for %s; keeping the winner", root)
+        fs.delete_dir(tmp_root)
+
+
+def _move_debris_aside(fs: pafs.FileSystem, root: str, ds_url: str) -> None:
+    """A directory with no published parquet sits at the cache target
+    (crashed pre-atomic-rename writer, or foreign files): move it ASIDE
+    rather than deleting in place, so a concurrent atomic publish landing in
+    the remaining window is taken out of the way (and re-materialized from
+    the same fingerprinted content) instead of destroyed."""
+    logger.warning("Clearing incomplete materialization at %s", ds_url)
+    aside = posixpath.join(posixpath.dirname(root),
+                           f".stale-{posixpath.basename(root)}"
+                           f"-{uuid.uuid4().hex[:8]}")
+    try:
+        fs.move(root, aside)
+        fs.delete_dir(aside)
+    except FileNotFoundError:
+        pass  # another process cleared it first
+
+
 def _materialize_spark_df(df, ds_url: str, cache_dir_url: str,
                           fs: pafs.FileSystem, root: str,
                           compression_codec: str,
@@ -197,11 +247,7 @@ def _materialize_spark_df(df, ds_url: str, cache_dir_url: str,
         fs.delete_dir(tmp_root)
         raise PetastormTpuError(
             f"Spark wrote no parquet files for {ds_url!r} (empty DataFrame?)")
-    try:
-        fs.move(tmp_root, root)
-    except OSError:
-        # lost the race: another process published the same plan first
-        fs.delete_dir(tmp_root)
+    _publish_dir(fs, tmp_root, root)
 
 
 def _share_live_handle(ds_url: str, delete_at_exit: bool):
@@ -265,12 +311,17 @@ def _make_spark_converter(df, cache_dir_url: str, *, dtype, compression_codec,
         return [i.path for i in entries if i.path.endswith(".parquet")]
 
     files = _published_files()
+    if not files and fs.get_file_info(root).type == pafs.FileType.Directory:
+        # a concurrent converter of the same plan may have published (atomic
+        # rename) between the check above and now - re-check before touching
+        # the directory, then move it ASIDE rather than deleting: if a publish
+        # still lands in the remaining window, the move takes the complete
+        # dataset out of the way (and we re-materialize the identical plan)
+        # instead of destroying it
+        files = _published_files()
+        if not files:
+            _move_debris_aside(fs, root, ds_url)
     if not files:
-        if fs.get_file_info(root).type == pafs.FileType.Directory:
-            # leftovers of a crashed pre-atomic-rename writer (or a foreign
-            # dir): clear so the fresh rename below can land
-            logger.warning("Clearing incomplete materialization at %s", ds_url)
-            fs.delete_dir(root)
         _materialize_spark_df(df, ds_url, cache_dir_url, fs, root,
                               compression_codec, row_group_size_mb)
         files = _published_files()
@@ -570,6 +621,7 @@ def make_converter(data,
                                     storage_options=storage_options)
             _register_converter(conv, delete_at_exit)
             return conv
+        _move_debris_aside(fs, root, ds_url)
 
     # write to a temp dir then rename: concurrent converters of the same
     # content race benignly (one rename wins, both see a complete dataset)
@@ -587,11 +639,7 @@ def make_converter(data,
     pq.write_table(stamped, data_path, filesystem=fs,
                    row_group_size=rows_per_group,
                    compression=compression_codec)
-    try:
-        fs.move(tmp_root, root)
-    except OSError:
-        # lost the race: another process published the same content first
-        fs.delete_dir(tmp_root)
+    _publish_dir(fs, tmp_root, root)
     stamp_dataset_metadata(ds_url, schema, storage_options=storage_options)
     files = [i.path for i in fs.get_file_info(pafs.FileSelector(root))
              if i.type == pafs.FileType.File and i.path.endswith(".parquet")]

@@ -167,6 +167,14 @@ def write_dataset(url: str,
             rows_written[key] = 0
         return writers[key]
 
+    def _delete_files_best_effort(fs_, paths):
+        for path in paths:
+            try:
+                fs_.delete_file(path)
+            except Exception:  # noqa: BLE001 - already failing
+                logger.warning("could not delete partial file %s after failed"
+                               " write", path, exc_info=True)
+
     _ESTIMATE_CHUNK = 1024  # rows encoded to estimate bytes/row for MB-based sizing
     pending: Dict[tuple, List[dict]] = {}
 
@@ -234,9 +242,20 @@ def write_dataset(url: str,
                 except Exception:  # noqa: BLE001 - already failing
                     logger.warning("could not close parquet writer after"
                                    " failed write", exc_info=True)
+            # close() wrote footers, so the debris now parses as VALID parquet
+            # that a later mode='append' run or metadata stamp would silently
+            # adopt as complete data - delete what this failed call produced
+            _delete_files_best_effort(fs, files)
 
-    for w in writers.values():
-        w.close()
+    try:
+        for w in writers.values():
+            w.close()
+    except BaseException:
+        # a footer flush failed (ENOSPC, upload error): earlier writers in
+        # this loop closed fine, so their files parse as complete parquet -
+        # the whole call failed, none of its output may survive to be adopted
+        _delete_files_best_effort(fs, files)
+        raise
     if not files:
         logger.warning("write_dataset(%s): no rows were written; dataset left empty",
                        url)

@@ -207,6 +207,12 @@ class JaxDataLoader:
         #: for the loader's lifetime, rebuilt per batch otherwise
         self._placement_cache: Dict[Tuple[str, Tuple[int, ...]],
                                     Tuple[NamedSharding, Tuple[slice, ...]]] = {}
+        #: (trailing shape, dtype) each field was LAST emitted with
+        #: (post-transform_fn, post-promotion, post-bucket-pad) - drain
+        #: alignment pads must match the last emitted batch (the same
+        #: semantics as the template path, which pads from the last drained
+        #: batch), not the schema
+        self._emitted_layout: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {}
 
     # -- shape/sharding bookkeeping ------------------------------------------
 
@@ -385,6 +391,7 @@ class JaxDataLoader:
             feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
             if arr.dtype != feed_dtype:
                 arr = arr.astype(feed_dtype)
+            self._emitted_layout[name] = (arr.shape[1:], arr.dtype)
             if self._mesh is not None:
                 sharding, sl, global_shape = self._placement_for(name, arr.shape[1:])
                 arr = arr[(slice(None),) + sl[1:]]  # sequence/model-axis slice
@@ -638,37 +645,108 @@ class JaxDataLoader:
         else:
             target = int(max(all_gather_counts(len(local))))
 
+        def _zero_array(global_shape, sharding, dtype):
+            # zeros with the SAME global shape and sharding so collectives in
+            # the consumer's step see identically laid-out operands; allocate
+            # only shard-sized zeros (a global-shape buffer per shard would
+            # spike host memory exactly at preemption time)
+            shard_shape = sharding.shard_shape(global_shape)
+            return jax.make_array_from_callback(
+                global_shape, sharding,
+                lambda idx, _s=shard_shape, _d=dtype: np.zeros(_s, _d))
+
         def _aligned():
             template = local[-1] if local else None
+            synthesized = None
             for batch in local:
                 yield batch
             for _ in range(target - len(local)):
-                if template is None:
-                    raise PetastormTpuError(
-                        "drain() alignment needs at least one delivered batch"
-                        " on this host to shape the padding; this host drained"
-                        " zero batches while a peer drained some - checkpoint"
-                        " at a step boundary instead")
                 pad = {}
-                for name, value in template.items():
-                    if name == "_valid_rows":
-                        continue
-                    if isinstance(value, jax.Array):
-                        # zeros with the SAME global shape and sharding so
-                        # collectives in the consumer's step see identically
-                        # laid-out operands; allocate only shard-sized zeros
-                        # (a global-shape buffer per shard would spike host
-                        # memory exactly at preemption time)
-                        shard_shape = value.sharding.shard_shape(value.shape)
-                        pad[name] = jax.make_array_from_callback(
-                            value.shape, value.sharding,
-                            lambda idx, _s=shard_shape, _d=value.dtype:
-                                np.zeros(_s, _d))
-                    else:
-                        pad[name] = value  # host fields pass through
+                if template is None and synthesized is None:
+                    # derived lazily: when target == 0 no pad is needed and
+                    # fields whose shapes cannot be derived must not raise
+                    synthesized = self._pad_batch_layout()
+                if template is not None:
+                    for name, value in template.items():
+                        if name == "_valid_rows":
+                            continue
+                        if isinstance(value, jax.Array):
+                            pad[name] = _zero_array(value.shape, value.sharding,
+                                                    value.dtype)
+                        else:
+                            pad[name] = value  # host fields pass through
+                else:
+                    # this host drained ZERO batches while a peer drained some:
+                    # synthesize the padding from the schema/placement layout
+                    # so this host still steps in lockstep with its peers
+                    # instead of raising after the allgather (which would hang
+                    # the pod mid-collective - the exact failure drain()
+                    # exists to prevent)
+                    for name, (shape, sharding, dtype) in synthesized.items():
+                        if sharding is not None:
+                            pad[name] = _zero_array(shape, sharding, dtype)
+                        else:
+                            pad[name] = np.zeros(shape, dtype)  # host field
                 pad["_valid_rows"] = 0
                 yield pad
         return _aligned()
+
+    def _pad_batch_layout(self) -> Dict:
+        """field -> (global shape, sharding | None, dtype) for synthesizing
+        drain-alignment pad batches when this host delivered no batch to use
+        as a template.  The placement cache (populated per emitted batch) wins
+        because it reflects ``transform_fn`` output shapes; otherwise shapes
+        come from the schema (fixed shapes, single-bucket pad targets, device
+        decode geometry)."""
+        layout: Dict[str, Tuple] = {}
+        # when batches WERE emitted, their staged field set is the pytree the
+        # peers' steps expect - a transform_fn may have added or dropped
+        # fields relative to self._fields
+        staged = (list(self._emitted_layout)
+                  + [n for n in self._device_decode if n in self._fields]
+                  if self._emitted_layout else list(self._fields))
+        for name in staged:
+            field = self._schema[name] if name in self._schema else None
+            emitted = self._emitted_layout.get(name)
+            if emitted is not None:
+                # last-emitted layout, not the schema's: a transform_fn may
+                # have changed the dtype (uint8 image -> float32) and
+                # multi-bucket pad_shapes make the trailing shape per-batch;
+                # peers pad from their LAST drained batch, so mirroring the
+                # last emitted batch here is the same semantics
+                trailing, dtype = emitted
+                sharding, _ = self._placement_cache[(name, trailing)]
+            elif name in self._device_decode:
+                trailing = tuple(field.shape)
+                sharding = NamedSharding(self._mesh, self._spec_for(name))
+                dtype = np.uint8
+            else:
+                if self._transform_fn is not None:
+                    raise PetastormTpuError(
+                        "drain() alignment on a zero-batch host cannot derive"
+                        f" the padded shape of field {name!r}: a transform_fn"
+                        " is set and no batch was ever emitted here to learn"
+                        " its output shape - checkpoint at a step boundary"
+                        " instead")
+                buckets = self._pad_shapes.get(name)
+                if buckets and len(buckets) > 1:
+                    raise PetastormTpuError(
+                        "drain() alignment on a zero-batch host cannot pick a"
+                        f" pad bucket for field {name!r} (multi-bucket"
+                        " pad_shapes): peers pad from their own last batch's"
+                        " bucket, so a guess here could silently diverge the"
+                        " pod's global shapes - checkpoint at a step boundary"
+                        " instead")
+                trailing = tuple(buckets[0]) if buckets else tuple(field.shape)
+                sharding = NamedSharding(self._mesh, self._spec_for(name))
+                dtype = jax_feed_dtype(field.dtype, keep_wide=self._keep_wide)
+            layout[name] = ((self._global_batch,) + trailing, sharding, dtype)
+        for name in self._host_fields:
+            field = self._schema[name]
+            shape = tuple(d if d is not None else 0 for d in field.shape)
+            host_dtype = field.dtype if field.dtype.kind not in "USOMm" else object
+            layout[name] = ((self._local_rows,) + shape, None, host_dtype)
+        return layout
 
     def state_dict(self) -> Dict:
         """Data-position cursor to pair with a training checkpoint.

@@ -241,3 +241,52 @@ def test_drain_multihost_alignment_pads_short_hosts(ds):
         assert p["id"].shape == real[-1]["id"].shape
         assert str(p["id"].sharding.spec) == str(PartitionSpec("data"))
         assert np.asarray(p["id"]).sum() == 0
+
+
+def test_drain_zero_batch_host_synthesizes_pads(ds):
+    """A host that drained ZERO batches while a peer drained some must still
+    yield synthesized pad batches (shapes from the schema) so the pod steps in
+    lockstep - raising here would hang the peers mid-collective."""
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    with make_batch_reader(ds, reader_pool_type="serial", num_epochs=1,
+                           shuffle_row_groups=False) as r:
+        with JaxDataLoader(r, batch_size=8, mesh=mesh,
+                           shardings=PartitionSpec("data"),
+                           drop_last=False) as loader:
+            for _ in loader:  # exhaust: nothing left in flight to drain
+                pass
+            drained = list(loader.drain(
+                all_gather_counts=lambda mine: [mine, mine + 2]))
+    assert len(drained) == 2
+    for p in drained:
+        assert p["_valid_rows"] == 0
+        assert p["id"].shape == (8,)
+        assert p["x"].shape == (8, 4)
+        assert str(p["x"].sharding.spec) == str(PartitionSpec("data"))
+        assert np.asarray(p["x"]).sum() == 0
+
+
+def test_drain_zero_batch_host_without_any_emitted_batch(ds):
+    """Zero-batch alignment must work even when NO batch was ever emitted on
+    this host (empty placement cache): shapes come from the schema."""
+    from jax.sharding import Mesh, PartitionSpec
+
+    from petastorm_tpu.predicates import in_lambda
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    nothing = in_lambda(["id"], lambda cols: np.zeros(len(cols["id"]), bool),
+                        vectorized=True)
+    with make_batch_reader(ds, reader_pool_type="serial", num_epochs=1,
+                           predicate=nothing, shuffle_row_groups=False) as r:
+        with JaxDataLoader(r, batch_size=8, mesh=mesh,
+                           shardings=PartitionSpec("data"),
+                           drop_last=False) as loader:
+            drained = list(loader.drain(
+                all_gather_counts=lambda mine: [mine, 1]))
+    assert len(drained) == 1
+    (p,) = drained
+    assert p["_valid_rows"] == 0
+    assert p["id"].shape == (8,) and p["x"].shape == (8, 4)
+    assert np.asarray(p["x"]).sum() == 0

@@ -186,6 +186,30 @@ def test_slices_get_distinct_fingerprints(tmp_path):
         assert sorted(row.x for row in r) == list(range(50, 100))
 
 
+def test_arrow_path_clears_debris_dir(tmp_path):
+    """A pre-existing directory with NO parquet at the cache target (crashed
+    writer, foreign files) must be moved aside and re-materialized - neither
+    adopted as data nor allowed to fail the publish rename."""
+    from petastorm_tpu.converter import _fingerprint
+
+    t = pa.table({"x": np.arange(40, dtype=np.int64)})
+    tag = _fingerprint(t, {"codec": "snappy", "rg_mb": 128.0, "v": 2})
+    debris = tmp_path / f"converted-{tag}"
+    debris.mkdir()
+    (debris / "stray.txt").write_text("junk")
+
+    conv = make_converter(t, str(tmp_path), row_group_size_mb=128.0)
+    try:
+        assert conv.file_urls and all(
+            u.endswith(".parquet") for u in conv.file_urls)
+        with conv.make_reader(reader_pool_type="serial",
+                              shuffle_row_groups=False) as r:
+            assert sorted(row.x for row in r) == list(range(40))
+        assert not (debris / "stray.txt").exists()
+    finally:
+        conv.delete()
+
+
 def test_dedup_persistence_wins(tmp_path):
     """A later delete_at_exit=False on the same content un-registers cleanup."""
     conv1 = make_converter(_df(), str(tmp_path))

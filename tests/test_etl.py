@@ -398,3 +398,40 @@ def test_write_failure_closes_open_writers(tmp_path):
         writer_mod.pq.ParquetWriter = orig_writer
     gc.collect()
     assert closed, "no writer was closed on the failure path"
+    # close() wrote a footer, making the debris parse as valid parquet; the
+    # failure path must delete it so mode='append'/stamp cannot adopt it
+    leftovers = [p for p in (tmp_path / "ds").rglob("*.parquet")]
+    assert not leftovers, f"failed write left adoptable parquet files: {leftovers}"
+
+
+def test_happy_path_close_failure_deletes_all_output(tmp_path):
+    """A footer flush failing in the final close loop must delete the files
+    earlier writers closed successfully - the call failed as a whole, so none
+    of its output may survive to be adopted by a later append/stamp."""
+    import numpy as np
+
+    from petastorm_tpu.etl import writer as writer_mod
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.schema import Field, Schema
+
+    orig_writer = writer_mod.pq.ParquetWriter
+    n_closed = [0]
+
+    class SecondCloseFails(orig_writer):
+        def close(self):
+            n_closed[0] += 1
+            if n_closed[0] == 2:
+                raise OSError("simulated footer flush failure")
+            return super().close()
+
+    writer_mod.pq.ParquetWriter = SecondCloseFails
+    try:
+        schema = Schema("P", [Field("part", np.int64), Field("id", np.int64)])
+        rows = [{"part": i % 2, "id": i} for i in range(8)]
+        with pytest.raises(OSError, match="footer flush"):
+            write_dataset(str(tmp_path / "ds"), schema, rows,
+                          partition_by=["part"], row_group_size_rows=2)
+    finally:
+        writer_mod.pq.ParquetWriter = orig_writer
+    leftovers = list((tmp_path / "ds").rglob("*.parquet"))
+    assert not leftovers, f"close failure left adoptable files: {leftovers}"
