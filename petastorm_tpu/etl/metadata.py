@@ -29,6 +29,7 @@ import pyarrow.parquet as pq
 
 from petastorm_tpu.errors import MetadataError
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+from petastorm_tpu.retry import resolve_retry_policy, retry_call
 from petastorm_tpu.schema import SCHEMA_METADATA_KEY, Schema
 
 logger = logging.getLogger(__name__)
@@ -79,6 +80,7 @@ class DatasetInfo:
 
     @property
     def partition_keys(self) -> List[str]:
+        """Hive partition key names, in first-seen rowgroup order."""
         keys = []
         for rg in self.row_groups:
             for k, _ in rg.partition_values:
@@ -164,7 +166,8 @@ def _check_legacy_row_group_counts(kv_metadata: Dict[bytes, bytes], root: str,
 
 
 def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
-                    kv_metadata: Dict[bytes, bytes]) -> List[RowGroupRef]:
+                    kv_metadata: Dict[bytes, bytes],
+                    retry_policy=None) -> List[RowGroupRef]:
     """Enumerate rowgroups for path-sorted ``files``.
 
     Strategy 1 (fast): cached per-file counts from KV metadata - no footer reads
@@ -193,7 +196,10 @@ def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
             per_file = {f: counts[posixpath.relpath(f, root)] for f in files}
     if counts is None:
         with ThreadPoolExecutor(max_workers=_FOOTER_READ_THREADS) as pool:
-            results = list(pool.map(lambda p: _footer_row_groups(fs, p), files))
+            results = list(pool.map(
+                lambda p: retry_call(lambda: _footer_row_groups(fs, p),
+                                     retry_policy, what=f"footer of {p}"),
+                files))
         per_file = dict(zip(files, results))
         _check_legacy_row_group_counts(kv_metadata, root, per_file)
 
@@ -209,18 +215,27 @@ def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
 def open_dataset(url_or_urls: Union[str, Sequence[str]],
                  storage_options: Optional[dict] = None,
                  filesystem: Optional[pafs.FileSystem] = None,
-                 require_stored_schema: bool = False) -> DatasetInfo:
+                 require_stored_schema: bool = False,
+                 io_retries="auto") -> DatasetInfo:
     """Resolve URL(s) -> DatasetInfo with schema, files, rowgroups.
 
     ``url_or_urls`` may be a dataset directory URL or an explicit list of parquet
     file URLs (reference supports both in make_batch_reader, fs_utils.py:199-228).
+
+    ``io_retries``: transient-failure policy for the listing/KV/footer reads
+    (petastorm_tpu.retry) - ``'auto'`` retries on remote filesystems only.
     """
     fs, path_or_paths = get_filesystem_and_path_or_paths(
         url_or_urls, storage_options, filesystem)
+    retry_policy = resolve_retry_policy(io_retries, fs)
+
+    def _list(selector):
+        return retry_call(lambda: fs.get_file_info(selector), retry_policy,
+                          what=f"listing {getattr(selector, 'base_dir', selector)}")
 
     if isinstance(path_or_paths, str):
         root = path_or_paths
-        info = fs.get_file_info(root)
+        info = _list(root)
         if info.type == pafs.FileType.NotFound:
             raise MetadataError(f"Dataset path not found: {url_or_urls!r}")
         if info.type == pafs.FileType.File:
@@ -228,12 +243,12 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
             root = posixpath.dirname(root)
         else:
             selector = pafs.FileSelector(root, recursive=True)
-            files = sorted(f.path for f in fs.get_file_info(selector)
+            files = sorted(f.path for f in _list(selector)
                            if f.type == pafs.FileType.File and _is_data_file(f.path))
     else:
         files = []
         for p in path_or_paths:
-            info = fs.get_file_info(p)
+            info = _list(p)
             if info.type == pafs.FileType.NotFound:
                 raise MetadataError(f"Dataset path not found: {p!r}")
             if info.type == pafs.FileType.File:
@@ -241,7 +256,7 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
             else:  # a directory in the list: expand it (reference contract is
                 # file lists; accepting dirs beats pyarrow's obscure OSError)
                 selector = pafs.FileSelector(p, recursive=True)
-                files.extend(f.path for f in fs.get_file_info(selector)
+                files.extend(f.path for f in _list(selector)
                              if f.type == pafs.FileType.File
                              and _is_data_file(f.path))
         files = sorted(files)
@@ -256,14 +271,19 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
     if not files:
         raise MetadataError(f"No parquet data files found under {url_or_urls!r}")
 
-    kv = _read_kv_metadata(fs, root)
+    kv = retry_call(lambda: _read_kv_metadata(fs, root), retry_policy,
+                    what=f"metadata of {root}")
     stored_schema = None
     if SCHEMA_METADATA_KEY in kv:
         stored_schema = Schema.from_json(kv[SCHEMA_METADATA_KEY])
     else:
         # schema may be stamped in data-file footers instead (single-file writes)
-        with fs.open_input_file(files[0]) as f:
-            file_kv = pq.ParquetFile(f).schema_arrow.metadata or {}
+        def _file_kv():
+            with fs.open_input_file(files[0]) as f:
+                return pq.ParquetFile(f).schema_arrow.metadata or {}
+
+        file_kv = retry_call(_file_kv, retry_policy,
+                             what=f"schema footer of {files[0]}")
         if SCHEMA_METADATA_KEY in file_kv:
             stored_schema = Schema.from_json(file_kv[SCHEMA_METADATA_KEY])
             kv = {**file_kv, **kv}
@@ -293,9 +313,11 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
             " make_batch_reader for plain parquet stores, or regenerate metadata with"
             " petastorm_tpu.tools.generate_metadata.")
 
-    dset = pads.dataset(files, filesystem=fs, format="parquet",
-                        partitioning=pads.HivePartitioning.discover())
-    row_groups = load_row_groups(fs, root, files, kv)
+    dset = retry_call(
+        lambda: pads.dataset(files, filesystem=fs, format="parquet",
+                             partitioning=pads.HivePartitioning.discover()),
+        retry_policy, what=f"dataset schema of {root}")
+    row_groups = load_row_groups(fs, root, files, kv, retry_policy=retry_policy)
     return DatasetInfo(url_or_urls, fs, path_or_paths, files, dset.schema, kv,
                        row_groups, stored_schema, root_path=root)
 

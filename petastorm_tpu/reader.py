@@ -78,7 +78,8 @@ def make_reader(dataset_url: str,
                 resume_from: Optional[dict] = None,
                 verify_checksums: bool = False,
                 decode_placement: Optional[Dict[str, str]] = None,
-                ngram=None) -> "Reader":
+                ngram=None,
+                io_retries="auto") -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
     Reference: ``make_reader`` (reader.py:59-176).  Yields one namedtuple row per
@@ -90,6 +91,11 @@ def make_reader(dataset_url: str,
     planes, which ONLY ``petastorm_tpu.jax.JaxDataLoader`` can finish - row
     iteration and the torch/tf adapters refuse such readers (they would see
     planes, not pixels).  Requires uniform jpeg geometry across the dataset.
+
+    ``io_retries``: transient remote-IO policy (petastorm_tpu.retry).
+    ``'auto'`` = bounded retry-with-backoff on remote filesystems (GCS/S3/
+    HDFS/fsspec), off for local paths; an int sets the attempt budget; a
+    ``RetryPolicy`` customizes backoff; ``None`` disables.
     """
     return _make_reader_impl(dataset_url, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -100,7 +106,8 @@ def make_reader(dataset_url: str,
                              batched_output=False, require_stored_schema=True,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
-                             decode_placement=decode_placement)
+                             decode_placement=decode_placement,
+                             io_retries=io_retries)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -151,12 +158,13 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       resume_from: Optional[dict] = None,
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
-                      ngram=None) -> "Reader":
+                      ngram=None,
+                      io_retries="auto") -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
 
     Reference: ``make_batch_reader`` (reader.py:179-290).  Yields one namedtuple of
-    column arrays per decoded rowgroup.
+    column arrays per decoded rowgroup.  ``io_retries``: see ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -167,7 +175,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              batched_output=True, require_stored_schema=False,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
-                             decode_placement=decode_placement)
+                             decode_placement=decode_placement,
+                             io_retries=io_retries)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -179,7 +188,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       batched_output, require_stored_schema,
                       resume_from: Optional[dict] = None, ngram=None,
                       verify_checksums: bool = False,
-                      decode_placement: Optional[Dict[str, str]] = None) -> "Reader":
+                      decode_placement: Optional[Dict[str, str]] = None,
+                      io_retries="auto") -> "Reader":
     if ngram is not None and batched_output:
         raise PetastormTpuError(
             "NGram is not supported by make_batch_reader (reference parity,"
@@ -205,7 +215,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     try:
         info = open_dataset(dataset_url, storage_options=storage_options,
                             filesystem=filesystem,
-                            require_stored_schema=require_stored_schema)
+                            require_stored_schema=require_stored_schema,
+                            io_retries=io_retries)
     except MetadataError as exc:
         if require_stored_schema:
             raise MetadataError(
@@ -294,12 +305,16 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     device_fields = _validate_decode_placement(decode_placement, full_schema,
                                                read_fields, transform_spec,
                                                ngram, worker_predicate)
+    from petastorm_tpu.retry import resolve_retry_policy
+
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
                                    predicate=worker_predicate,
                                    transform=transform_spec, cache=cache,
                                    ngram=ngram, ngram_schema=ngram_schema,
                                    verify_checksums=verify_checksums,
-                                   raw_fields=device_fields)
+                                   raw_fields=device_fields,
+                                   retry_policy=resolve_retry_policy(
+                                       io_retries, info.filesystem))
 
     if workers_count == "auto":
         # size to the usable cores (cgroup/affinity-aware), one left for the
@@ -633,11 +648,13 @@ class Reader:
     # -- lifecycle ------------------------------------------------------------
 
     def stop(self) -> None:
+        """Stop ventilation and the worker pool; in-flight items are discarded."""
         self._stopped = True
         self._ventilator.stop()
         self._executor.stop()
 
     def join(self) -> None:
+        """Wait for the pool workers and ventilator to exit (after stop())."""
         self._ventilator.join()
         self._executor.join()
 
@@ -650,6 +667,7 @@ class Reader:
 
     @property
     def diagnostics(self) -> dict:
+        """Observability snapshot: items consumed/expected, epoch position, pool queue depths, worker profile samples (when enabled)."""
         return {**self._executor.diagnostics,
                 "items_per_epoch": self._ventilator.items_per_epoch,
                 "consumed_items": self._consumed_items,

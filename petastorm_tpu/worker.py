@@ -55,7 +55,8 @@ class RowGroupDecoderWorker:
                  ngram=None,
                  ngram_schema: Optional[Schema] = None,
                  verify_checksums: bool = False,
-                 raw_fields: Sequence[str] = ()):
+                 raw_fields: Sequence[str] = (),
+                 retry_policy=None):
         self._fs_factory = fs_factory
         self._schema = schema
         self._read_fields = list(read_fields)
@@ -66,6 +67,9 @@ class RowGroupDecoderWorker:
         self._ngram = ngram
         self._ngram_schema = ngram_schema or schema
         self._verify_checksums = verify_checksums
+        #: petastorm_tpu.retry.RetryPolicy (or None): transient read failures
+        #: on remote stores are retried with the cached file handle dropped
+        self._retry_policy = retry_policy
         #: fields delivered as raw encoded bytes (codec decode skipped) -
         #: decode_placement='device': the jax loader decodes them on-chip
         self._raw_fields = frozenset(raw_fields)
@@ -105,11 +109,28 @@ class RowGroupDecoderWorker:
 
         def process(item) -> ColumnBatch:
             from petastorm_tpu.pool import VentilatedItem
+            from petastorm_tpu.retry import retry_call
 
             ordinal = None
             if isinstance(item, VentilatedItem):
                 ordinal, item = item.ordinal, item.item
-            batch = self._process(_parquet_file, item)
+
+            def drop_handle(_exc):
+                # the cached ParquetFile (its buffered stream/connection) may
+                # be poisoned by the failure; reopen on the next attempt
+                entry = open_files.pop(item.row_group.path, None)
+                if entry is not None:
+                    try:
+                        entry[0].close()
+                    except Exception:  # noqa: BLE001 - already failing
+                        pass
+
+            batch = retry_call(
+                lambda: self._process(_parquet_file, item),
+                self._retry_policy,
+                what=f"rowgroup {item.row_group.path}"
+                     f"#{item.row_group.row_group}",
+                on_retry=drop_handle)
             # ordinal rides the batch so the consumer can track the exact
             # contiguous consumed prefix (resume correctness under pools
             # that complete items out of ventilation order).  Shallow copy:
