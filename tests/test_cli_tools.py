@@ -199,3 +199,46 @@ def test_reader_mock_rows_and_batches():
     batches = list(mock.iter_batches())
     assert len(batches) == 3
     assert batches[0].num_rows == 4
+
+
+# -- petastorm-tpu-metadata show (reference etl/metadata_util.py:15-70) -------
+
+def test_show_metadata_human(small_ds, capsys):
+    from petastorm_tpu.tools.show_metadata import main as show_main
+
+    url, rows = small_ds
+    assert show_main(["show", url]) == 0
+    out = capsys.readouterr().out
+    assert "Schema:" in out and "id" in out and "NdarrayCodec" in out
+    assert "Rowgroups: 6 across" in out          # 30 rows / rg_size 5
+    assert f"{len(rows)} rows total" in out
+    assert "nullable" in out                     # the 'opt' field
+    assert "KV metadata keys:" in out
+
+
+def test_show_metadata_json_and_indexes(small_ds, tmp_path, capsys):
+    from petastorm_tpu.etl.indexing import (SingleFieldIndexer,
+                                            build_rowgroup_index)
+    from petastorm_tpu.tools.show_metadata import main as show_main
+
+    url, rows = small_ds
+    build_rowgroup_index(url, [SingleFieldIndexer("by_id", "id")])
+    assert show_main(["show", "--rowgroups", "--json", url]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["name"] for f in doc["schema"]} == {"id", "value", "opt"}
+    assert doc["schema_source"] == "stored"
+    assert doc["rowgroups"]["total_rows"] == len(rows)
+    assert doc["rowgroups"]["rows_per_group_median"] == 5
+    assert sum(f["rows"] for f in doc["files"]) == len(rows)
+    by_id = [ix for ix in doc["indexes"] if ix["name"] == "by_id"]
+    assert by_id and by_id[0]["num_indexed_values"] == len(rows)
+    assert any("schema" in k for k in doc["kv_metadata_keys"])
+
+
+def test_show_metadata_schema_only(small_ds, capsys):
+    from petastorm_tpu.tools.show_metadata import main as show_main
+
+    url, _ = small_ds
+    assert show_main(["show", "--schema-only", "--json", url]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"url", "schema_source", "schema"}
