@@ -193,13 +193,16 @@ def _publish_dir(fs: pafs.FileSystem, tmp_root: str, root: str) -> None:
     ours = _parquet_count(tmp_root)
     try:
         fs.move(tmp_root, root)
-    except Exception:  # noqa: BLE001 - re-raised unless the race is confirmed
+    except Exception as move_exc:  # noqa: BLE001 - re-raised unless race confirmed
         # the winner must look at least as complete as what we tried to
         # publish: on filesystems where move is per-file copy+delete, OUR
         # OWN failed half-move must not read as a winning peer (deleting
         # tmp_root would then destroy the only complete copy)
-        won = (fs.get_file_info(root).type == pafs.FileType.Directory
-               and _parquet_count(root) >= max(ours, 1))
+        try:
+            won = (fs.get_file_info(root).type == pafs.FileType.Directory
+                   and _parquet_count(root) >= max(ours, 1))
+        except Exception:  # noqa: BLE001 - verification itself failed
+            raise move_exc  # unknown outcome: surface the original failure
         if not won:
             raise
         logger.info("Lost publish race for %s; keeping the winner", root)

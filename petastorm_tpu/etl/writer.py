@@ -247,15 +247,22 @@ def write_dataset(url: str,
             # adopt as complete data - delete what this failed call produced
             _delete_files_best_effort(fs, files)
 
-    try:
-        for w in writers.values():
+    close_exc = None
+    for w in writers.values():
+        try:
             w.close()
-    except BaseException:
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            # keep closing the REST: an unclosed writer's stream could flush
+            # its footer later (GC/multipart commit) and resurrect a valid
+            # parquet file after the cleanup below deletes it
+            if close_exc is None:
+                close_exc = exc
+    if close_exc is not None:
         # a footer flush failed (ENOSPC, upload error): earlier writers in
         # this loop closed fine, so their files parse as complete parquet -
         # the whole call failed, none of its output may survive to be adopted
         _delete_files_best_effort(fs, files)
-        raise
+        raise close_exc
     if not files:
         logger.warning("write_dataset(%s): no rows were written; dataset left empty",
                        url)

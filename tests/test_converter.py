@@ -300,6 +300,12 @@ class _FakeCol:
 
 
 def _install_fake_pyspark(monkeypatch):
+    """Mock pyspark pinned to the EXACT API surface converter.py uses
+    (signatures per pyspark 3.5; see docs/operations.md 'Spark converter
+    verification'): ``pyspark.sql.functions.col(name: str)``,
+    ``pyspark.ml.functions.vector_to_array(col: Column, dtype: str)`` with
+    dtype in {'float32','float64'}.  Any call outside these signatures fails
+    the test instead of passing silently."""
     import sys
     import types
 
@@ -308,8 +314,21 @@ def _install_fake_pyspark(monkeypatch):
     sqlf = types.ModuleType("pyspark.sql.functions")
     ml = types.ModuleType("pyspark.ml")
     mlf = types.ModuleType("pyspark.ml.functions")
-    sqlf.col = _FakeCol
-    mlf.vector_to_array = lambda col, dtype="float64": ("v2a", col.name, dtype)
+
+    def _col(name):
+        assert isinstance(name, str) and name, \
+            f"pyspark.sql.functions.col takes a column-name string, got {name!r}"
+        return _FakeCol(name)
+
+    def _vector_to_array(col, dtype="float64"):
+        assert isinstance(col, _FakeCol), \
+            f"vector_to_array takes a Column (from col()), got {type(col)}"
+        assert dtype in ("float32", "float64"), \
+            f"vector_to_array dtype must be 'float32'/'float64', got {dtype!r}"
+        return ("v2a", col.name, dtype)
+
+    sqlf.col = _col
+    mlf.vector_to_array = _vector_to_array
     for name, mod in (("pyspark", root), ("pyspark.sql", sql),
                       ("pyspark.sql.functions", sqlf), ("pyspark.ml", ml),
                       ("pyspark.ml.functions", mlf)):
@@ -355,6 +374,11 @@ class _FakeSparkDataFrame:
                     "FloatType" if dtype == "float32" else "DoubleType")))
         elif kind == "cast":
             _, src, target = expr
+            # pin cast targets to valid Spark SQL type strings (Column.cast
+            # accepts a DDL-formatted type name)
+            assert target in ("float", "double", "array<float>",
+                              "array<double>"), \
+                f"Column.cast called with non-Spark type string {target!r}"
             if target in ("float", "double"):
                 np_t = np.float32 if target == "float" else np.float64
                 pdf[name] = pdf[src].astype(np_t)
@@ -371,18 +395,40 @@ class _FakeSparkDataFrame:
         return _FakeSparkDataFrame(pdf, _FakeSchema(fields),
                                    self._plan_tag + f"+{name}:{kind}")
 
+    #: DataFrameWriter call sequences, one list per .write chain (pinned-API
+    #: assertion surface; cleared by tests that inspect it)
+    write_calls = []
+
     @property
     def write(self):
         df = self
+        calls = []
+        _FakeSparkDataFrame.write_calls.append(calls)
 
         class _Writer:
             def mode(self_inner, m):
+                # converter.py must write mode('overwrite') into its fresh tmp
+                # dir (DataFrameWriter.mode accepts a saveMode string)
+                assert m == "overwrite", f"unexpected write mode {m!r}"
+                calls.append(("mode", m))
                 return self_inner
 
             def option(self_inner, k, v):
+                # the two options the reference sets (spark_dataset_converter
+                # .py:553-555): parquet codec + target block size
+                assert k in ("compression", "parquet.block.size"), \
+                    f"unexpected DataFrameWriter.option key {k!r}"
+                if k == "parquet.block.size":
+                    assert isinstance(v, int) and v > 0, v
+                else:
+                    assert isinstance(v, str) and v, v
+                calls.append(("option", k, v))
                 return self_inner
 
             def parquet(self_inner, url):
+                assert isinstance(url, str) and "://" in url or url.startswith("/"), \
+                    f"DataFrameWriter.parquet takes a path/URL string, got {url!r}"
+                calls.append(("parquet", url))
                 path = url[len("file://"):] if url.startswith("file://") else url
                 os.makedirs(path, exist_ok=True)
                 n = len(df._pdf)
@@ -427,6 +473,36 @@ def test_spark_df_materializes_on_executors(tmp_path, monkeypatch):
         # DoubleType scalar downcast to float32 by dtype='float32'
         assert conv.schema["x"].dtype == np.float32
     finally:
+        conv.delete()
+
+
+def test_spark_write_call_sequence_pinned(tmp_path, monkeypatch):
+    """The executor-side materialization must issue EXACTLY the pinned
+    DataFrameWriter chain (mode -> compression option -> block-size option ->
+    parquet into a .tmp dir) - the strongest drift tripwire available without
+    a real pyspark in this environment (docs/operations.md)."""
+    _install_fake_pyspark(monkeypatch)
+    import warnings as _w
+
+    _FakeSparkDataFrame.write_calls.clear()
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        conv = make_converter(_spark_frame(), cache_dir_url=str(tmp_path),
+                              row_group_size_mb=32.0)
+    try:
+        # attribute probes (hasattr duck-typing) touch .write without calling
+        # it; exactly ONE chain may actually write
+        chains = [c for c in _FakeSparkDataFrame.write_calls if c]
+        assert len(chains) == 1
+        (calls,) = chains
+        assert calls[0] == ("mode", "overwrite")
+        assert calls[1] == ("option", "compression", "snappy")
+        assert calls[2] == ("option", "parquet.block.size", int(32.0 * 2**20))
+        kind, url = calls[3]
+        assert kind == "parquet" and "/.tmp-" in url  # tmp dir, atomic publish
+        assert len(calls) == 4
+    finally:
+        _FakeSparkDataFrame.write_calls.clear()
         conv.delete()
 
 
