@@ -31,6 +31,8 @@ class CacheBase(ABC):
         """Return cached value or compute+store via ``fill_cache_func``."""
 
     def cleanup(self) -> None:
+        """Release the cache's resources (files, memory); the cache is
+        unusable afterwards.  No-op by default."""
         pass
 
 

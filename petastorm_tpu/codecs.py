@@ -61,6 +61,9 @@ def _decode_threads() -> int:
 
 
 def register_codec(cls: Type["Codec"]) -> Type["Codec"]:
+    """Class decorator: make a Codec subclass JSON-round-trippable by name
+    (datasets stamp ``{"codec": codec_name, **params}``; readers look the
+    name up here).  User-defined codecs must register before reading."""
     _CODEC_REGISTRY[cls.codec_name] = cls
     return cls
 
@@ -111,11 +114,11 @@ class Codec(ABC):
 
     @abstractmethod
     def encode(self, field, value) -> Any:
-        ...
+        """One cell's python value -> the storage value handed to pyarrow."""
 
     @abstractmethod
     def decode(self, field, value) -> Any:
-        ...
+        """Invert :meth:`encode` for one stored cell."""
 
     def decode_column(self, field, column: pa.Array) -> np.ndarray:
         """Decode an arrow column -> stacked numpy array.
@@ -129,6 +132,8 @@ class Codec(ABC):
     # -- serialization --------------------------------------------------------
 
     def to_json(self) -> Dict[str, Any]:
+        """JSON-native params dict stored in dataset metadata ({} by default);
+        inverted by ``from_json`` via the codec registry."""
         return {"codec": self.codec_name}
 
     @classmethod
@@ -446,6 +451,7 @@ class CompressedImageCodec(Codec):
 
     @property
     def image_codec(self) -> str:
+        """The stored image format: 'png' or 'jpeg'."""
         return self._format
 
     def storage_type(self, field) -> pa.DataType:

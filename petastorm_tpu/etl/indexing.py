@@ -63,18 +63,25 @@ class RowGroupIndexer(ABC):
 
     @abstractmethod
     def process_row_group(self, row_group_index: int, columns: Dict[str, np.ndarray]):
+        """Fold one rowgroup's column arrays into the index during the build
+        scan (called once per rowgroup, in global-index order)."""
         ...
 
     @abstractmethod
     def indexed_values(self) -> List:
+        """Every distinct value the index maps (sorted where orderable)."""
         ...
 
     @abstractmethod
     def get_row_group_indexes(self, value=None) -> Set[int]:
+        """Global rowgroup ordinals holding ``value`` (or any indexed value
+        when ``value`` is None)."""
         ...
 
     @abstractmethod
     def to_json(self) -> dict:
+        """JSON-native payload stored under the dataset's index KV key;
+        inverted by ``from_json``."""
         ...
 
     @classmethod

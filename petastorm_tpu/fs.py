@@ -140,4 +140,5 @@ class FilesystemFactory:
 
     @property
     def url(self) -> str:
+        """The dataset URL this factory re-resolves in worker processes."""
         return self._url

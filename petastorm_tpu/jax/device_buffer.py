@@ -102,6 +102,7 @@ class DeviceShufflingBuffer:
         return stack(batches)
 
     def push(self, batch: Dict[str, jax.Array]) -> Optional[Dict[str, jax.Array]]:
+        """Add one device batch; once the buffer is full, evicts and returns a uniformly-chosen resident batch (None while filling)."""
         if self._store is None:
             self._pending.append(batch)
             if len(self._pending) < self._capacity:

@@ -779,11 +779,13 @@ class JaxDataLoader:
                 logger.debug("stop_trace: %s", exc)
 
     def stop(self) -> None:
+        """Stop the producer pipeline and the underlying reader."""
         self._stop_event.set()
         self._reader.stop()
         self._stop_trace()
 
     def join(self) -> None:
+        """Wait for the producer threads and the reader to exit (after stop())."""
         if self._started:
             self._thread.join(timeout=10)
             self._transfer_thread.join(timeout=10)

@@ -35,6 +35,12 @@ NGRAM_KEY_SEP = "/"
 
 
 class NGram:
+    """Sliding-window spec: ``{offset: [fields]}`` read per window, windows
+    anchored where consecutive ``timestamp_field`` values stay within
+    ``delta_threshold``.  Pass to ``make_reader(ngram=...)``;
+    ``stack_timesteps=True`` yields columnar (window, T, ...) arrays for the
+    device-feed path instead of per-offset namedtuples."""
+
     def __init__(self,
                  fields: Dict[int, Sequence],
                  delta_threshold: Union[int, float],
@@ -58,6 +64,7 @@ class NGram:
 
     @property
     def offsets(self) -> List[int]:
+        """Sorted timestep offsets this window spec covers."""
         return list(self._offsets)
 
     def __eq__(self, other):
@@ -210,6 +217,7 @@ class NGram:
         return Schema(f"{schema.name}_ngram", out)
 
     def make_namedtuple_types(self, schema: Schema):
+        """offset -> namedtuple type for window rows (what row-path iteration yields per timestep)."""
         views = self.resolve_schema(schema)
         return {off: view.make_namedtuple_type() for off, view in views.items()}
 

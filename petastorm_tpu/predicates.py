@@ -27,7 +27,8 @@ from petastorm_tpu.errors import PetastormTpuError
 class PredicateBase(ABC):
     @abstractmethod
     def get_fields(self) -> List[str]:
-        ...
+        """Field names this predicate reads (the reader decodes these FIRST
+        and masks rows before decoding the rest - the split-read)."""
 
     def do_include(self, row: Dict) -> bool:
         """Per-row check; default delegates to the vectorized form."""

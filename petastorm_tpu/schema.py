@@ -63,6 +63,7 @@ class Field:
 
     @property
     def is_fixed_shape(self) -> bool:
+        """True when every dim is concrete (no None wildcards) - such columns decode to one contiguous (n, *shape) array."""
         return all(d is not None for d in self.shape)
 
     def __eq__(self, other):
@@ -77,6 +78,7 @@ class Field:
     # -- serialization --------------------------------------------------------
 
     def to_json(self) -> Dict[str, Any]:
+        """JSON-native dict for the stored schema document (dtype as numpy str, shape with null wildcards, codec by registered name)."""
         # dtype.str ('<U10', '|S5', '<f4') roundtrips through np.dtype() exactly,
         # unlike dtype.name which is lossy for unicode and invalid for bytes
         return {
@@ -126,10 +128,12 @@ class Schema:
 
     @property
     def name(self) -> str:
+        """The schema's name (stored with the dataset; informational)."""
         return self._name
 
     @property
     def fields(self) -> "OrderedDict[str, Field]":
+        """name -> Field mapping, in declaration order."""
         return self._fields
 
     def __iter__(self):
@@ -172,6 +176,7 @@ class Schema:
         return Schema(self._name, [f for f in self if f.name in selected])
 
     def resolve_fields(self, selectors: Iterable[_SelectorT]) -> List[str]:
+        """Expand name/regex/Field selectors into concrete field names, in schema order (reference unischema field-selection semantics)."""
         selected: "OrderedDict[str, None]" = OrderedDict()
         for sel in selectors:
             if isinstance(sel, Field):
@@ -209,6 +214,7 @@ class Schema:
         return self._namedtuple
 
     def make_namedtuple(self, **kwargs):
+        """One row as this schema's namedtuple (fields passed by keyword)."""
         missing = set(self._fields) - set(kwargs)
         if missing:
             raise SchemaError(f"Missing fields {sorted(missing)} building row of {self._name!r}")
@@ -217,6 +223,7 @@ class Schema:
     # -- serialization --------------------------------------------------------
 
     def to_json(self) -> str:
+        """Schema as the JSON document stored under the parquet KV key (never pickle - stable across class renames); inverted by ``from_json``."""
         return json.dumps({
             "version": 1,
             "name": self._name,

@@ -17,10 +17,13 @@ from petastorm_tpu.etl.indexing import RowGroupIndexer
 class RowGroupSelectorBase(ABC):
     @abstractmethod
     def get_index_names(self) -> List[str]:
+        """Names of the stored rowgroup indexes this selector consults."""
         ...
 
     @abstractmethod
     def select_row_groups(self, indexes: Dict[str, RowGroupIndexer]) -> Set[int]:
+        """Global rowgroup indexes to read, resolved against the dataset's
+        stored indexes (missing index names raise with the available set)."""
         ...
 
     def _require(self, indexes: Dict[str, RowGroupIndexer], name: str) -> RowGroupIndexer:

@@ -68,9 +68,13 @@ def iter_batched(source, buffer: "ShufflingBufferBase", batch_size: int):
 
 class ShufflingBufferBase:
     def add(self, batch: ColumnBatch) -> None:
+        """Accept one columnar batch into the buffer (caller checked
+        ``can_add``)."""
         raise NotImplementedError
 
     def retrieve(self, n: int) -> ColumnBatch:
+        """Remove and return exactly ``n`` rows (caller checked
+        ``can_retrieve(n)``)."""
         raise NotImplementedError
 
     def finish(self) -> None:
@@ -79,10 +83,12 @@ class ShufflingBufferBase:
 
     @property
     def size(self) -> int:
+        """Rows currently buffered."""
         raise NotImplementedError
 
     @property
     def can_add(self) -> bool:
+        """True while the buffer has room for another batch."""
         raise NotImplementedError
 
     @property
@@ -91,6 +97,8 @@ class ShufflingBufferBase:
         raise NotImplementedError
 
     def can_retrieve(self, n: int) -> bool:
+        """True when ``n`` rows can be retrieved now (respects the
+        ``min_after_retrieve`` mixing floor until ``finish``)."""
         raise NotImplementedError
 
 

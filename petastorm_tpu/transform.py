@@ -24,6 +24,10 @@ EditFieldT = Tuple[str, "np.dtype", Tuple[Optional[int], ...], bool]
 
 
 class TransformSpec:
+    """Worker-side columnar transform: ``func(columns) -> columns`` plus the
+    schema edits it implies (``edit_fields`` added/retyped, ``removed_fields``
+    dropped, ``selected_fields`` kept) - the reader's output schema reflects
+    the edits before any data flows (reference transform_spec semantics)."""
     def __init__(self,
                  func: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
                  edit_fields: Optional[Sequence[EditFieldT]] = None,

@@ -19,6 +19,12 @@ from petastorm_tpu.errors import PetastormTpuError
 
 
 class WeightedSamplingReader:
+    """Mix several compatible readers into one stream, drawing each next
+    row/batch from reader ``i`` with probability ``probabilities[i]``
+    (normalized; seeded for reproducibility).  Schemas must agree on the
+    delivered fields; exhausted readers drop out and the remaining weights
+    renormalize (reference weighted_sampling_reader semantics)."""
+
     def __init__(self, readers: Sequence, probabilities: Sequence[float],
                  seed: Optional[int] = None):
         if len(readers) != len(probabilities) or not readers:
@@ -63,6 +69,7 @@ class WeightedSamplingReader:
 
     @property
     def last_row_consumed(self) -> bool:
+        """True once every underlying reader finished its epochs."""
         return all(r.last_row_consumed for r in self._readers)
 
     def __iter__(self):
@@ -85,6 +92,7 @@ class WeightedSamplingReader:
         raise StopIteration
 
     def iter_batches(self):
+        """Columnar batches drawn from the mixed stream (device-feed path)."""
         sources = [r.iter_batches() for r in self._readers]
         alive = list(range(len(sources)))
         while alive:
@@ -96,10 +104,12 @@ class WeightedSamplingReader:
                 alive.pop(i)
 
     def stop(self) -> None:
+        """Stop every underlying reader."""
         for r in self._readers:
             r.stop()
 
     def join(self) -> None:
+        """Wait for every underlying reader to exit (after stop())."""
         for r in self._readers:
             r.join()
 

@@ -1,0 +1,53 @@
+"""The committed API reference must match the live package.
+
+docs/api/*.md is generated (docs/gen_api_reference.py); this test regenerates
+into a tmp dir and diffs against the committed copy, so a public signature or
+docstring change without a doc regeneration fails CI with a actionable
+message.  It also caps the number of undocumented public symbols at zero.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "docs"))
+
+
+def test_committed_api_docs_are_current(tmp_path):
+    from gen_api_reference import generate
+
+    committed_dir = os.path.join(REPO, "docs", "api")
+    assert os.path.isdir(committed_dir), "docs/api missing - run" \
+        " python docs/gen_api_reference.py"
+    written = generate(str(tmp_path))
+    fresh = {os.path.basename(p) for p in written}
+    committed = {n for n in os.listdir(committed_dir) if n.endswith(".md")}
+    assert fresh == committed, (
+        "docs/api file set is stale - run python docs/gen_api_reference.py")
+    stale = []
+    for name in sorted(fresh):
+        with open(tmp_path / name) as f:
+            new = f.read()
+        with open(os.path.join(committed_dir, name)) as f:
+            old = f.read()
+        if new != old:
+            stale.append(name)
+    assert not stale, (f"docs/api is stale for {stale} - run"
+                       " python docs/gen_api_reference.py")
+
+
+def test_every_public_symbol_is_documented():
+    committed_dir = os.path.join(REPO, "docs", "api")
+    undocumented = []
+    for name in sorted(os.listdir(committed_dir)):
+        if not name.endswith(".md"):
+            continue
+        with open(os.path.join(committed_dir, name)) as f:
+            text = f.read()
+        count = text.count("*(undocumented)*")
+        if count:
+            undocumented.append((name, count))
+    assert not undocumented, (
+        f"public symbols missing docstrings: {undocumented}")
