@@ -114,6 +114,13 @@ class JaxDataLoader:
         #: fields arriving as raw jpeg bytes (reader decode_placement='device');
         #: decoded on-chip in _emit via ops/jpeg.decode_coefficients
         self._device_decode = list(getattr(reader, "device_decode_fields", ()) or ())
+        #: subset using the mixed-geometry wire format ('device-mixed'):
+        #: decoded per geometry bucket, padded to a static target
+        self._mixed_decode = frozenset(
+            getattr(reader, "device_decode_mixed", ()) or ())
+        #: geometries seen per mixed field (diagnostics; tests assert the
+        #: decode compile count stays bounded by this set's size)
+        self._mixed_geometries: Dict[str, set] = {}
 
         # output_schema describes the columns iter_batches actually yields
         # (differs from reader.schema for ngram readers)
@@ -216,8 +223,41 @@ class JaxDataLoader:
 
     # -- shape/sharding bookkeeping ------------------------------------------
 
+    def _mixed_target(self, name: str) -> Tuple[int, ...]:
+        """Static (H, W[, C]) every decoded image of a 'device-mixed' field is
+        padded/cropped to: the schema shape when fixed, else a SINGLE
+        pad_shapes target (XLA compiles the fit once per geometry x target,
+        so the target must be static)."""
+        field = self._schema[name]
+        if field.is_fixed_shape:
+            return tuple(field.shape)
+        buckets = self._pad_shapes.get(name)
+        if not buckets or len(buckets) != 1:
+            raise PetastormTpuError(
+                f"decode_placement='device-mixed' field {name!r} has variable"
+                f" shape {field.shape}: give it ONE pad_shapes target (H, W"
+                "[, C]) so every geometry bucket decodes+pads to a static"
+                " shape" + (f"; got {len(buckets)} buckets" if buckets else ""))
+        target = tuple(buckets[0])
+        if len(target) != len(field.shape):
+            raise PetastormTpuError(
+                f"pad_shapes[{name!r}] target {target} rank differs from the"
+                f" field shape {field.shape}")
+        return target
+
     def _validate_deliverable(self, schema) -> None:
         for name in self._fields:
+            if name in self._mixed_decode:
+                if self._mesh is not None:
+                    raise PetastormTpuError(
+                        "decode_placement='device-mixed' is not supported with"
+                        " a mesh yet: geometry buckets differ per host, which"
+                        " would diverge collective shapes. Decode on one"
+                        " device, or re-encode uniformly"
+                        " (petastorm-tpu-copy-dataset --jpeg-quality) and use"
+                        " decode_placement='device'.")
+                self._mixed_target(name)  # raises when no static target exists
+                continue
             if name in self._device_decode:
                 continue  # raw jpeg bytes in, schema-shaped uint8 out (on-chip)
             field = schema[name]
@@ -374,8 +414,10 @@ class JaxDataLoader:
         valid_rows = host_batch.num_rows
         for name in self._device_decode:
             if name in self._fields:
-                device_batch[name] = self._decode_on_device(
-                    name, host_batch.columns)
+                decode = (self._decode_mixed_on_device
+                          if name in self._mixed_decode
+                          else self._decode_on_device)
+                device_batch[name] = decode(name, host_batch.columns)
         if self._mesh is not None and valid_rows < self._local_rows:
             # partial final batch on a mesh: zero-pad to the static local batch so
             # the global shape (and the consumer's jit signature) never changes -
@@ -425,6 +467,98 @@ class JaxDataLoader:
                 self._tail_batches.append(device_batch)
             return
         self._push(device_batch)
+
+    def _decode_mixed_on_device(self, name: str, columns: Dict[str, np.ndarray]
+                                ) -> jax.Array:
+        """Finish the hybrid decode of a MIXED-geometry field
+        (decode_placement='device-mixed').
+
+        The batch's object cells are re-grouped by jpeg geometry; each
+        geometry bucket's planes are padded to the full batch size (so XLA
+        compiles the on-chip decode exactly once per geometry, never per
+        data-dependent group size), decoded, fitted (pad/crop) to the static
+        target, then scattered back into batch order.  The wasted FLOPs on
+        the padding rows are cheap: the on-chip half is ~0.4 ms per 64
+        images (RESULTS.md on-chip ops table).
+        """
+        import jax.numpy as jnp
+
+        from petastorm_tpu.native.image import (COEF_COLUMN_SEP,
+                                                MIXED_CELL_SUFFIX,
+                                                _layout_from_meta)
+        from petastorm_tpu.ops.jpeg import decode_coefficients
+
+        field = self._schema[name]
+        target = self._mixed_target(name)
+        col = columns[f"{name}{COEF_COLUMN_SEP}{MIXED_CELL_SUFFIX}"]
+        n = len(col)
+        groups: Dict[bytes, list] = {}
+        for i, cell in enumerate(col):
+            groups.setdefault(cell[2].tobytes(), []).append(i)
+        self._mixed_geometries.setdefault(name, set()).update(groups)
+        batch_pad = max(self._local_rows, n)
+        # every bucket stays at the STATIC batch_pad length end to end - no op
+        # in this method ever sees a data-dependent group size, so compiles
+        # are bounded by the distinct geometries (decode/fit) plus the
+        # distinct per-batch geometry-subset sizes (concat/gather)
+        parts = []
+        flat_idx = np.empty(n, dtype=np.int64)
+        for g, (key, idxs) in enumerate(groups.items()):
+            layout = _layout_from_meta(np.frombuffer(key, dtype=np.int32))
+            k = len(idxs)
+            planes = []
+            for c in range(len(layout.components)):
+                stack = np.stack([col[i][0][c] for i in idxs])
+                if k < batch_pad:
+                    stack = np.concatenate(
+                        [stack, np.zeros((batch_pad - k,) + stack.shape[1:],
+                                         stack.dtype)])
+                planes.append(stack)
+            qtabs = np.stack([col[i][1] for i in idxs])
+            if k < batch_pad:
+                qtabs = np.concatenate(
+                    [qtabs, np.ones((batch_pad - k,) + qtabs.shape[1:],
+                                    qtabs.dtype)])
+            sampling = tuple((h, v) for (h, v, _, _) in layout.components)
+            jp, jq = jax.device_put((tuple(planes), qtabs))
+            img = decode_coefficients(jp, jq,
+                                      image_size=(layout.height, layout.width),
+                                      sampling=sampling)
+            if len(target) == 3:
+                if img.ndim == 3:
+                    img = img[..., None]
+                if img.shape[-1] != target[2]:
+                    if img.shape[-1] == 1:
+                        img = jnp.repeat(img, target[2], axis=-1)
+                    else:
+                        raise PetastormTpuError(
+                            f"field {name!r}: a stored jpeg decodes to"
+                            f" {img.shape[-1]}-channel images but the target"
+                            f" {target} wants {target[2]} channel(s); declare"
+                            " a (H, W, 3) shape/target or store grayscale"
+                            " jpegs")
+            elif img.ndim == 4:
+                raise PetastormTpuError(
+                    f"field {name!r}: stored jpeg decodes to"
+                    f" {img.shape[-1]}-channel images but the target {target}"
+                    " is 2-D; declare a (H, W, C) shape/target")
+            # fit to the static target: crop the excess, zero-pad the rest
+            img = img[:, :min(img.shape[1], target[0]),
+                      :min(img.shape[2], target[1])]
+            pad = [(0, 0), (0, target[0] - img.shape[1]),
+                   (0, target[1] - img.shape[2])]
+            if img.ndim == 4:
+                pad.append((0, 0))
+            parts.append(jnp.pad(img, pad))        # (batch_pad, *target)
+            flat_idx[np.asarray(idxs)] = g * batch_pad + np.arange(k)
+        stacked = (jnp.concatenate(parts, axis=0) if len(parts) > 1
+                   else parts[0])
+        # one static-shape gather scatters rows back into batch order and
+        # drops the pad rows in the same pass
+        out = stacked[jnp.asarray(flat_idx)]
+        if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 3:
+            out = out[..., None]
+        return out
 
     def _decode_on_device(self, name: str, columns: Dict[str, np.ndarray]
                           ) -> jax.Array:
@@ -515,6 +649,11 @@ class JaxDataLoader:
                "delivered_batches": self._delivered_batches,
                "consumer_wait_s": self._consumer_wait_s,
                "finished": self._finished}
+        if self._mixed_geometries:
+            # distinct jpeg geometries decoded per 'device-mixed' field: the
+            # on-chip decode compiles once per entry (bounded-compile contract)
+            out["mixed_decode_geometries"] = {
+                name: len(keys) for name, keys in self._mixed_geometries.items()}
         reader_diag = getattr(self._reader, "diagnostics", None)
         if isinstance(reader_diag, dict):
             out["reader"] = reader_diag

@@ -264,10 +264,11 @@ def pack_coef_columns(name: str, column, field=None, nthreads: int = 1) -> dict:
 
 
 _MIXED_GEOMETRY_GUIDANCE = (
-    "the device decode path requires every stored jpeg to share one geometry"
-    " and subsampling (XLA compiles the on-chip decode per geometry);"
-    " re-encode uniformly (petastorm-tpu-copy-dataset re-encodes jpeg fields,"
-    " see --jpeg-quality) or use decode_placement='host'")
+    "decode_placement='device' requires every stored jpeg to share one"
+    " geometry and subsampling (XLA compiles the on-chip decode per geometry)."
+    " Use decode_placement='device-mixed' (per-geometry bucketed on-chip"
+    " decode), re-encode uniformly (petastorm-tpu-copy-dataset --jpeg-quality),"
+    " or use decode_placement='host'")
 
 
 def _diagnose_coef_failure(column, exc) -> str:
@@ -295,6 +296,61 @@ def _diagnose_coef_failure(column, exc) -> str:
     # headers parse and agree: entropy-level corruption, or the simulated
     # failure injected by tests
     return f"{exc}. If the dataset mixes jpeg geometries: {_MIXED_GEOMETRY_GUIDANCE}."
+
+
+#: suffix of the MIXED-geometry wire column: one object cell per row holding
+#: ``(per-component plane tuple, qtab (ncomp, 64), layout-meta int32 vector)``.
+#: Object columns ride batching/shuffle; the shm transport pickles them
+#: (native/transport.py object fallback) - slower than the fixed-shape plane
+#: columns, which stay the uniform-geometry fast path.
+MIXED_CELL_SUFFIX = "x"
+
+
+def pack_coef_columns_mixed(name: str, column, field=None,
+                            nthreads: int = 1) -> dict:
+    """Entropy-decode a jpeg column of MIXED geometries into one object column.
+
+    Worker side of ``decode_placement='device-mixed'``: rows are grouped by
+    coefficient-plane geometry (header parse only), each group entropy-decodes
+    through the batched GIL-released C call, and every row becomes one object
+    cell ``(planes, qtab, meta)``.  The jax loader re-groups the assembled
+    batch by geometry and runs the on-chip half once per geometry bucket
+    (petastorm_tpu/ops/jpeg.py), so XLA compiles are bounded by the number of
+    distinct geometries in the dataset.
+
+    A fixed-shape schema field must match every stored geometry; declare
+    wildcard dims (e.g. ``(None, None, 3)``) for genuinely mixed datasets.
+    """
+    from petastorm_tpu.errors import CodecError
+
+    cells = (list(column) if isinstance(column, (list, tuple))
+             else column.to_pylist())
+    if not cells:
+        raise CodecError(f"field {name!r}: empty jpeg column")
+    groups: dict = {}
+    for i, buf in enumerate(cells):
+        try:
+            layout = jpeg_coef_layout(bytes(buf))
+        except CodecError as exc:
+            raise CodecError(
+                f"decode_placement='device-mixed' field {name!r}: cell {i} is"
+                f" not a decodable jpeg (corrupt or truncated stream): {exc}"
+            ) from exc
+        if field is not None and field.is_fixed_shape and (
+                layout.height, layout.width) != tuple(field.shape[:2]):
+            raise CodecError(
+                f"field {name!r}: stored jpeg is {layout.height}x{layout.width},"
+                f" schema says {tuple(field.shape[:2])}; declare wildcard dims"
+                " (None, None, ...) for mixed-geometry datasets")
+        groups.setdefault(_layout_meta(layout).tobytes(), []).append(i)
+    out = np.empty(len(cells), dtype=object)
+    for key, idxs in groups.items():
+        planes, qtabs, layout = read_jpeg_coefficients_column(
+            [cells[i] for i in idxs], nthreads=nthreads)
+        meta = np.frombuffer(key, dtype=np.int32)
+        for j, i in enumerate(idxs):
+            out[i] = (tuple(p[j] for p in planes), qtabs[j], meta)
+    return {f"{name}{COEF_COLUMN_SEP}{MIXED_CELL_SUFFIX}": out}
 
 
 def unpack_coef_columns(name: str, columns: dict):

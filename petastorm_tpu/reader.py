@@ -90,7 +90,10 @@ def make_reader(dataset_url: str,
     decode on-chip: the workers run only the entropy half and ship coefficient
     planes, which ONLY ``petastorm_tpu.jax.JaxDataLoader`` can finish - row
     iteration and the torch/tf adapters refuse such readers (they would see
-    planes, not pixels).  Requires uniform jpeg geometry across the dataset.
+    planes, not pixels).  ``'device'`` requires uniform jpeg geometry across
+    the dataset (one XLA compile); ``'device-mixed'`` supports mixed
+    geometries/subsamplings via per-geometry bucketed decode (compiles
+    bounded by the number of distinct geometries; single-device loaders).
 
     ``io_retries``: transient remote-IO policy (petastorm_tpu.retry).
     ``'auto'`` = bounded retry-with-backoff on remote filesystems (GCS/S3/
@@ -302,9 +305,9 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     fs_factory = FilesystemFactory(dataset_url if isinstance(dataset_url, str)
                                    else dataset_url[0], storage_options,
                                    filesystem=filesystem)
-    device_fields = _validate_decode_placement(decode_placement, full_schema,
-                                               read_fields, transform_spec,
-                                               ngram, worker_predicate)
+    device_fields, mixed_fields = _validate_decode_placement(
+        decode_placement, full_schema, read_fields, transform_spec,
+        ngram, worker_predicate)
     from petastorm_tpu.retry import resolve_retry_policy
 
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
@@ -313,6 +316,7 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    ngram=ngram, ngram_schema=ngram_schema,
                                    verify_checksums=verify_checksums,
                                    raw_fields=device_fields,
+                                   mixed_raw_fields=mixed_fields,
                                    retry_policy=resolve_retry_policy(
                                        io_retries, info.filesystem))
 
@@ -347,30 +351,38 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                     start_item=start_item, ngram=ngram)
     #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
     reader.device_decode_fields = device_fields
+    #: subset using the mixed-geometry object wire format ('device-mixed')
+    reader.device_decode_mixed = mixed_fields
     return reader
 
 
 def _validate_decode_placement(decode_placement, schema, read_fields,
-                               transform_spec, ngram, predicate=None) -> list:
-    """Check a decode_placement mapping; returns the 'device' field names.
+                               transform_spec, ngram, predicate=None) -> tuple:
+    """Check a decode_placement mapping; returns (device fields, mixed subset).
 
     Device placement = the pool worker runs only libjpeg's entropy decode and
-    ships fixed-shape coefficient-plane columns; the jax loader runs the
-    FLOP-heavy rest (dequant + IDCT + upsample + color) on the TPU
-    (ops/jpeg.py).  Requires uniform jpeg geometry/subsampling across the
-    dataset (XLA compiles the on-chip decode once per geometry).
+    ships coefficient planes; the jax loader runs the FLOP-heavy rest
+    (dequant + IDCT + upsample + color) on the TPU (ops/jpeg.py).
+
+    ``'device'`` is the uniform-geometry fast path: fixed-shape plane columns
+    (batch/shuffle/shm as ordinary arrays), one XLA compile for the whole
+    dataset.  ``'device-mixed'`` supports datasets mixing jpeg geometries/
+    subsamplings: rows travel as object cells and the loader decodes each
+    geometry bucket on-chip separately (compiles bounded by the number of
+    distinct geometries; see JaxDataLoader for the pad-target contract).
     """
     if not decode_placement:
-        return []
+        return [], frozenset()
     from petastorm_tpu.codecs import CompressedImageCodec
     from petastorm_tpu.native import image as native_image
 
     device_fields = []
+    mixed_fields = set()
     for name, place in decode_placement.items():
-        if place not in ("host", "device"):
+        if place not in ("host", "device", "device-mixed"):
             raise PetastormTpuError(
-                f"decode_placement[{name!r}] must be 'host' or 'device',"
-                f" got {place!r}")
+                f"decode_placement[{name!r}] must be 'host', 'device' or"
+                f" 'device-mixed', got {place!r}")
         if name not in schema:
             raise PetastormTpuError(f"decode_placement field {name!r} not in"
                                     f" schema {[f.name for f in schema]}")
@@ -378,7 +390,7 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
             continue
         if not native_image.available():
             raise PetastormTpuError(
-                "decode_placement='device' needs the native image library"
+                f"decode_placement={place!r} needs the native image library"
                 " (petastorm_tpu/native/image_decode.cpp failed to build on"
                 " this host); use host decode")
         field = schema[name]
@@ -386,43 +398,46 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
         if not (isinstance(codec, CompressedImageCodec)
                 and codec.image_codec == "jpeg"):
             raise PetastormTpuError(
-                f"decode_placement='device' requires a jpeg"
+                f"decode_placement={place!r} requires a jpeg"
                 f" CompressedImageCodec field; {name!r} has"
                 f" {type(codec).__name__}"
                 + (f"({codec.image_codec})" if isinstance(
                     codec, CompressedImageCodec) else "")
                 + ". PNG's deflate stream cannot be entropy-split for on-chip"
                 " decode - store images as jpeg for device decode.")
-        if not field.is_fixed_shape:
+        if place == "device" and not field.is_fixed_shape:
             raise PetastormTpuError(
                 f"decode_placement='device' field {name!r} needs a fixed shape"
-                f" (got {field.shape}): XLA compiles per geometry")
+                f" (got {field.shape}): XLA compiles per geometry. For"
+                " mixed-geometry datasets use decode_placement='device-mixed'")
         if (len(field.shape) not in (2, 3)
                 or (len(field.shape) == 3 and field.shape[2] not in (1, 3))):
             raise PetastormTpuError(
-                f"decode_placement='device' field {name!r} must be (H, W),"
+                f"decode_placement={place!r} field {name!r} must be (H, W),"
                 f" (H, W, 1) or (H, W, 3); got {field.shape}")
         if ngram is not None:
             raise PetastormTpuError(
-                "decode_placement='device' is not supported with ngram readers")
+                f"decode_placement={place!r} is not supported with ngram readers")
         if transform_spec is not None:
             raise PetastormTpuError(
-                "decode_placement='device' cannot be combined with a"
+                f"decode_placement={place!r} cannot be combined with a"
                 " transform_spec: the transform would see raw jpeg bytes, not"
                 " pixels. Decode on host, or transform on device after the"
                 " loader.")
         if predicate is not None and name in predicate.get_fields():
             raise PetastormTpuError(
-                f"predicate field {name!r} uses decode_placement='device':"
+                f"predicate field {name!r} uses decode_placement={place!r}:"
                 " the predicate would see coefficient planes, not pixels."
                 " Decode it on host, or predicate on other fields.")
         if name not in read_fields:
             raise PetastormTpuError(
-                f"decode_placement='device' field {name!r} is not being read"
+                f"decode_placement={place!r} field {name!r} is not being read"
                 " (excluded by schema_fields); drop it from decode_placement"
                 " or add it to schema_fields")
         device_fields.append(name)
-    return device_fields
+        if place == "device-mixed":
+            mixed_fields.add(name)
+    return device_fields, frozenset(mixed_fields)
 
 
 class Reader:
@@ -453,6 +468,8 @@ class Reader:
         self.last_row_consumed = False
         #: set by make_reader after construction (decode_placement='device')
         self.device_decode_fields: list = []
+        #: subset using the mixed-geometry wire format ('device-mixed')
+        self.device_decode_mixed: frozenset = frozenset()
 
         self._start_item = start_item
         self._consumed_items = 0

@@ -49,6 +49,8 @@ class WeightedSamplingReader:
         #: agree (mixing a planes stream with a pixels stream cannot batch)
         self.device_decode_fields = list(
             getattr(first, "device_decode_fields", ()) or ())
+        self.device_decode_mixed = frozenset(
+            getattr(first, "device_decode_mixed", ()) or ())
         for r in readers[1:]:
             if r.batched_output != self.batched_output:
                 raise PetastormTpuError("All readers must share batched_output mode")
@@ -60,12 +62,15 @@ class WeightedSamplingReader:
                 raise PetastormTpuError(
                     f"Schema mismatch: {list(r.schema.fields)} vs"
                     f" {list(self.schema.fields)}")
-            if list(getattr(r, "device_decode_fields", ()) or ()) != \
-                    self.device_decode_fields:
+            if (list(getattr(r, "device_decode_fields", ()) or ())
+                    != self.device_decode_fields
+                    or frozenset(getattr(r, "device_decode_mixed", ()) or ())
+                    != self.device_decode_mixed):
                 raise PetastormTpuError(
                     "All readers must share the same decode_placement: one"
                     f" ships {self.device_decode_fields or 'pixels'} and"
-                    f" another {getattr(r, 'device_decode_fields', []) or 'pixels'}")
+                    f" another {getattr(r, 'device_decode_fields', []) or 'pixels'}"
+                    " (mixed-geometry mode must also match)")
 
     @property
     def last_row_consumed(self) -> bool:

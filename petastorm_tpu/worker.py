@@ -56,6 +56,7 @@ class RowGroupDecoderWorker:
                  ngram_schema: Optional[Schema] = None,
                  verify_checksums: bool = False,
                  raw_fields: Sequence[str] = (),
+                 mixed_raw_fields: Sequence[str] = (),
                  retry_policy=None):
         self._fs_factory = fs_factory
         self._schema = schema
@@ -73,6 +74,9 @@ class RowGroupDecoderWorker:
         #: fields delivered as raw encoded bytes (codec decode skipped) -
         #: decode_placement='device': the jax loader decodes them on-chip
         self._raw_fields = frozenset(raw_fields)
+        #: subset shipping the mixed-geometry object wire format
+        #: (decode_placement='device-mixed')
+        self._mixed_raw_fields = frozenset(mixed_raw_fields)
 
     # -- factory protocol -----------------------------------------------------
 
@@ -190,7 +194,8 @@ class RowGroupDecoderWorker:
         # plane columns); bump it whenever that format changes, or a warm
         # persistent cache from an older version poisons the pipeline
         tag = (",".join(self._read_fields)
-               + "|rawcoef1:" + ",".join(sorted(self._raw_fields)))
+               + "|rawcoef1:" + ",".join(sorted(self._raw_fields))
+               + "|mixedcoef1:" + ",".join(sorted(self._mixed_raw_fields)))
         fields_tag = hashlib.md5(tag.encode()).hexdigest()[:8]
         return (f"{self._cache_prefix}:{item.row_group.path}:{item.row_group.row_group}"
                 f":{start}:{stop}:{fields_tag}")
@@ -228,14 +233,19 @@ class RowGroupDecoderWorker:
             field = self._schema[name]
             chunk = table.column(name).combine_chunks()
             if name in self._raw_fields:
-                # decode_placement='device': run the entropy half HERE, in the
-                # pool worker, and ship fixed-shape coefficient planes (which
-                # batch/shuffle/shm-transport like ordinary columns); the
-                # FLOP-heavy IDCT+upsample+color runs on-chip in the jax
-                # loader.  Parallelism comes from the pool, so nthreads=1.
-                from petastorm_tpu.native.image import pack_coef_columns
+                # decode_placement='device[-mixed]': run the entropy half
+                # HERE, in the pool worker; the FLOP-heavy
+                # IDCT+upsample+color runs on-chip in the jax loader.
+                # 'device' ships fixed-shape coefficient planes (which
+                # batch/shuffle/shm-transport like ordinary columns);
+                # 'device-mixed' ships per-row object cells grouped by
+                # geometry.  Parallelism comes from the pool, so nthreads=1.
+                from petastorm_tpu.native.image import (pack_coef_columns,
+                                                        pack_coef_columns_mixed)
 
-                columns.update(pack_coef_columns(name, chunk, field))
+                pack = (pack_coef_columns_mixed
+                        if name in self._mixed_raw_fields else pack_coef_columns)
+                columns.update(pack(name, chunk, field))
             else:
                 columns[name] = field.codec.decode_column(field, chunk)
         pvals = dict(item.row_group.partition_values)

@@ -227,7 +227,8 @@ def test_device_decode_rejects_png(tmp_path):
     write_dataset(url, schema, [{"image": _smooth_rgb(16, 16)}])
     with pytest.raises(PetastormTpuError, match="jpeg"):
         make_batch_reader(url, decode_placement={"image": "device"})
-    with pytest.raises(PetastormTpuError, match="'host' or 'device'"):
+    with pytest.raises(PetastormTpuError,
+                       match="'host', 'device' or 'device-mixed'"):
         make_batch_reader(url, decode_placement={"image": "chip"})
 
 

@@ -1,0 +1,172 @@
+"""decode_placement='device-mixed': on-chip decode of MIXED jpeg geometries.
+
+Lifts the uniform-geometry restriction of the 'device' fast path (VERDICT
+round-2 item 3): rows are grouped by (H, W, subsampling), each geometry
+bucket decodes on-chip with its planes padded to the full batch size - so
+XLA compiles the decode exactly once per geometry, never per data-dependent
+group size - then every image is padded/cropped to one static target.
+
+Reference analog: the host decode handles any geometry per cell
+(petastorm/codecs.py:92-118); this gets the same generality on the device
+path.
+"""
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from petastorm_tpu.errors import PetastormTpuError  # noqa: E402
+from petastorm_tpu.native import image as native_image  # noqa: E402
+
+if not native_image.available():
+    pytest.skip("native image library unavailable", allow_module_level=True)
+
+from petastorm_tpu.codecs import CompressedImageCodec  # noqa: E402
+from petastorm_tpu.etl.writer import write_dataset  # noqa: E402
+from petastorm_tpu.jax import JaxDataLoader  # noqa: E402
+from petastorm_tpu.reader import make_batch_reader  # noqa: E402
+from petastorm_tpu.schema import Field, Schema  # noqa: E402
+
+from tests.test_jpeg_hybrid import _cv2_decode, _encode, _smooth_rgb  # noqa: E402
+
+#: three geometries, interleaved so single rowgroups mix them
+GEOMETRIES = [(64, 96), (48, 64), (32, 32)]
+TARGET = (64, 96, 3)
+N_ROWS = 24
+
+
+@pytest.fixture(scope="module")
+def mixed_ds(tmp_path_factory):
+    schema = Schema("MixedGeo", [
+        Field("idx", np.int64),
+        Field("image", np.uint8, (None, None, 3),
+              CompressedImageCodec("jpeg", quality=92)),
+    ])
+    rows = []
+    for i in range(N_ROWS):
+        h, w = GEOMETRIES[i % len(GEOMETRIES)]
+        rows.append({"idx": i, "image": _smooth_rgb(h, w, seed=i)})
+    url = str(tmp_path_factory.mktemp("mixed_geo") / "ds")
+    write_dataset(url, schema, rows, row_group_size_rows=6)
+    return url
+
+
+def test_mixed_decode_matches_host_decode(mixed_ds, monkeypatch):
+    """Every geometry decodes on-device to within the hybrid-decode pixel
+    contract of its host decode, padded to the static target - and the
+    on-chip decode sees a BOUNDED set of shapes (one per geometry)."""
+    import petastorm_tpu.ops.jpeg as ops_jpeg
+
+    signatures = set()
+    real = ops_jpeg.decode_coefficients
+
+    def recording(planes, qtabs, image_size, sampling, **kw):
+        signatures.add((tuple(p.shape for p in planes), image_size, sampling))
+        return real(planes, qtabs, image_size=image_size, sampling=sampling, **kw)
+
+    monkeypatch.setattr(ops_jpeg, "decode_coefficients", recording)
+
+    with make_batch_reader(mixed_ds, shuffle_row_groups=False, num_epochs=2,
+                           decode_placement={"image": "device-mixed"}) as r:
+        assert r.device_decode_mixed == frozenset({"image"})
+        with JaxDataLoader(r, batch_size=8, fields=["idx", "image"],
+                           pad_shapes={"image": TARGET}) as loader:
+            got = {}
+            for b in loader:
+                imgs = np.asarray(b["image"])
+                assert imgs.shape == (8,) + TARGET and imgs.dtype == np.uint8
+                for k, i in enumerate(np.asarray(b["idx"])):
+                    got.setdefault(int(i), []).append(imgs[k])
+            diag = loader.diagnostics
+    assert sorted(got) == list(range(N_ROWS))
+    assert all(len(v) == 2 for v in got.values())  # both epochs delivered
+
+    # bounded compiles: one decode signature per geometry, across 2 epochs
+    # and 6 batches (data-dependent group sizes are padded away)
+    assert len(signatures) == len(GEOMETRIES)
+    assert diag["mixed_decode_geometries"] == {"image": len(GEOMETRIES)}
+
+    for i in range(N_ROWS):
+        h, w = GEOMETRIES[i % len(GEOMETRIES)]
+        ref = _cv2_decode(_encode(_smooth_rgb(h, w, seed=i), quality=92))
+        dev = got[i][0]
+        diff = np.abs(ref.astype(int) - dev[:h, :w].astype(int))
+        assert diff.max() <= 6 and diff.mean() < 1.0, f"idx {i} ({h}x{w})"
+        # the pad region is exactly zero
+        assert dev[h:].sum() == 0 and dev[:, w:].sum() == 0
+
+
+def test_mixed_subsampling_within_one_size(tmp_path):
+    """Same pixel size but different chroma subsampling = different
+    coefficient geometry; both must decode in one dataset."""
+    s444 = getattr(cv2, "IMWRITE_JPEG_SAMPLING_FACTOR_444", None)
+    if s444 is None:
+        pytest.skip("cv2 build lacks sampling-factor control")
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.etl.writer import stamp_dataset_metadata
+
+    schema = Schema("MixedSamp", [
+        Field("idx", np.int64),
+        Field("image", np.uint8, (32, 32, 3), CompressedImageCodec("jpeg"))])
+    bufs = [_encode(_smooth_rgb(32, 32, seed=i),
+                    sampling=(s444 if i % 2 else None)) for i in range(8)]
+    url = str(tmp_path / "ds")
+    os.makedirs(url)
+    table = pa.Table.from_pylist(
+        [{"idx": i, "image": b} for i, b in enumerate(bufs)],
+        schema=schema.as_arrow_schema())
+    pq.write_table(table, os.path.join(url, "part-00000.parquet"),
+                   row_group_size=4)
+    stamp_dataset_metadata(url, schema)
+
+    with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
+                           decode_placement={"image": "device-mixed"}) as r:
+        # fixed schema shape: the target comes from the schema, no pad_shapes
+        with JaxDataLoader(r, batch_size=4, fields=["idx", "image"]) as loader:
+            batches = list(loader)
+            diag = loader.diagnostics
+    assert diag["mixed_decode_geometries"] == {"image": 2}
+    by_idx = {int(i): np.asarray(b["image"])[k]
+              for b in batches for k, i in enumerate(np.asarray(b["idx"]))}
+    for i in range(8):
+        ref = _cv2_decode(bufs[i])
+        diff = np.abs(ref.astype(int) - by_idx[i].astype(int))
+        assert diff.max() <= 6 and diff.mean() < 1.0
+
+
+def test_mixed_requires_static_target(mixed_ds):
+    with make_batch_reader(mixed_ds, num_epochs=1,
+                           decode_placement={"image": "device-mixed"}) as r:
+        with pytest.raises(PetastormTpuError, match="ONE pad_shapes target"):
+            JaxDataLoader(r, batch_size=8, fields=["idx", "image"])
+        with pytest.raises(PetastormTpuError, match="ONE pad_shapes target"):
+            JaxDataLoader(r, batch_size=8, fields=["idx", "image"],
+                          pad_shapes={"image": [(32, 32, 3), (64, 96, 3)]})
+
+
+def test_mixed_rejected_on_mesh(mixed_ds):
+    import jax
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    with make_batch_reader(mixed_ds, num_epochs=1,
+                           decode_placement={"image": "device-mixed"}) as r:
+        with pytest.raises(PetastormTpuError, match="not supported with"
+                                                    " a mesh"):
+            JaxDataLoader(r, batch_size=8, mesh=mesh,
+                          shardings=PartitionSpec("data"),
+                          fields=["idx", "image"],
+                          pad_shapes={"image": TARGET})
+
+
+def test_uniform_device_path_still_guides_to_mixed(mixed_ds):
+    """The uniform 'device' path on a mixed dataset keeps failing loudly,
+    now pointing at 'device-mixed'."""
+    with pytest.raises(PetastormTpuError, match="device-mixed"):
+        make_batch_reader(mixed_ds, num_epochs=1,
+                          decode_placement={"image": "device"})
