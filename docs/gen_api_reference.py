@@ -45,7 +45,8 @@ API = [
     ("petastorm_tpu.jax.device_buffer", ["DeviceShufflingBuffer"]),
     ("petastorm_tpu.pytorch", ["DataLoader", "BatchedDataLoader"]),
     ("petastorm_tpu.tf", ["make_petastorm_dataset", "tf_tensors"]),
-    ("petastorm_tpu.spark", ["dataset_as_rdd"]),
+    ("petastorm_tpu.spark", ["dataset_as_rdd", "as_spark_schema",
+                             "dict_to_spark_row", "decode_row"]),
     ("petastorm_tpu.converter", ["make_converter", "DatasetConverter"]),
     ("petastorm_tpu.etl.writer", ["write_dataset", "materialize_dataset",
                                   "stamp_dataset_metadata"]),

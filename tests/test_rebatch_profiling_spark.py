@@ -229,3 +229,106 @@ def test_dataset_as_rdd_decodes_rows(tmp_path, monkeypatch):
     for i, row in out.items():
         np.testing.assert_array_equal(row.matrix, src[i]["matrix"])
         assert not hasattr(row, "image_png")  # subset honored
+
+
+def _install_fake_spark_types(monkeypatch):
+    """Minimal pyspark.sql.types/Row mock pinned to the classes
+    as_spark_schema/dict_to_spark_row use (pyspark 3.5 names)."""
+    root = types.ModuleType("pyspark")
+    sql = types.ModuleType("pyspark.sql")
+    t = types.ModuleType("pyspark.sql.types")
+
+    class _Type:
+        def __init__(self, *a):
+            self.args = a
+
+        def __eq__(self, other):
+            return type(self) is type(other) and self.args == other.args
+
+        def __repr__(self):
+            return type(self).__name__
+
+    for name in ("BinaryType", "StringType", "BooleanType", "ByteType",
+                 "ShortType", "IntegerType", "LongType", "FloatType",
+                 "DoubleType", "DateType", "TimestampType", "DecimalType"):
+        setattr(t, name, type(name, (_Type,), {}))
+
+    class ArrayType(_Type):
+        def __init__(self, element):
+            super().__init__(element)
+
+    class StructField(_Type):
+        def __init__(self, name, data_type, nullable=True):
+            super().__init__(name, data_type, nullable)
+            self.name, self.dataType, self.nullable = name, data_type, nullable
+
+    class StructType(_Type):
+        def __init__(self, fields):
+            super().__init__(tuple(fields))
+            self.fields = list(fields)
+
+    t.ArrayType, t.StructField, t.StructType = ArrayType, StructField, StructType
+
+    class Row:
+        def __init__(self, **kw):
+            self._kw = kw
+
+        def asDict(self):
+            return dict(self._kw)
+
+    sql.types = t
+    sql.Row = Row
+    for name, mod in (("pyspark", root), ("pyspark.sql", sql),
+                      ("pyspark.sql.types", t)):
+        monkeypatch.setitem(sys.modules, name, mod)
+    return t, Row
+
+
+def test_as_spark_schema_maps_storage_types(monkeypatch):
+    from petastorm_tpu import spark as spark_mod
+    from petastorm_tpu.codecs import (CompressedImageCodec, NdarrayCodec,
+                                      ScalarCodec)
+    from petastorm_tpu.schema import Field, Schema
+
+    t, _ = _install_fake_spark_types(monkeypatch)
+    schema = Schema("S", [
+        Field("id", np.int64, (), ScalarCodec()),
+        Field("name", np.str_, (), ScalarCodec(), nullable=True),
+        Field("img", np.uint8, (8, 8, 3), CompressedImageCodec("png")),
+        Field("vec", np.float32, (4,), NdarrayCodec()),
+        Field("flag", np.bool_, (), ScalarCodec()),
+        Field("small", np.uint8, (), ScalarCodec()),
+    ])
+    st = spark_mod.as_spark_schema(schema)
+    by_name = {f.name: f for f in st.fields}
+    assert type(by_name["id"].dataType).__name__ == "LongType"
+    assert type(by_name["name"].dataType).__name__ == "StringType"
+    assert by_name["name"].nullable and not by_name["id"].nullable
+    assert type(by_name["img"].dataType).__name__ == "BinaryType"
+    assert type(by_name["vec"].dataType).__name__ == "BinaryType"
+    assert type(by_name["flag"].dataType).__name__ == "BooleanType"
+    # Spark has no unsigned: uint8 widens to ShortType
+    assert type(by_name["small"].dataType).__name__ == "ShortType"
+
+
+def test_dict_to_spark_row_encodes_and_validates(monkeypatch):
+    from petastorm_tpu import spark as spark_mod
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.errors import SchemaError
+    from petastorm_tpu.schema import Field, Schema
+
+    _install_fake_spark_types(monkeypatch)
+    schema = Schema("S", [
+        Field("id", np.int64, (), ScalarCodec()),
+        Field("vec", np.float32, (3,), NdarrayCodec()),
+        Field("opt", np.float64, (), ScalarCodec(), nullable=True),
+    ])
+    row = spark_mod.dict_to_spark_row(
+        schema, {"id": 7, "vec": np.ones(3, np.float32)})
+    d = row.asDict()
+    assert d["id"] == 7 and isinstance(d["vec"], bytes) and d["opt"] is None
+    # the encoded bytes round-trip through the codec
+    back = schema["vec"].codec.decode(schema["vec"], d["vec"])
+    np.testing.assert_array_equal(back, np.ones(3, np.float32))
+    with pytest.raises(SchemaError, match="not nullable"):
+        spark_mod.dict_to_spark_row(schema, {"id": None, "vec": np.ones(3, np.float32)})
