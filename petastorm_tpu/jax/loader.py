@@ -76,6 +76,13 @@ class JaxDataLoader:
     With ``drop_last=False`` on a mesh, the final partial batch is zero-padded to
     the static batch size (constant shapes = no XLA recompile, even shards) and
     carries an extra ``'_valid_rows'`` host int with the true row count.
+
+    ``valid_mask_field='mask'`` (mesh only) adds a synthetic 1-D float32 device
+    column: 1.0 for real rows, 0.0 for padding, sharded exactly like the data
+    fields' batch axis.  Being a global array it is identical on every host -
+    weight per-example losses by it instead of branching on the host-local
+    ``'_valid_rows'`` (which differs across hosts on drained pads and would
+    diverge pod control flow; see ``drain()``).
     """
 
     def __init__(self,
@@ -97,7 +104,8 @@ class JaxDataLoader:
                                                  Dict[str, np.ndarray]]] = None,
                  trace_dir: Optional[str] = None,
                  device_shuffle_capacity: int = 0,
-                 device_shuffle_seed: Optional[int] = None):
+                 device_shuffle_seed: Optional[int] = None,
+                 valid_mask_field: Optional[str] = None):
         self._reader = reader
         self._mesh = mesh
         self._specs = shardings
@@ -121,6 +129,13 @@ class JaxDataLoader:
         #: geometries seen per mixed field (diagnostics; tests assert the
         #: decode compile count stays bounded by this set's size)
         self._mixed_geometries: Dict[str, set] = {}
+        #: (field, h, w) geometries already warned about as missing from the
+        #: dataset-level declared-geometry contract (one warning each)
+        self._geom_warned: set = set()
+        #: the contract is immutable for an open reader: parse the KV JSON
+        #: once here, not per decoded geometry group on the hot path
+        self._declared_geometries: Dict = (
+            getattr(reader, "declared_geometries", None) or {})
 
         # output_schema describes the columns iter_batches actually yields
         # (differs from reader.schema for ngram readers)
@@ -143,6 +158,27 @@ class JaxDataLoader:
             raise PetastormTpuError(
                 "JaxDataLoader needs at least one device-deliverable field"
                 " (all schema fields were excluded or routed to host_fields)")
+
+        #: synthetic per-row validity column (1.0 = real row, 0.0 = padding).
+        #: Unlike the host-local '_valid_rows' int, the mask is a GLOBAL device
+        #: array assembled like any data field, so every host of a pod holds
+        #: the same logical values - the only safe signal to weight losses by
+        #: under collectives, where branching on host-local '_valid_rows'
+        #: diverges control flow across hosts and hangs the pod (see drain())
+        self._valid_mask = valid_mask_field
+        if valid_mask_field is not None:
+            if mesh is None:
+                raise PetastormTpuError(
+                    "valid_mask_field only applies to mesh delivery: without a"
+                    " mesh no zero-padding happens, every delivered row is real")
+            if valid_mask_field in schema:
+                raise PetastormTpuError(
+                    f"valid_mask_field {valid_mask_field!r} collides with a"
+                    " schema field; pick an unused name")
+            if valid_mask_field == "_valid_rows":
+                raise PetastormTpuError(
+                    "valid_mask_field cannot be '_valid_rows': that key is"
+                    " reserved for the host-local valid-row count")
         self._validate_deliverable(schema)
 
         if batch_size < 1:
@@ -248,15 +284,20 @@ class JaxDataLoader:
     def _validate_deliverable(self, schema) -> None:
         for name in self._fields:
             if name in self._mixed_decode:
-                if self._mesh is not None:
-                    raise PetastormTpuError(
-                        "decode_placement='device-mixed' is not supported with"
-                        " a mesh yet: geometry buckets differ per host, which"
-                        " would diverge collective shapes. Decode on one"
-                        " device, or re-encode uniformly"
-                        " (petastorm-tpu-copy-dataset --jpeg-quality) and use"
-                        " decode_placement='device'.")
                 self._mixed_target(name)  # raises when no static target exists
+                if self._mesh is not None:
+                    # mesh delivery works because the decode stays HOST-LOCAL
+                    # (each host compiles only the geometries it encounters -
+                    # bucket sets may differ per host freely) and only the
+                    # decoded pixels are declared a global array afterwards
+                    # (_scatter_local_rows) - so only the batch axis may shard
+                    spec = self._spec_for(name)
+                    if any(ax is not None for ax in spec[1:]):
+                        raise PetastormTpuError(
+                            f"decode_placement='device-mixed' field {name!r}:"
+                            " only the batch axis may be sharded (the decode"
+                            " is host-local; trailing image axes cannot span"
+                            f" hosts). Got spec {spec}.")
                 continue
             if name in self._device_decode:
                 continue  # raw jpeg bytes in, schema-shaped uint8 out (on-chip)
@@ -277,6 +318,13 @@ class JaxDataLoader:
             spec = self._specs.get(name)
         else:
             spec = self._specs
+        if name == self._valid_mask and (
+                not isinstance(self._specs, dict) or name not in self._specs):
+            # the 1-D mask must shard its only axis exactly like the data
+            # fields shard their batch axis, or local row counts diverge
+            base = self._spec_for(self._fields[0]) if self._fields else None
+            return PartitionSpec(
+                base[0] if base is not None and len(base) else None)
         if spec is None:
             axis = self._mesh.axis_names[0] if self._mesh is not None else "data"
             spec = PartitionSpec(axis)
@@ -427,6 +475,10 @@ class JaxDataLoader:
             cols = {name: np.concatenate(
                 [col, np.zeros((pad,) + col.shape[1:], dtype=col.dtype)])
                 for name, col in cols.items()}
+        if self._valid_mask is not None:
+            mask = np.zeros(self._local_rows, np.float32)
+            mask[:valid_rows] = 1.0
+            cols[self._valid_mask] = mask
         staged: Dict[str, np.ndarray] = {}
         for name, col in cols.items():
             arr = np.ascontiguousarray(col)
@@ -505,6 +557,7 @@ class JaxDataLoader:
         flat_idx = np.empty(n, dtype=np.int64)
         for g, (key, idxs) in enumerate(groups.items()):
             layout = _layout_from_meta(np.frombuffer(key, dtype=np.int32))
+            self._check_declared_geometry(name, layout)
             k = len(idxs)
             planes = []
             for c in range(len(layout.components)):
@@ -558,7 +611,67 @@ class JaxDataLoader:
         out = stacked[jnp.asarray(flat_idx)]
         if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 3:
             out = out[..., None]
+        if self._mesh is not None:
+            out = self._scatter_local_rows(name, out, n)
         return out
+
+    def _check_declared_geometry(self, name: str, layout) -> None:
+        """Warn (once per geometry) when a batch reveals an image geometry
+        missing from the dataset-level contract stamped at write time - the
+        compile count is then no longer bounded by the declared set."""
+        shapes = self._declared_geometries.get(name)
+        if not shapes:
+            return  # no contract stamped (e.g. externally-written dataset)
+        # channel count matters too: a grayscale jpeg at a declared color
+        # size is still a NEW decode compile (the contract is shape-level;
+        # subsampling variants within one shape are beyond its resolution)
+        hwc = {(s[0], s[1], s[2] if len(s) > 2 else 1) for s in shapes}
+        seen = (layout.height, layout.width, len(layout.components))
+        key = (name,) + seen
+        if seen not in hwc and key not in self._geom_warned:
+            self._geom_warned.add(key)
+            logger.warning(
+                "field %r: jpeg geometry %s (h, w, channels) is not in the"
+                " dataset's declared geometry contract %s - the on-device"
+                " decode compile count is no longer bounded by the declared"
+                " set; re-stamp it (petastorm-tpu-generate-metadata"
+                " --scan-geometries) after changing the dataset",
+                name, seen, sorted(hwc))
+
+    def _scatter_local_rows(self, name: str, out, n: int) -> jax.Array:
+        """Host-local decoded rows -> one GLOBAL mesh array.
+
+        The mixed-geometry decode is deliberately host-local: each host
+        compiles kernels only for the geometries IT encountered (the
+        dataset-level contract stamped at write time -
+        ``etl.metadata.declared_geometries`` - bounds the total), and bucket
+        sets may differ across hosts without any cross-host agreement,
+        because no collective runs inside the decode.  Mesh delivery is then
+        pure data placement: zero-pad to the static local row count, split
+        across this host's addressable devices, and declare the result a
+        global array (``jax.make_array_from_single_device_arrays`` - no
+        collective, no host round-trip of the decoded pixels).
+        """
+        import jax.numpy as jnp
+
+        if n < self._local_rows:
+            out = jnp.concatenate(
+                [out, jnp.zeros((self._local_rows - n,) + out.shape[1:],
+                                out.dtype)])
+        spec = self._spec_for(name)
+        batch_sharding = NamedSharding(
+            self._mesh, PartitionSpec(spec[0] if len(spec) else None))
+        global_shape = (self._global_batch,) + tuple(out.shape[1:])
+        idx_map = batch_sharding.addressable_devices_indices_map(global_shape)
+        starts = [(sl[0].start or 0) for sl in idx_map.values()]
+        lo = min(starts)
+        shards = []
+        for dev, sl in idx_map.items():
+            a = (sl[0].start or 0) - lo
+            b = (sl[0].stop if sl[0].stop is not None else global_shape[0]) - lo
+            shards.append(jax.device_put(out[a:b], dev))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, batch_sharding, shards)
 
     def _decode_on_device(self, name: str, columns: Dict[str, np.ndarray]
                           ) -> jax.Array:
@@ -654,6 +767,11 @@ class JaxDataLoader:
             # on-chip decode compiles once per entry (bounded-compile contract)
             out["mixed_decode_geometries"] = {
                 name: len(keys) for name, keys in self._mixed_geometries.items()}
+            if self._declared_geometries:
+                # the dataset-level bound those counts must stay under
+                out["declared_geometries"] = {
+                    name: len(shapes)
+                    for name, shapes in self._declared_geometries.items()}
         reader_diag = getattr(self._reader, "diagnostics", None)
         if isinstance(reader_diag, dict):
             out["reader"] = reader_diag
@@ -743,6 +861,18 @@ class JaxDataLoader:
         zero batches carrying ``'_valid_rows': 0`` - every host yields the
         same number of steps.  ``all_gather_counts`` overrides the collective
         (tests; custom coordination).
+
+        ``'_valid_rows'`` is HOST-LOCAL: the same drained step can be a real
+        batch on one host and a pad on another, so a consumer that branches
+        on it (``if _valid_rows == 0: continue``) diverges control flow
+        across the pod and hangs the very collective drain exists to protect.
+        Multi-host consumers must instead construct the loader with
+        ``valid_mask_field=`` and run EVERY drained step, weighting the loss
+        by the mask - a globally-consistent device array (1.0 real row / 0.0
+        pad) assembled like any data field.  Proven for real (separate OS
+        processes, Gloo collectives) by
+        ``petastorm_tpu.parallel.selfcheck`` and
+        ``tests/test_multiprocess_distributed.py``.
 
         With ``drop_last=True`` a final partial batch's rows are dropped
         exactly as they would be at an epoch end; training that checkpoints
@@ -844,6 +974,8 @@ class JaxDataLoader:
         staged = (list(self._emitted_layout)
                   + [n for n in self._device_decode if n in self._fields]
                   if self._emitted_layout else list(self._fields))
+        if self._valid_mask is not None and self._valid_mask not in staged:
+            staged.append(self._valid_mask)
         for name in staged:
             field = self._schema[name] if name in self._schema else None
             emitted = self._emitted_layout.get(name)
@@ -855,8 +987,16 @@ class JaxDataLoader:
                 # last emitted batch here is the same semantics
                 trailing, dtype = emitted
                 sharding, _ = self._placement_cache[(name, trailing)]
+            elif name == self._valid_mask:
+                trailing = ()
+                sharding = NamedSharding(self._mesh, self._spec_for(name))
+                dtype = np.float32
             elif name in self._device_decode:
-                trailing = tuple(field.shape)
+                # mixed fields may declare a variable shape; their static
+                # delivery shape is the fit target, not the schema shape
+                trailing = (self._mixed_target(name)
+                            if name in self._mixed_decode
+                            else tuple(field.shape))
                 sharding = NamedSharding(self._mesh, self._spec_for(name))
                 dtype = np.uint8
             else:

@@ -1,0 +1,535 @@
+"""Real multi-process validation of the multi-host data plane.
+
+Every multi-host claim in this framework ultimately rests on three JAX
+primitives: the ``jax.distributed.initialize`` process topology,
+``jax.make_array_from_process_local_data`` global-batch assembly, and the
+``multihost_utils.process_allgather`` drain alignment.  On a single machine
+those paths are normally only *simulated* - one process pretending to be many
+hosts, which is exactly how the reference simulates sharding too
+(petastorm/tests/test_end_to_end.py:454 runs every "worker" in-process).
+This module executes them for REAL: it launches N separate OS processes on
+the CPU backend (Gloo collectives over localhost), each owning a disjoint
+subset of one shared device mesh, and drives
+
+* sharded reading    - ``shard_options_from_jax()`` resolved per process
+* global assembly    - every batch built with ``jax.process_count() > 1``
+* collective steps   - a jitted masked global mean per step (replicated
+                       output realized on every host)
+* drain alignment    - the REAL ``process_allgather`` branch of
+                       ``JaxDataLoader.drain`` (no injected counts), with
+                       hosts configured to buffer deliberately unequal
+                       amounts so the zero-pad path must fire
+* valid-mask safety  - pads carry a zero ``valid_mask_field`` column and the
+                       collective runs on EVERY drained step (the no-hang
+                       contract; see JaxDataLoader.drain docs)
+* elastic resume     - a second launch under a DIFFERENT process count
+                       resumes from ``elastic_resume()`` of the saved cursors
+
+and verifies, in the launching process, that the rows every process observed
+reconstruct the single-process ground truth row for row, and that phase-1
+consumption plus phase-2 resume cover the dataset exactly once.
+
+Usage (also wired into the driver dry-run and the test suite)::
+
+    from petastorm_tpu.parallel.selfcheck import run_selfcheck
+    report = run_selfcheck(num_processes=2, devices_per_process=2)
+    assert report["ok"], report["failures"]
+
+or from a shell::
+
+    python -m petastorm_tpu.parallel.selfcheck --num-processes 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import re
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+MASK_FIELD = "mask"
+_ID = "id"
+_VALUE = "value"
+_VALUE_DIM = 4
+
+
+def _value_for_ids(ids):
+    import numpy as np
+
+    ids = np.asarray(ids, dtype=np.float32)
+    return np.stack([ids * 0.5, ids - 3.0, ids % 7.0, ids * 0.25],
+                    axis=-1).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# worker side (runs in each spawned process)
+# ---------------------------------------------------------------------------
+
+def _worker_main(args) -> None:
+    # sitecustomize may have imported jax already (axon plugin); the backend is
+    # lazy, so re-asserting the CPU platform before distributed init still works
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=args.coordinator,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id)
+    if args.phase == "pipeline":
+        _worker_pipeline(args)
+    elif args.phase == "resume":
+        _worker_resume(args)
+    else:
+        raise ValueError(f"unknown phase {args.phase!r}")
+
+
+def _worker_pipeline(args) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.parallel.mesh import shard_options_from_jax
+    from petastorm_tpu.reader import make_reader
+
+    pid = jax.process_index()
+    assert jax.process_count() == args.num_processes, (
+        jax.process_count(), args.num_processes)
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices), ("data",))
+    cur, count = shard_options_from_jax()
+    local_rows = args.global_batch * len(jax.local_devices()) // len(devices)
+
+    # DELIBERATELY asymmetric buffering: the higher-ranked process holds a much
+    # deeper in-flight window, so at drain time the hosts have unequal batch
+    # counts and the alignment pad path MUST fire on the shallow host(s)
+    # workers_count=1 pins delivery to plan order (multi-worker pools deliver
+    # in completion order, legitimately nondeterministic) so the launcher can
+    # assert row-for-row equality against its own single-process read
+    reader = make_reader(args.dataset, cur_shard=cur, shard_count=count,
+                         shuffle_row_groups=False, num_epochs=1,
+                         workers_count=1, results_queue_size=2 + 8 * pid)
+    rep = NamedSharding(mesh, P())
+    masked_mean = jax.jit(
+        lambda v, m: (v.sum(axis=1) * m).sum() / jnp.maximum(m.sum(), 1.0),
+        out_shardings=rep)
+
+    batches: List[Dict] = []
+
+    def record(batch, kind):
+        entry = {"kind": kind, "shards": [], "mask": [],
+                 "valid_rows": int(batch.get("_valid_rows", -1)),
+                 "values_match": True}
+        for sh in batch[_ID].addressable_shards:
+            sl = sh.index[0]
+            ids = np.asarray(sh.data).ravel()
+            entry["shards"].append({"start": int(sl.start or 0),
+                                    "stop": int(sl.stop),
+                                    "ids": ids.astype(int).tolist()})
+        for sh in batch[_VALUE].addressable_shards:
+            sl = sh.index[0]
+            ids = next(s["ids"] for s in entry["shards"]
+                       if s["start"] == int(sl.start or 0))
+            vals = np.asarray(sh.data)
+            if entry["valid_rows"] != 0 and not np.allclose(
+                    vals, _value_for_ids(ids)):
+                entry["values_match"] = False
+        for sh in batch[MASK_FIELD].addressable_shards:
+            sl = sh.index[0]
+            entry["mask"].append({"start": int(sl.start or 0),
+                                  "vals": np.asarray(sh.data).ravel().tolist()})
+        batches.append(entry)
+
+    steps = 0
+    means: List[float] = []
+    with JaxDataLoader(reader, batch_size=args.global_batch, mesh=mesh,
+                       shardings={_ID: P("data"), _VALUE: P("data")},
+                       drop_last=False, prefetch=2 + 6 * pid,
+                       valid_mask_field=MASK_FIELD) as loader:
+        it = iter(loader)
+        first = next(it)
+        means.append(float(masked_mean(first[_VALUE], first[MASK_FIELD])))
+        steps += 1
+        record(first, "consumed")
+        time.sleep(args.settle)  # let every host's pipeline buffer to capacity
+
+        drained_real = pad_count = 0
+        for b in loader.drain():  # REAL process_allgather alignment
+            # the contract under pod collectives: run EVERY drained step (the
+            # mask zeroes pad rows out of the loss); branching on the
+            # host-local '_valid_rows' here would hang the other process
+            means.append(float(masked_mean(b[_VALUE], b[MASK_FIELD])))
+            steps += 1
+            if b.get("_valid_rows", local_rows) == 0:
+                pad_count += 1
+                record(b, "drain_pad")
+            else:
+                drained_real += 1
+                record(b, "drain_real")
+        state = loader.state_dict()["reader"]
+
+    real_all = multihost_utils.process_allgather(
+        np.asarray([drained_real], np.int32)).ravel()
+    drain_steps_all = multihost_utils.process_allgather(
+        np.asarray([drained_real + pad_count], np.int32)).ravel()
+    steps_all = multihost_utils.process_allgather(
+        np.asarray([steps], np.int32)).ravel()
+    assert len(set(drain_steps_all.tolist())) == 1, (
+        f"drain alignment broken: per-host drain step counts {drain_steps_all}")
+    assert len(set(steps_all.tolist())) == 1, (
+        f"collective step counts diverged: {steps_all}")
+    assert state.get("ordinal_exact"), state
+
+    with open(os.path.join(args.out, f"state_{pid}.pkl"), "wb") as f:
+        pickle.dump(state, f)
+    report = {
+        "process_id": pid,
+        "process_count": jax.process_count(),
+        "n_devices": len(devices),
+        "n_local_devices": len(jax.local_devices()),
+        "local_rows": local_rows,
+        "cur_shard": cur,
+        "shard_count": count,
+        "drained_real": int(drained_real),
+        "pad_count": int(pad_count),
+        "real_all": real_all.tolist(),
+        "drain_steps_all": drain_steps_all.tolist(),
+        "steps_all": steps_all.tolist(),
+        "means": means,
+        "batches": batches,
+    }
+    with open(os.path.join(args.out, f"worker_{pid}.json"), "w") as f:
+        json.dump(report, f)
+
+
+def _worker_resume(args) -> None:
+    import jax
+
+    from petastorm_tpu.parallel.mesh import shard_options_from_jax
+    from petastorm_tpu.reader import elastic_resume, make_reader
+
+    pid = jax.process_index()
+    with open(args.resume_states, "rb") as f:
+        states = pickle.load(f)
+    token = elastic_resume(states)
+    cur, count = shard_options_from_jax()
+    reader = make_reader(args.dataset, cur_shard=cur, shard_count=count,
+                         shuffle_row_groups=False, num_epochs=1,
+                         workers_count=1, resume_from=token)
+    ids: List[int] = []
+    try:
+        for cb in reader.iter_batches():
+            ids.extend(np.asarray(cb.columns[_ID]).astype(int).tolist())
+    finally:
+        reader.stop()
+        reader.join()
+    with open(os.path.join(args.out, f"resume_{pid}.json"), "w") as f:
+        json.dump({"process_id": pid, "process_count": jax.process_count(),
+                   "ids": ids}, f)
+
+
+# ---------------------------------------------------------------------------
+# launcher side
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(devices_per_process: int) -> Dict[str, str]:
+    import petastorm_tpu
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count="
+                        f"{devices_per_process}").strip()
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(petastorm_tpu.__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _launch(phase: str, num_processes: int, devices_per_process: int,
+            dataset: str, out: str, timeout: float, logs: List[str],
+            extra: Optional[List[str]] = None) -> Optional[str]:
+    """Spawn one worker per process, wait, return an error string or None."""
+    port = _free_port()
+    env = _worker_env(devices_per_process)
+    procs = []
+    for pid in range(num_processes):
+        log_path = os.path.join(out, f"{phase}_{pid}.log")
+        logs.append(log_path)
+        log = open(log_path, "w")
+        cmd = [sys.executable, "-m", "petastorm_tpu.parallel.selfcheck",
+               "--worker", "--phase", phase,
+               "--process-id", str(pid),
+               "--num-processes", str(num_processes),
+               "--coordinator", f"127.0.0.1:{port}",
+               "--dataset", dataset, "--out", out] + (extra or [])
+        procs.append((subprocess.Popen(cmd, env=env, stdout=log, stderr=log),
+                      log))
+    deadline = time.monotonic() + timeout
+    error = None
+    try:
+        for proc, _ in procs:
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                code = proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                error = (f"{phase}: timed out after {timeout:.0f}s"
+                         " (collective hang or machine too slow)")
+                break
+            if code != 0 and error is None:
+                error = f"{phase}: worker exited with code {code}"
+    finally:
+        for proc, log in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            log.close()
+    return error
+
+
+def run_selfcheck(num_processes: int = 2,
+                  devices_per_process: int = 2,
+                  global_batch: int = 8,
+                  n_batches: int = 28,
+                  resume_processes: Optional[int] = 3,
+                  settle: float = 1.5,
+                  timeout: float = 240.0,
+                  workdir: Optional[str] = None) -> Dict:
+    """Run the multi-process data-plane check; return a report dict.
+
+    ``report["ok"]`` is True when every invariant held; ``report["failures"]``
+    lists what broke (``report["timeout"]`` marks an environment-style failure
+    the caller may choose to skip on rather than fail).
+    """
+    import tempfile
+
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    assert global_batch % (num_processes * devices_per_process) == 0, (
+        "global_batch must divide evenly over the device mesh")
+    local_rows = global_batch // num_processes
+    total_rows = n_batches * global_batch
+
+    workdir = workdir or tempfile.mkdtemp(prefix="petastorm_tpu_selfcheck_")
+    os.makedirs(workdir, exist_ok=True)
+    # params-keyed name: a reused workdir with different batch/process
+    # geometry must regenerate, not misverify against a stale dataset
+    dataset = os.path.join(
+        workdir, f"ds_gb{global_batch}_nb{n_batches}_np{num_processes}")
+    schema = Schema("SelfCheck", [
+        Field(_ID, np.int32),
+        Field(_VALUE, np.float32, (_VALUE_DIM,)),
+    ])
+    if not os.path.exists(dataset):
+        write_dataset(dataset, schema,
+                      [{_ID: np.int32(i), _VALUE: _value_for_ids([i])[0]}
+                       for i in range(total_rows)],
+                      row_group_size_rows=local_rows)
+
+    report: Dict = {"ok": False, "timeout": False, "failures": [],
+                    "workdir": workdir, "num_processes": num_processes,
+                    "devices_per_process": devices_per_process,
+                    "global_batch": global_batch, "n_batches": n_batches}
+    failures = report["failures"]
+    logs: List[str] = []
+    report["logs"] = logs
+
+    # up to two pipeline attempts: drained-count inequality (which forces the
+    # pad path) comes from engineered buffering asymmetry plus a settle
+    # sleep, and a slow/contended box can still even the counts out - that
+    # is a property of the box, not a data-plane failure, so retry once with
+    # a longer settle and report `pad_exercised` rather than failing
+    report["notes"] = notes = []
+    workers: List[Dict] = []
+    attempt_settle = settle
+    for attempt in range(2):
+        error = _launch("pipeline", num_processes, devices_per_process,
+                        dataset, workdir, timeout, logs,
+                        ["--global-batch", str(global_batch),
+                         "--settle", str(attempt_settle)])
+        if error:
+            failures.append(error)
+            report["timeout"] = "timed out" in error
+            return report
+        workers = []
+        for pid in range(num_processes):
+            with open(os.path.join(workdir, f"worker_{pid}.json")) as f:
+                workers.append(json.load(f))
+        if len(set(workers[0]["real_all"])) > 1 or attempt == 1:
+            break
+        notes.append(f"attempt {attempt + 1}: hosts drained equal counts"
+                     f" {workers[0]['real_all']}; retrying with settle"
+                     f" {attempt_settle * 2}")
+        attempt_settle *= 2
+    report["pad_exercised"] = len(set(workers[0]["real_all"])) > 1
+
+    # ground truth: what each shard yields when read in THIS process
+    def shard_ids(shard: int, count: int) -> List[int]:
+        r = make_reader(dataset, cur_shard=shard, shard_count=count,
+                        shuffle_row_groups=False, num_epochs=1,
+                        workers_count=1)
+        out: List[int] = []
+        try:
+            for cb in r.iter_batches():
+                out.extend(np.asarray(cb.columns[_ID]).astype(int).tolist())
+        finally:
+            r.stop()
+            r.join()
+        return out
+
+    expected_shards = [shard_ids(p, num_processes)
+                       for p in range(num_processes)]
+
+    # -- per-worker checks ---------------------------------------------------
+    consumed: List[int] = []
+    for w in workers:
+        pid = w["process_id"]
+        if w["process_count"] != num_processes:
+            failures.append(f"worker {pid}: process_count {w['process_count']}")
+        if w["n_devices"] != num_processes * devices_per_process:
+            failures.append(f"worker {pid}: saw {w['n_devices']} devices")
+        exp = expected_shards[pid]
+        real = [b for b in w["batches"] if b["kind"] != "drain_pad"]
+        pads = [b for b in w["batches"] if b["kind"] == "drain_pad"]
+        lo = pid * local_rows
+        for k, b in enumerate(real):
+            shards = sorted(b["shards"], key=lambda s: s["start"])
+            got = [i for s in shards for i in s["ids"]]
+            want = exp[k * local_rows:(k + 1) * local_rows]
+            if got != want:
+                failures.append(
+                    f"worker {pid} batch {k}: rows {got} != expected {want}"
+                    " (global assembly placed the wrong data)")
+                break
+            starts = [s["start"] for s in shards]
+            if starts[0] != lo or shards[-1]["stop"] != lo + local_rows:
+                failures.append(
+                    f"worker {pid} batch {k}: local shards cover"
+                    f" [{starts[0]}, {shards[-1]['stop']}) but this process"
+                    f" owns [{lo}, {lo + local_rows})")
+                break
+            if not b["values_match"]:
+                failures.append(f"worker {pid} batch {k}: value column does"
+                                " not match f(id)")
+                break
+            mask_vals = [v for m in sorted(b["mask"], key=lambda s: s["start"])
+                         for v in m["vals"]]
+            if mask_vals != [1.0] * local_rows:
+                failures.append(f"worker {pid} batch {k}: real batch mask"
+                                f" {mask_vals}")
+                break
+        for b in pads:
+            mask_vals = [v for m in b["mask"] for v in m["vals"]]
+            if any(v != 0.0 for v in mask_vals):
+                failures.append(f"worker {pid}: pad batch has nonzero mask")
+            if b["valid_rows"] != 0:
+                failures.append(f"worker {pid}: pad batch valid_rows"
+                                f" {b['valid_rows']}")
+        consumed.extend(exp[:len(real) * local_rows])
+        if len(set(w["drain_steps_all"])) != 1:
+            failures.append(f"worker {pid}: unaligned drain steps"
+                            f" {w['drain_steps_all']}")
+        if any(not np.isfinite(m) for m in w["means"]):
+            failures.append(f"worker {pid}: non-finite collective result")
+
+    # -- cross-worker checks -------------------------------------------------
+    real_counts = workers[0]["real_all"]
+    report["drained_real_per_process"] = real_counts
+    report["pad_counts"] = [w["pad_count"] for w in workers]
+    if not report["pad_exercised"]:
+        notes.append(
+            "hosts drained equal counts on both attempts - the pad path was"
+            " not exercised this run (slow box, not a data-plane failure)")
+    elif sum(report["pad_counts"]) == 0:
+        failures.append("hosts drained unequal counts but no alignment pads"
+                        " were emitted")
+    means = [tuple(w["means"]) for w in workers]
+    if len(set(means)) != 1:
+        failures.append("hosts realized different collective results:"
+                        f" {means} (replicated output must agree)")
+
+    # -- phase 2: elastic resume under a different process count -------------
+    if resume_processes:
+        states = []
+        for pid in range(num_processes):
+            with open(os.path.join(workdir, f"state_{pid}.pkl"), "rb") as f:
+                states.append(pickle.load(f))
+        with open(os.path.join(workdir, "states.pkl"), "wb") as f:
+            pickle.dump(states, f)
+        error = _launch("resume", resume_processes, 1, dataset, workdir,
+                        timeout, logs,
+                        ["--resume-states",
+                         os.path.join(workdir, "states.pkl")])
+        if error:
+            failures.append(error)
+            report["timeout"] = report["timeout"] or "timed out" in error
+            return report
+        resumed: List[int] = []
+        for pid in range(resume_processes):
+            with open(os.path.join(workdir, f"resume_{pid}.json")) as f:
+                resumed.extend(json.load(f)["ids"])
+        report["consumed_rows"] = len(consumed)
+        report["resumed_rows"] = len(resumed)
+        both = sorted(consumed + resumed)
+        if both != list(range(total_rows)):
+            dup = len(both) - len(set(both))
+            missing = sorted(set(range(total_rows)) - set(both))[:10]
+            failures.append(
+                f"resume not exact: {dup} duplicated rows, first missing"
+                f" {missing} ({len(consumed)} consumed + {len(resumed)}"
+                f" resumed of {total_rows})")
+
+    report["ok"] = not failures
+    return report
+
+
+def _main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--worker", action="store_true",
+                        help="internal: run as a spawned worker process")
+    parser.add_argument("--phase", default="pipeline",
+                        choices=["pipeline", "resume"])
+    parser.add_argument("--process-id", type=int, default=0)
+    parser.add_argument("--num-processes", type=int, default=2)
+    parser.add_argument("--coordinator", default=None)
+    parser.add_argument("--dataset", default=None)
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--global-batch", type=int, default=8)
+    parser.add_argument("--settle", type=float, default=1.5)
+    parser.add_argument("--resume-states", default=None)
+    parser.add_argument("--devices-per-process", type=int, default=2)
+    parser.add_argument("--resume-processes", type=int, default=3)
+    parser.add_argument("--timeout", type=float, default=240.0)
+    args = parser.parse_args()
+    if args.worker:
+        _worker_main(args)
+        return 0
+    report = run_selfcheck(num_processes=args.num_processes,
+                           devices_per_process=args.devices_per_process,
+                           global_batch=args.global_batch,
+                           resume_processes=args.resume_processes,
+                           settle=args.settle, timeout=args.timeout)
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

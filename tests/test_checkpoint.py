@@ -290,3 +290,50 @@ def test_drain_zero_batch_host_without_any_emitted_batch(ds):
     assert p["_valid_rows"] == 0
     assert p["id"].shape == (8,) and p["x"].shape == (8, 4)
     assert np.asarray(p["x"]).sum() == 0
+
+
+def test_drain_pads_carry_zero_valid_mask(ds):
+    """Drain-alignment pads must zero the valid_mask_field column so a
+    collective consumer that weights by the mask (the pod-safe pattern;
+    branching on host-local '_valid_rows' would diverge control flow) sees
+    the pad rows contribute nothing."""
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    with make_batch_reader(ds, reader_pool_type="thread", shuffle_seed=1,
+                           num_epochs=1) as r:
+        with JaxDataLoader(r, batch_size=8, mesh=mesh,
+                           shardings=PartitionSpec("data"),
+                           drop_last=False, valid_mask_field="mask") as loader:
+            it = iter(loader)
+            first = next(it)
+            assert np.asarray(first["mask"]).tolist() == [1.0] * 8
+            drained = list(loader.drain(
+                all_gather_counts=lambda mine: [mine, mine + 3]))
+    pads = [b for b in drained if b.get("_valid_rows", -1) == 0]
+    assert len(pads) == 3
+    for p in pads:
+        assert np.asarray(p["mask"]).tolist() == [0.0] * 8
+
+
+def test_drain_zero_batch_host_synthesizes_mask(ds):
+    """The zero-batch-host synthesized pads (no template batch, no placement
+    cache) must still include the valid_mask_field column, zeroed."""
+    from jax.sharding import Mesh, PartitionSpec
+
+    from petastorm_tpu.predicates import in_lambda
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    nothing = in_lambda(["id"], lambda cols: np.zeros(len(cols["id"]), bool),
+                        vectorized=True)
+    with make_batch_reader(ds, reader_pool_type="serial", num_epochs=1,
+                           predicate=nothing, shuffle_row_groups=False) as r:
+        with JaxDataLoader(r, batch_size=8, mesh=mesh,
+                           shardings=PartitionSpec("data"),
+                           drop_last=False, valid_mask_field="mask") as loader:
+            drained = list(loader.drain(
+                all_gather_counts=lambda mine: [mine, 1]))
+    (p,) = drained
+    assert p["_valid_rows"] == 0
+    assert p["mask"].shape == (8,)
+    assert np.asarray(p["mask"]).tolist() == [0.0] * 8

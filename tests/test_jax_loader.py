@@ -472,3 +472,46 @@ def test_pad_bucket_validation(num_ds):
                       pad_shapes={"vec": [(6,), (8,)]},
                       device_shuffle_capacity=2)
     reader2.stop(); reader2.join()
+
+
+def test_valid_mask_field_full_and_partial(num_ds, devices):
+    """valid_mask_field adds a globally-consistent per-row validity column:
+    1.0 on real rows, 0.0 on the zero-padding of a partial final batch -
+    the only pod-safe signal to weight losses by (host-local '_valid_rows'
+    differs across hosts; see JaxDataLoader.drain docs)."""
+    url, _ = num_ds
+    mesh = data_parallel_mesh()
+    reader = make_reader(url, shuffle_row_groups=False,
+                         schema_fields=["idx", "vec"])
+    with JaxDataLoader(reader, batch_size=24, mesh=mesh, drop_last=False,
+                       valid_mask_field="mask") as loader:
+        batches = list(loader)
+    assert len(batches) == 3  # 64 rows = 24 + 24 + 16(+8 pad)
+    for b in batches[:2]:
+        assert isinstance(b["mask"], jax.Array)
+        assert b["mask"].shape == (24,)
+        assert np.asarray(b["mask"]).tolist() == [1.0] * 24
+        # mask shards its only axis like the data fields shard their batch axis
+        assert b["mask"].sharding.spec[0] == b["idx"].sharding.spec[0]
+    tail = batches[-1]
+    assert tail["_valid_rows"] == 16
+    assert np.asarray(tail["mask"]).tolist() == [1.0] * 16 + [0.0] * 8
+    # masked mean ignores the zero-padded rows
+    vec = np.asarray(tail["vec"]).sum(axis=1)
+    mask = np.asarray(tail["mask"])
+    assert np.isclose((vec * mask).sum() / mask.sum(), vec[:16].mean())
+
+
+def test_valid_mask_field_validation(num_ds):
+    url, _ = num_ds
+    reader = make_reader(url, schema_fields=["idx"])
+    with pytest.raises(PetastormTpuError, match="only applies to mesh"):
+        JaxDataLoader(reader, batch_size=8, valid_mask_field="mask")
+    reader.stop(); reader.join()
+
+    mesh = data_parallel_mesh()
+    reader2 = make_reader(url, schema_fields=["idx", "vec"])
+    with pytest.raises(PetastormTpuError, match="collides with a schema field"):
+        JaxDataLoader(reader2, batch_size=8, mesh=mesh,
+                      valid_mask_field="vec")
+    reader2.stop(); reader2.join()

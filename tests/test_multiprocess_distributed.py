@@ -1,0 +1,48 @@
+"""REAL multi-process execution of the multi-host data plane.
+
+Everything else in this suite simulates hosts in one process (the reference
+does the same: petastorm/tests/test_end_to_end.py:454).  These tests launch
+genuinely separate OS processes via ``jax.distributed`` on the CPU backend
+(Gloo collectives over localhost) and prove, with ``process_count > 1``:
+
+* ``shard_options_from_jax`` sharded reading per process
+* ``jax.make_array_from_process_local_data`` global-batch assembly - the
+  launcher reconstructs every global batch from each process's addressable
+  shards and matches it row-for-row against a single-process read
+* ``JaxDataLoader.drain`` through the REAL ``multihost_utils.process_allgather``
+  branch (no injected counts), with deliberately unequal host buffering so the
+  zero-pad alignment path must fire
+* the ``valid_mask_field`` no-hang contract: a collective step runs on EVERY
+  drained step, pads carrying a zero mask, and all hosts realize identical
+  replicated results
+* ``elastic_resume`` across a process-count change (2 -> 3): phase-1
+  consumption plus phase-2 resume cover the dataset exactly once
+
+Skipped (not failed) on launcher timeout: collective hangs and glacial shared
+CI boxes are indistinguishable from here, and a hang IS the failure mode the
+drain alignment exists to prevent - the selfcheck's own asserts catch real
+misalignment well before the timeout.
+"""
+
+import pytest
+
+from petastorm_tpu.parallel.selfcheck import run_selfcheck
+
+
+def test_multiprocess_data_plane(tmp_path):
+    report = run_selfcheck(num_processes=2, devices_per_process=2,
+                           global_batch=8, n_batches=28, resume_processes=3,
+                           workdir=str(tmp_path), timeout=300.0)
+    if report["timeout"]:
+        pytest.skip(f"multi-process selfcheck timed out: {report['failures']}")
+    assert report["ok"], report["failures"]
+    # both phases moved real data
+    assert report["consumed_rows"] > 0
+    assert report["resumed_rows"] > 0
+    if not report["pad_exercised"]:
+        # equal drains on both attempts = the box was too slow to build the
+        # buffering asymmetry, not a data-plane failure (selfcheck notes)
+        pytest.skip(f"pad path not exercised on this box: {report['notes']}")
+    # the interesting regime actually occurred: unequal drains forced pads
+    assert sum(report["pad_counts"]) > 0
+    assert len(set(report["drained_real_per_process"])) > 1
