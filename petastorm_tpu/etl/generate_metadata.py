@@ -20,9 +20,96 @@ from typing import List, Optional
 logger = logging.getLogger(__name__)
 
 
+def _image_dims(buf: bytes) -> Optional[tuple]:
+    """(h, w, c) from a jpeg/png header, or None when unrecognized.
+
+    Header-only parse (no pixel decode): PNG IHDR, or the first jpeg SOF
+    frame marker - cheap enough to scan whole columns with.
+    """
+    if len(buf) < 26:
+        return None
+    if buf[:8] == b"\x89PNG\r\n\x1a\n":
+        w = int.from_bytes(buf[16:20], "big")
+        h = int.from_bytes(buf[20:24], "big")
+        channels = {0: 1, 2: 3, 3: 3, 4: 2, 6: 4}.get(buf[25])
+        return (h, w, channels) if channels else None
+    if buf[:2] == b"\xff\xd8":  # jpeg SOI
+        i = 2
+        sof = {0xC0, 0xC1, 0xC2, 0xC3, 0xC5, 0xC6, 0xC7,
+               0xC9, 0xCA, 0xCB, 0xCD, 0xCE, 0xCF}
+        while i + 9 < len(buf):
+            if buf[i] != 0xFF:
+                i += 1
+                continue
+            marker = buf[i + 1]
+            if marker == 0xFF:  # legal fill byte, not a marker
+                i += 1
+                continue
+            if marker in sof:
+                h = int.from_bytes(buf[i + 5:i + 7], "big")
+                w = int.from_bytes(buf[i + 7:i + 9], "big")
+                return (h, w, buf[i + 9])
+            if marker in (0xD8, 0x01) or 0xD0 <= marker <= 0xD7:
+                i += 2  # standalone markers have no length field
+                continue
+            i += 2 + int.from_bytes(buf[i + 2:i + 4], "big")
+    return None
+
+
+def scan_geometries(dataset_url: str,
+                    storage_options: Optional[dict] = None,
+                    schema=None) -> dict:
+    """Scan every variable-shape image column for its distinct geometries.
+
+    Reads only the image columns, streamed one row-group batch at a time,
+    and parses encoded HEADERS (no pixel decode, no whole-column
+    materialization).  This is the repair path for the dataset-level
+    geometry contract (``etl.metadata.declared_geometries``) after files
+    were added/rewritten by an external engine - the jax loader's
+    'device-mixed' diagnostics point here when they see an undeclared
+    geometry.
+
+    ``schema``: pass the already-resolved Schema when the dataset itself has
+    none stored yet (the ``--schema-from``/``--infer`` repair flows, which
+    run this scan BEFORE stamping).
+    """
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.etl.metadata import infer_or_load_schema, open_dataset
+
+    info = open_dataset(dataset_url, storage_options=storage_options,
+                        require_stored_schema=schema is None)
+    if schema is None:
+        schema = infer_or_load_schema(info)
+    fields = [f.name for f in schema
+              if isinstance(f.codec, CompressedImageCodec)
+              and any(d is None for d in f.shape)]
+    if not fields:
+        return {}
+    geoms: dict = {name: set() for name in fields}
+    for path in info.files:
+        with info.filesystem.open_input_file(path) as f:
+            pf = pq.ParquetFile(f)
+            present = [n for n in fields if n in pf.schema_arrow.names]
+            if not present:
+                continue
+            for batch in pf.iter_batches(columns=present):
+                for name in present:
+                    for cell in batch.column(name):
+                        buf = cell.as_py()
+                        if buf is None:
+                            continue
+                        dims = _image_dims(bytes(buf))
+                        if dims is not None:
+                            geoms[name].add(dims)
+    return {name: shapes for name, shapes in geoms.items() if shapes}
+
+
 def generate_metadata(dataset_url: str,
                       schema_from: Optional[str] = None,
                       infer: bool = False,
+                      rescan_geometries: bool = False,
                       storage_options: Optional[dict] = None) -> None:
     from petastorm_tpu.etl.metadata import open_dataset
     from petastorm_tpu.etl.writer import stamp_dataset_metadata
@@ -38,9 +125,18 @@ def generate_metadata(dataset_url: str,
         schema = infer_or_load_schema(
             open_dataset(dataset_url, storage_options=storage_options,
                          require_stored_schema=False))
-    # schema=None -> stamp_dataset_metadata reads the schema JSON from file KV
+    geometries = None
+    if rescan_geometries:
+        geometries = scan_geometries(dataset_url,
+                                     storage_options=storage_options,
+                                     schema=schema) or None
+    # schema=None -> stamp_dataset_metadata reads the schema JSON from file KV.
+    # A rescan saw the WHOLE dataset, so its geometry set REPLACES the stamped
+    # one (stale shapes from rewritten files must disappear, not merge).
     stamp_dataset_metadata(dataset_url, schema=schema,
-                           storage_options=storage_options)
+                           storage_options=storage_options,
+                           geometries=geometries,
+                           merge_geometries=not rescan_geometries)
     logger.info("Stamped metadata for %s", dataset_url)
 
 
@@ -55,6 +151,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--infer", action="store_true",
                         help="infer the schema from the parquet arrow schema"
                              " when no stored schema exists")
+    parser.add_argument("--scan-geometries", action="store_true",
+                        help="scan variable-shape image columns (header-only"
+                             " parse) and stamp the distinct shapes as the"
+                             " dataset-level geometry contract, REPLACING any"
+                             " already-stamped shapes (the scan sees the whole"
+                             " dataset, so its result is authoritative)")
     return parser
 
 
@@ -62,7 +164,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     args = build_parser().parse_args(argv)
     generate_metadata(args.dataset_url, schema_from=args.schema_from,
-                      infer=args.infer)
+                      infer=args.infer,
+                      rescan_geometries=args.scan_geometries)
     print(f"metadata stamped: {args.dataset_url}")
     return 0
 

@@ -36,6 +36,11 @@ logger = logging.getLogger(__name__)
 
 #: Parquet KV key: JSON ``{"files": {relative_path: [rows_in_rg0, rows_in_rg1, ...]}}``
 ROW_GROUPS_METADATA_KEY = b"petastorm-tpu.row_groups_per_file.v1"
+#: per-field distinct image shapes, stamped at write/copy time: the
+#: DATASET-LEVEL geometry contract that bounds on-device mixed-geometry
+#: decode compiles (every geometry a reader can possibly encounter is known
+#: up front - jax/loader.py 'device-mixed').  JSON {field: [[h, w, c], ...]}.
+GEOMETRIES_METADATA_KEY = b"petastorm-tpu.image_geometries.v1"
 #: Parquet KV key: JSON rowgroup index (petastorm_tpu/etl/indexing.py)
 ROWGROUP_INDEX_METADATA_KEY = b"petastorm-tpu.rowgroup_index.v1"
 
@@ -332,6 +337,25 @@ def infer_or_load_schema(info: DatasetInfo) -> Schema:
     partition_cols = [k for k in info.partition_keys]
     return Schema.from_arrow_schema(info.arrow_schema, name="inferred",
                                     partition_columns=partition_cols)
+
+
+def declared_geometries(info: "DatasetInfo") -> Dict[str, List[tuple]]:
+    """Per-field distinct image shapes from the dataset's KV metadata, or {}.
+
+    Stamped by ``write_dataset``/``stamp_dataset_metadata`` for
+    variable-shape ``CompressedImageCodec`` fields; consumed by the jax
+    loader's ``decode_placement='device-mixed'`` path as the dataset-level
+    bound on decode compiles (and surfaced in loader diagnostics)."""
+    raw = info.kv_metadata.get(GEOMETRIES_METADATA_KEY)
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+    except (ValueError, TypeError):
+        logger.warning("unparseable %s metadata ignored", GEOMETRIES_METADATA_KEY)
+        return {}
+    return {name: [tuple(int(d) for d in shape) for shape in shapes]
+            for name, shapes in parsed.items()}
 
 
 def write_metadata_file(fs: pafs.FileSystem, root: str, arrow_schema: pa.Schema,

@@ -32,7 +32,9 @@ import pyarrow.fs as pafs
 import pyarrow.parquet as pq
 
 from petastorm_tpu.errors import MetadataError, SchemaError
-from petastorm_tpu.etl.metadata import (ROW_GROUPS_METADATA_KEY, _is_data_file,
+from petastorm_tpu.etl.metadata import (GEOMETRIES_METADATA_KEY,
+                                        ROW_GROUPS_METADATA_KEY, _is_data_file,
+                                        _read_kv_metadata,
                                         collect_row_group_counts, hive_partition_segment,
                                         open_dataset, write_metadata_file)
 from petastorm_tpu.fs import get_filesystem_and_path
@@ -92,7 +94,8 @@ def write_dataset(url: str,
                   stamp_metadata: bool = True,
                   mode: str = "error",
                   compression: Optional[Union[str, Dict[str, str]]] = None,
-                  encode_workers: int = 1) -> List[str]:
+                  encode_workers: int = 1,
+                  geometry_sink: Optional[Dict[str, set]] = None) -> List[str]:
     """Encode + write rows as a petastorm_tpu parquet dataset; returns file paths.
 
     ``partition_by`` names scalar fields materialized as hive ``key=value``
@@ -112,6 +115,13 @@ def write_dataset(url: str,
     ``encode_workers`` > 1 encodes rows through the codecs on a thread pool
     (jpeg/png/deflate encoding releases the GIL); row and rowgroup order are
     unchanged, so the written dataset is byte-identical either way.
+
+    ``geometry_sink``: coordination hook for multi-writer flows
+    (``parallel.distributed_write_dataset``) - the distinct image shapes this
+    call observed are ADDED to the given dict ({field: set of shape tuples})
+    so a coordinator can merge every writer's set and stamp the combined
+    geometry contract; with ``stamp_metadata=True`` the shapes are also
+    stamped directly.
     """
     if mode not in ("error", "overwrite", "append"):
         raise ValueError(f"mode must be 'error', 'overwrite' or 'append',"
@@ -175,6 +185,20 @@ def write_dataset(url: str,
                 logger.warning("could not delete partial file %s after failed"
                                " write", path, exc_info=True)
 
+    # dataset-level geometry contract: record the distinct image shapes of
+    # variable-shape CompressedImageCodec fields while the rows stream by, so
+    # readers know EVERY geometry up front (bounds the on-device mixed-decode
+    # compile count; jax loader 'device-mixed')
+    from petastorm_tpu.codecs import CompressedImageCodec
+
+    geom_fields = [f.name for f in schema
+                   if isinstance(f.codec, CompressedImageCodec)
+                   and any(d is None for d in f.shape)]
+    geom_seen: Dict[str, set] = (geometry_sink if geometry_sink is not None
+                                 else {})
+    for name in geom_fields:
+        geom_seen.setdefault(name, set())
+
     _ESTIMATE_CHUNK = 1024  # rows encoded to estimate bytes/row for MB-based sizing
     pending: Dict[tuple, List[dict]] = {}
 
@@ -220,6 +244,10 @@ def write_dataset(url: str,
                     raise SchemaError(f"Row is missing a value for partition field {k!r}"
                                       " (partition values must be non-null)")
             pv = tuple((k, str(r[k])) for k in partition_by)
+            for name in geom_fields:
+                v = r.get(name)
+                if v is not None:
+                    geom_seen[name].add(tuple(np.asarray(v).shape))
             pending.setdefault(pv, []).append(r)
             if len(pending[pv]) >= (rows_per_group or _ESTIMATE_CHUNK):
                 _flush(pv, final=False)
@@ -268,15 +296,28 @@ def write_dataset(url: str,
                        url)
         return []
     if stamp_metadata:
-        stamp_dataset_metadata(url, schema, filesystem=fs)
+        stamp_dataset_metadata(url, schema, filesystem=fs,
+                               geometries={n: s for n, s in geom_seen.items()
+                                           if s} or None)
     return files
 
 
 def stamp_dataset_metadata(url: str, schema: Optional[Schema] = None,
                            filesystem: Optional[pafs.FileSystem] = None,
                            storage_options: Optional[dict] = None,
-                           validate: bool = True) -> None:
+                           validate: bool = True,
+                           geometries: Optional[Dict[str, Iterable]] = None,
+                           merge_geometries: bool = True) -> None:
     """Write/refresh ``_common_metadata``: schema JSON + per-file rowgroup counts.
+
+    ``geometries``: {field: iterable of image shape tuples} to stamp as the
+    dataset-level geometry contract (see ``etl.metadata.declared_geometries``).
+    With ``merge_geometries=True`` (default) they are unioned with any
+    already-stamped shapes - right for ``mode='append'`` writes, which see
+    only their own rows.  Pass ``merge_geometries=False`` when the given set
+    is authoritative for the WHOLE dataset (a full rescan:
+    ``petastorm-tpu-generate-metadata --scan-geometries``), so stale
+    geometries from rewritten files actually disappear.
 
     Reference: the post-write half of ``materialize_dataset``
     (dataset_metadata.py:113-131) and the standalone regenerator CLI
@@ -302,6 +343,22 @@ def stamp_dataset_metadata(url: str, schema: Optional[Schema] = None,
         SCHEMA_METADATA_KEY: schema.to_json().encode(),
         ROW_GROUPS_METADATA_KEY: json.dumps({"files": counts}).encode(),
     }
+    if geometries:
+        merged: Dict[str, set] = {n: {tuple(int(d) for d in s) for s in shapes}
+                                  for n, shapes in geometries.items()}
+        existing_raw = (_read_kv_metadata(fs, root).get(GEOMETRIES_METADATA_KEY)
+                        if merge_geometries else None)
+        if existing_raw:
+            try:
+                for n, shapes in json.loads(existing_raw).items():
+                    merged.setdefault(n, set()).update(
+                        tuple(int(d) for d in s) for s in shapes)
+            except (ValueError, TypeError):
+                logger.warning("discarding unparseable stamped geometry"
+                               " metadata during re-stamp")
+        kv[GEOMETRIES_METADATA_KEY] = json.dumps(
+            {n: sorted(list(s) for s in shapes)
+             for n, shapes in merged.items()}).encode()
     write_metadata_file(fs, root, arrow_schema, kv)
     if validate:
         info = open_dataset(url, filesystem=fs, require_stored_schema=True)

@@ -154,11 +154,18 @@ def distributed_write_dataset(url: str,
     # raising early would strand the surviving hosts in sync_global_devices.
     files: List[str] = []
     write_error: Optional[BaseException] = None
+    geom_seen: dict = {}
     try:
         files = write_dataset(url, schema, local_rows,
                               file_prefix=f"part-{process_index:05d}",
                               stamp_metadata=False, mode="append",
+                              geometry_sink=geom_seen,
                               **write_kwargs)
+        if any(geom_seen.values()):
+            # each host saw only ITS rows' image shapes; publish them as an
+            # underscore sidecar (skipped by data discovery) so host 0 can
+            # stamp the MERGED dataset-level geometry contract
+            _write_geometry_sidecar(fs, root, process_index, geom_seen)
     except BaseException as exc:  # noqa: BLE001 - re-raised after barriers
         write_error = exc
         _drop_fail_marker(fs, root, process_index)
@@ -174,9 +181,14 @@ def distributed_write_dataset(url: str,
                 raise PetastormTpuError(
                     f"write failed on host(s) {sorted(markers)}; dataset not"
                     " stamped")
+            merged_geoms, sidecars = _merge_geometry_sidecars(fs, root)
             stamp_dataset_metadata(url, schema,
                                    storage_options=storage_options,
-                                   filesystem=filesystem)
+                                   filesystem=filesystem,
+                                   geometries=merged_geoms or None)
+            # only AFTER the stamp succeeded: a failed stamp must leave the
+            # sidecars behind so a retry still has the observed geometry set
+            _delete_geometry_sidecars(fs, sidecars)
         except BaseException as exc:  # noqa: BLE001 - surfaced by phase 4
             logger.error("distributed write stamp failed: %s", exc)
     sync("petastorm_tpu:distributed_write:stamp")
@@ -211,6 +223,49 @@ def _preflight(fs: pafs.FileSystem, root: str, url: str, mode: str) -> None:
                        for f in entries if f.type == pafs.FileType.File):
             fs.delete_dir_contents(root)
     fs.create_dir(root, recursive=True)
+
+
+#: per-host geometry sidecars merged into the stamped contract by host 0
+_GEOM_SIDECAR = "_image_geometries"
+
+
+def _write_geometry_sidecar(fs: pafs.FileSystem, root: str, idx: int,
+                            geom_seen: dict) -> None:
+    import json
+
+    payload = json.dumps({name: sorted(list(s) for s in shapes)
+                          for name, shapes in geom_seen.items() if shapes})
+    with fs.open_output_stream(
+            posixpath.join(root, f"{_GEOM_SIDECAR}.{idx}.json")) as f:
+        f.write(payload.encode())
+
+
+def _merge_geometry_sidecars(fs: pafs.FileSystem, root: str) -> tuple:
+    """(union of every host's geometry sidecar, the sidecar paths).
+
+    Deletion is the caller's job, after the stamp that persists the merged
+    set has actually succeeded."""
+    import json
+
+    merged: dict = {}
+    paths = [f.path for f in fs.get_file_info(
+                 pafs.FileSelector(root, recursive=False))
+             if posixpath.basename(f.path).startswith(_GEOM_SIDECAR)]
+    for path in sorted(paths):
+        with fs.open_input_file(path) as f:
+            for name, shapes in json.loads(f.read()).items():
+                merged.setdefault(name, set()).update(
+                    tuple(int(d) for d in s) for s in shapes)
+    return merged, paths
+
+
+def _delete_geometry_sidecars(fs: pafs.FileSystem, paths) -> None:
+    for path in paths:
+        try:
+            fs.delete_file(path)
+        except Exception as exc:  # noqa: BLE001 - cleanup is best-effort
+            logger.warning("could not remove geometry sidecar %s: %s",
+                           path, exc)
 
 
 def _drop_fail_marker(fs: pafs.FileSystem, root: str, idx) -> None:

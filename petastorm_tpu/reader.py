@@ -689,3 +689,12 @@ class Reader:
                 "items_per_epoch": self._ventilator.items_per_epoch,
                 "consumed_items": self._consumed_items,
                 "expected_items": self._expected_items}
+
+    @property
+    def declared_geometries(self) -> dict:
+        """{field: [shape tuples]} stamped at write/copy time, or {} - the
+        dataset-level geometry contract the jax loader's 'device-mixed'
+        decode uses to bound its compile count (etl.metadata)."""
+        from petastorm_tpu.etl.metadata import declared_geometries
+
+        return declared_geometries(self.dataset_info)

@@ -242,3 +242,97 @@ def test_show_metadata_schema_only(small_ds, capsys):
     assert show_main(["show", "--schema-only", "--json", url]) == 0
     doc = json.loads(capsys.readouterr().out)
     assert set(doc) == {"url", "schema_source", "schema"}
+
+
+def test_generate_metadata_scan_geometries(tmp_path):
+    """--scan-geometries repairs the geometry contract after external writes:
+    header-only parse of the image columns, merged into the stamped set."""
+    cv2 = pytest.importorskip("cv2")  # noqa: F841
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.etl.generate_metadata import main as gen_main
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    schema = Schema("ScanGeo", [
+        Field("idx", np.int64),
+        Field("image", np.uint8, (None, None, 3),
+              CompressedImageCodec("jpeg", quality=90)),
+    ])
+    rng = np.random.default_rng(3)
+    geoms = [(16, 24), (24, 16)]
+    url = str(tmp_path / "ds")
+    write_dataset(url, schema,
+                  [{"idx": i,
+                    "image": rng.integers(0, 255, geoms[i % 2] + (3,),
+                                          dtype=np.uint8)}
+                   for i in range(8)],
+                  row_group_size_rows=4)
+    # simulate an external engine: wipe the stamped contract
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.etl.metadata import GEOMETRIES_METADATA_KEY
+    meta = pq.read_metadata(f"{url}/_common_metadata")
+    kv = {k: v for k, v in (meta.metadata or {}).items()
+          if k != GEOMETRIES_METADATA_KEY}
+    pq.write_metadata(meta.schema.to_arrow_schema().with_metadata(kv),
+                      f"{url}/_common_metadata")
+    with make_batch_reader(url, num_epochs=1) as r:
+        assert r.declared_geometries == {}
+
+    assert gen_main([url, "--scan-geometries"]) == 0
+    with make_batch_reader(url, num_epochs=1) as r:
+        declared = r.declared_geometries
+    assert sorted(declared["image"]) == sorted(g + (3,) for g in geoms)
+
+    # a rescan is authoritative: stale shapes from rewritten files DISAPPEAR
+    # (append-mode stamps merge, but --scan-geometries replaces)
+    from petastorm_tpu.etl.writer import stamp_dataset_metadata
+    stamp_dataset_metadata(url, geometries={"image": {(99, 99, 3)}})
+    with make_batch_reader(url, num_epochs=1) as r:
+        assert (99, 99, 3) in r.declared_geometries["image"]  # merged in
+    assert gen_main([url, "--scan-geometries"]) == 0
+    with make_batch_reader(url, num_epochs=1) as r:
+        assert sorted(r.declared_geometries["image"]) == sorted(
+            g + (3,) for g in geoms)  # stale shape replaced away
+
+
+def test_image_dims_header_parse():
+    """Header-only geometry parse: png IHDR, jpeg SOF, jpeg with legal 0xFF
+    fill bytes before the marker, and junk."""
+    from petastorm_tpu.etl.generate_metadata import _image_dims
+
+    png = (b"\x89PNG\r\n\x1a\n" + b"\x00\x00\x00\rIHDR"
+           + (24).to_bytes(4, "big") + (16).to_bytes(4, "big")
+           + bytes([8, 2, 0, 0, 0]))
+    assert _image_dims(png) == (16, 24, 3)
+
+    def sof(h, w, c):
+        return (b"\xff\xc0" + (8 + 3 * c).to_bytes(2, "big") + b"\x08"
+                + h.to_bytes(2, "big") + w.to_bytes(2, "big")
+                + bytes([c]) + b"\x00" * (3 * c))
+
+    app0 = b"\xff\xe0" + (16).to_bytes(2, "big") + b"JFIF\x00" + b"\x00" * 9
+    assert _image_dims(b"\xff\xd8" + app0 + sof(32, 48, 3) + b"\x00" * 8) \
+        == (32, 48, 3)
+    # legal fill bytes between segments must not be read as a marker+length
+    assert _image_dims(b"\xff\xd8" + b"\xff\xff\xff" + sof(7, 9, 1)
+                       + b"\x00" * 16) == (7, 9, 1)
+    assert _image_dims(b"not an image at all, definitely not") is None
+
+
+def test_valid_mask_field_rejects_reserved_name(small_ds):
+    import jax
+    from jax.sharding import Mesh
+
+    from petastorm_tpu.errors import PetastormTpuError
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.reader import make_reader
+
+    url, _ = small_ds
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    reader = make_reader(url, schema_fields=["id"])
+    with pytest.raises(PetastormTpuError, match="reserved"):
+        JaxDataLoader(reader, batch_size=8, mesh=mesh,
+                      valid_mask_field="_valid_rows")
+    reader.stop(); reader.join()

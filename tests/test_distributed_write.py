@@ -168,3 +168,52 @@ def test_one_host_write_failure_fails_all_hosts(tmp_path):
     assert not os.path.exists(os.path.join(url, "_common_metadata"))
     assert os.path.exists(
         os.path.join(url, "_distributed_write_failed.2"))
+
+
+def test_distributed_write_stamps_merged_geometry_contract(tmp_path):
+    """Each host sees only its own rows' image shapes; the stamped dataset
+    must carry the UNION (the dataset-level geometry contract the
+    'device-mixed' decode bounds its compiles by)."""
+    cv2 = pytest.importorskip("cv2")  # noqa: F841 - jpeg encode in the codec
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.reader import make_batch_reader
+
+    schema = Schema("DistWriteGeo", [
+        Field("id", np.int64),
+        Field("image", np.uint8, (None, None, 3),
+              CompressedImageCodec("jpeg", quality=90)),
+    ])
+    rng = np.random.default_rng(7)
+    # host i writes ONLY geometry i - no single host sees the full set
+    geoms = [(16, 24), (24, 16), (32, 16), (16, 32)]
+    rows = [{"id": i,
+             "image": rng.integers(0, 255, geoms[i % HOSTS] + (3,),
+                                   dtype=np.uint8)}
+            for i in range(32)]
+    url = str(tmp_path / "ds")
+    barrier = threading.Barrier(HOSTS, timeout=30)
+    errors = []
+
+    def host(idx):
+        try:
+            distributed_write_dataset(
+                url, schema, rows[idx::HOSTS],
+                process_index=idx, process_count=HOSTS,
+                sync_fn=lambda tag: barrier.wait(),
+                row_group_size_rows=4)
+        except BaseException as exc:  # noqa: BLE001
+            errors.append((idx, exc))
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in range(HOSTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+
+    with make_batch_reader(url, num_epochs=1) as r:
+        declared = r.declared_geometries
+    assert sorted(declared["image"]) == sorted(g + (3,) for g in geoms)
+    # sidecars were cleaned up after the merge
+    import os
+    assert not [f for f in os.listdir(url) if "geometries" in f]
