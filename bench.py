@@ -30,9 +30,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # kernel per free (docs/operations.md); must be set before numpy allocates,
 # so re-exec once with the env in place
 if os.environ.get("_PST_BENCH_CHILD") != "1":
+    # TF_CPP_MIN_LOG_LEVEL/GRPC_VERBOSITY: TF/absl/oneDNN/grpc banners on
+    # stderr truncated the driver's BENCH_r03 tail capture (VERDICT r3 item
+    # 4); silence them HERE so every child inherits the quiet env too
     env = dict(os.environ, _PST_BENCH_CHILD="1",
                MALLOC_MMAP_THRESHOLD_="268435456",
-               MALLOC_TRIM_THRESHOLD_="268435456")
+               MALLOC_TRIM_THRESHOLD_="268435456",
+               TF_CPP_MIN_LOG_LEVEL="3",
+               GRPC_VERBOSITY="ERROR")
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)]
               + sys.argv[1:], env)
 
@@ -56,12 +61,19 @@ def _median(rates):
     return rates[len(rates) // 2]
 
 
+#: every line emitted this run, replayed as one penultimate 'bench_summary'
+#: line right before the headline - so ANY tail window of the driver's
+#: capture contains every metric even if early lines scroll out
+_EMITTED = []
+
+
 def _emit(metric, value, unit, baseline, note=None):
     line = {"metric": metric, "value": round(value, 2), "unit": unit,
             "vs_baseline": round(value / baseline, 3)}
     if note:
         line["note"] = note
     print(json.dumps(line), flush=True)
+    _EMITTED.append(line)
     return line
 
 
@@ -227,7 +239,24 @@ def bench_north_star(tmp):
     import jax
     import jax.numpy as jnp
 
-    import tensorflow as tf  # noqa: PLC0415 - heavyweight, scoped to this config
+    import logging as _logging
+
+    _logging.getLogger("absl").setLevel(_logging.ERROR)
+    # TF's C++ bootstrap writes I0000 oneDNN/cuda banners straight to fd 2
+    # BEFORE absl log init, ignoring TF_CPP_MIN_LOG_LEVEL - exactly the noise
+    # that truncated the driver's BENCH_r03 tail capture.  Silence fd 2 for
+    # the import only (python-level stderr/exceptions are unaffected after).
+    devnull = os.open(os.devnull, os.O_WRONLY)
+    saved_fd2 = os.dup(2)
+    os.dup2(devnull, 2)
+    try:
+        import tensorflow as tf  # noqa: PLC0415 - heavyweight, scoped here
+    finally:
+        os.dup2(saved_fd2, 2)
+        os.close(saved_fd2)
+        os.close(devnull)
+
+    tf.get_logger().setLevel("ERROR")
 
     from petastorm_tpu.jax import JaxDataLoader
     from petastorm_tpu.native import image as native_image
@@ -306,6 +335,84 @@ def bench_north_star(tmp):
                       " vs_baseline>=1.0 meets the >=0.9x-of-tf.data target")
 
 
+# -- north star under REAL training: tf.data vs ours, same train loop ---------
+
+def _child_env():
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)  # APPEND to PYTHONPATH: the jax plugin site must stay
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _backend_in_child(env):
+    """Probe the default backend in a CHILD so the parent process never
+    initializes the device runtime (train subprocesses must own the chip
+    exclusively - a second tunnel client timeshares dispatch)."""
+    import subprocess
+
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+        timeout=300)
+    return probe.stdout.strip()
+
+
+def bench_north_star_train(tmp):
+    """The north star measured under REAL training: tf.data vs this loader
+    feeding the SAME ResNet-50 train loop (same stored jpegs, same jitted
+    train_step, symmetric background device transfer - examples/imagenet/
+    train_resnet_tpu.py --input).  Fresh-process interleaved A/B/A/B so
+    tunnel/CPU drift hits both pipelines equally; reports samples/sec/chip
+    AND the input-attributable device-idle%% for both.  Retires the r3 gap
+    that the 1.51x ingest-only ratio was measured with a trivial jitted
+    reduce, not train steps (BASELINE.json north_star is a training metric).
+    """
+    import subprocess
+
+    env = _child_env()
+    on_chip = _backend_in_child(env) not in ("cpu", "")
+    if on_chip:
+        url = _ensure_imagenet(tmp)
+        shape = ["--steps", "200", "--global-batch", "32", "--side", "224"]
+    else:
+        url = os.path.join(tmp, "imagenet64")
+        from examples.imagenet.train_resnet_tpu import generate_dataset
+
+        if not os.path.exists(url):
+            generate_dataset(url, rows=64, side=64)
+        shape = ["--steps", "4", "--global-batch", "8", "--side", "64",
+                 "--num-classes", "10"]
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "examples", "imagenet", "train_resnet_tpu.py")
+
+    def run(input_):
+        out = subprocess.run(
+            [sys.executable, script, "--dataset-url", url, "--skip-generate",
+             "--workers", "1", "--prefetch", "3", "--decode", "device",
+             "--cache", "null", "--input", input_, "--json"] + shape,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env, timeout=900, check=True)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    ours, tfd = [], []
+    for _ in range(2):  # interleaved pairs: drift hits both equally
+        ours.append(run("petastorm"))
+        tfd.append(run("tfdata"))
+
+    def mean(ms, key):
+        return sum(m[key] for m in ms) / len(ms)
+
+    om, tm = (mean(ours, "samples_per_sec_per_chip"),
+              mean(tfd, "samples_per_sec_per_chip"))
+    oi, ti = mean(ours, "device_idle_pct"), mean(tfd, "device_idle_pct")
+    return _emit("north_star_train_ratio", om / tm, "x", 0.9,
+                 note=f"REAL ResNet-50 train steps ({ours[0]['steps']}/run,"
+                      " fresh-process interleaved A/B x2, cold cache):"
+                      f" ours {om:.0f} samples/s/chip @ {oi:.1f}% input idle"
+                      f" vs tf.data {tm:.0f} @ {ti:.1f}%;"
+                      " vs_baseline>=1.0 meets the >=0.9x-of-tf.data target")
+
+
 # -- real-training input stall: ResNet-50 train steps -------------------------
 
 def bench_train_stall(tmp):
@@ -318,18 +425,10 @@ def bench_train_stall(tmp):
     import subprocess
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ)  # APPEND to PYTHONPATH: the jax plugin site must stay
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-
-    # probe the backend in a CHILD so this (parent) process never initializes
-    # the device runtime: this config runs FIRST, and its train subprocesses
-    # must own the chip exclusively - a second client on the tunnel timeshares
-    # the dispatch path and halves the measured rate
-    probe = subprocess.run(
-        [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
-        timeout=300)
-    on_chip = probe.stdout.strip() not in ("cpu", "")
+    env = _child_env()
+    # this config runs FIRST so the parent has not initialized the device
+    # runtime and the train subprocesses own the chip exclusively
+    on_chip = _backend_in_child(env) not in ("cpu", "")
     if on_chip:
         url = _ensure_imagenet(tmp)
         shape = ["--steps", "200", "--global-batch", "32", "--side", "224"]
@@ -470,16 +569,24 @@ def main() -> None:
     try:
         # non-headline configs are isolated: a failure (chip runtime down,
         # native lib missing, ...) must not suppress the driver-parsed
-        # HEADLINE line.  bench_train_stall runs FIRST: its subprocess
+        # HEADLINE line.  The two train configs run FIRST: their subprocess
         # measurements need exclusive chip ownership, so the parent must not
         # have initialized the device runtime yet.
-        for fn in (bench_train_stall, bench_mnist, bench_imagenet,
-                   bench_converter, bench_ngram, bench_north_star):
+        for fn in (bench_train_stall, bench_north_star_train, bench_mnist,
+                   bench_imagenet, bench_converter, bench_ngram,
+                   bench_north_star):
             try:
                 fn(tmp)
             except Exception:  # noqa: BLE001 - reported, never fatal
                 print(json.dumps({"metric": fn.__name__, "error":
                                   traceback.format_exc(limit=3)}), flush=True)
+        # penultimate summary: replay every metric in ONE line directly before
+        # the headline, so any tail window of the driver's capture holds all
+        # numbers even if early lines scrolled out (BENCH_r03 truncation)
+        print(json.dumps({"metric": "bench_summary",
+                          "metrics": {ln["metric"]: [ln["value"],
+                                                     ln["vs_baseline"]]
+                                      for ln in _EMITTED}}), flush=True)
         bench_hello_world(tmp)  # headline LAST: the driver parses the last line
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

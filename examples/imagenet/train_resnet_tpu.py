@@ -12,7 +12,10 @@ This is the BASELINE.md north-star shape: samples/sec/chip feeding ResNet-50.
 """
 
 import argparse
+import os
+import queue
 import tempfile
+import threading
 import time
 
 import jax
@@ -52,9 +55,115 @@ def generate_dataset(url: str, rows: int, side: int, seed: int = 0) -> None:
                   row_group_size_rows=max(rows // 8, 1), mode="overwrite")
 
 
+def build_tfrecord(dataset_url: str, tfr_path: str) -> None:
+    """Extract the STORED jpeg bytes from the parquet dataset into a TFRecord
+    so the tf.data comparator reads its native format with zero parquet
+    overhead (best effort for tf.data; same bytes, same decode work)."""
+    import pyarrow.dataset as pads
+    import tensorflow as tf
+
+    table = pads.dataset(dataset_url, format="parquet").to_table(
+        columns=["label", "image"])
+    # write-then-rename: an interrupted build must not leave a truncated
+    # .tfrecord that a later 'if exists' check happily reuses
+    tmp_path = tfr_path + ".tmp"
+    with tf.io.TFRecordWriter(tmp_path) as w:
+        for b, lbl in zip(table.column("image").to_pylist(),
+                          table.column("label").to_pylist()):
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "image": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b])),
+                "label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[int(lbl)]))}))
+            w.write(ex.SerializeToString())
+    os.replace(tmp_path, tfr_path)
+
+
+class TfdataDeviceFeed:
+    """tf.data comparator for the north-star A/B: TFRecord -> decode_jpeg ->
+    batch -> prefetch(AUTOTUNE), plus a background device-transfer thread
+    (depth = ``prefetch``) so both pipelines overlap host->device copies with
+    compute - the A/B then measures the INPUT pipelines, not a strawman
+    synchronous ``device_put`` on the tf.data consumer path.
+
+    Mirrors JaxDataLoader's consumer contract: ``next()`` yields a dict of
+    ready device arrays and ``consumer_wait_s`` accumulates the time the
+    consumer spent blocked - the input-attributable device idle.
+    """
+
+    def __init__(self, tfr_path: str, global_batch: int, prefetch: int,
+                 image_sharding, label_sharding):
+        import tensorflow as tf
+
+        feat = {"image": tf.io.FixedLenFeature([], tf.string),
+                "label": tf.io.FixedLenFeature([], tf.int64)}
+
+        def _parse(raw):
+            ex = tf.io.parse_single_example(raw, feat)
+            return tf.io.decode_jpeg(ex["image"], channels=3), ex["label"]
+
+        ds = (tf.data.TFRecordDataset(tfr_path).repeat()
+                .map(_parse, num_parallel_calls=tf.data.AUTOTUNE,
+                     deterministic=False)
+                .batch(global_batch, drop_remainder=True)
+                .prefetch(tf.data.AUTOTUNE))
+        self._it = ds.as_numpy_iterator()
+        self._image_sharding = image_sharding
+        self._label_sharding = label_sharding
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+        self._stop = threading.Event()
+        self.consumer_wait_s = 0.0
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="tfdata-device-feed")
+        self._thread.start()
+
+    def _produce(self):
+        try:
+            while not self._stop.is_set():
+                img, lbl = next(self._it)
+                batch = {"image": jax.device_put(img, self._image_sharding),
+                         "label": jax.device_put(lbl, self._label_sharding)}
+                jax.block_until_ready(batch)  # commit in the transfer thread
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as exc:  # noqa: BLE001 - re-raised in __next__
+            # a silently-dead producer would block the consumer forever on
+            # q.get(); ship the error as a sentinel instead (without blocking
+            # past shutdown if the consumer is already gone)
+            while not self._stop.is_set():
+                try:
+                    self._q.put(("__error__", exc), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        batch = self._q.get()
+        self.consumer_wait_s += time.perf_counter() - t0
+        if isinstance(batch, tuple) and batch and batch[0] == "__error__":
+            raise RuntimeError("tf.data feed producer failed") from batch[1]
+        return batch
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
 def train(dataset_url: str, steps: int, global_batch: int, side: int,
           num_classes: int = 1000, decode: str = "device",
-          workers: int = 4, prefetch: int = 2, cache: str = "null") -> dict:
+          workers: int = 4, prefetch: int = 2, cache: str = "null",
+          input_pipeline: str = "petastorm") -> dict:
     """Run ``steps`` real ResNet-50 train steps fed by the loader; returns a
     metrics dict incl. samples/sec/chip and the input-attributable device-idle
     percentage (consumer wait vs wall time over the measured window)."""
@@ -87,25 +196,48 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    # decode='device': hybrid jpeg decode - host does only entropy decode,
-    # dequant + IDCT + upsample + color run on-chip (ops/jpeg.py)
-    if decode == "device":
-        from petastorm_tpu.native import image as native_image
+    if input_pipeline == "tfdata":
+        # the north-star comparator: SAME stored jpegs (re-packed as TFRecord,
+        # tf.data's native format), SAME train_step, symmetric background
+        # device transfer - only the input pipeline differs
+        tfr = dataset_url.rstrip("/") + ".tfrecord"
+        if not os.path.exists(tfr):
+            build_tfrecord(dataset_url, tfr)
+        feed = TfdataDeviceFeed(tfr, global_batch, prefetch,
+                                NamedSharding(mesh, P("data")),
+                                NamedSharding(mesh, P("data")))
+        decode = "tfdata-host"
+    else:
+        # decode='device': hybrid jpeg decode - host does only entropy decode,
+        # dequant + IDCT + upsample + color run on-chip (ops/jpeg.py)
+        if decode == "device":
+            from petastorm_tpu.native import image as native_image
 
-        if not native_image.available():
-            print("native image library unavailable; falling back to host decode")
-            decode = "host"
-    placement = {"image": "device"} if decode == "device" else None
-    # cache='memory' keeps decoded (or entropy-decoded, for decode='device')
-    # batches in a host LRU: epochs after the first skip parquet+jpeg work
-    # entirely - the answer for datasets that fit host RAM
-    reader = make_reader(dataset_url, num_epochs=None, workers_count=workers,
-                         decode_placement=placement, cache_type=cache)
+            if not native_image.available():
+                print("native image library unavailable; falling back to host"
+                      " decode")
+                decode = "host"
+        placement = {"image": "device"} if decode == "device" else None
+        # cache='memory' keeps decoded (or entropy-decoded, for
+        # decode='device') batches in a host LRU: epochs after the first skip
+        # parquet+jpeg work entirely - the answer for datasets that fit RAM
+        reader = make_reader(dataset_url, num_epochs=None,
+                             workers_count=workers,
+                             decode_placement=placement, cache_type=cache)
+        feed = JaxDataLoader(reader, batch_size=global_batch, mesh=mesh,
+                             prefetch=prefetch,
+                             shardings={"image": P("data"),
+                                        "label": P("data")})
+
+    def consumer_wait(f):
+        # both feeds expose the same signal: seconds the consumer spent
+        # blocked waiting for a ready device batch
+        return (f.diagnostics["consumer_wait_s"] if hasattr(f, "diagnostics")
+                else f.consumer_wait_s)
+
     step = 0
-    with JaxDataLoader(reader, batch_size=global_batch, mesh=mesh,
-                       prefetch=prefetch,
-                       shardings={"image": P("data"), "label": P("data")}) as loader:
-        it = iter(loader)
+    with feed:
+        it = iter(feed)
         # warmup: compile, fill queues
         aug_key = jax.random.PRNGKey(17)
         batch = next(it)
@@ -113,10 +245,10 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
                                              batch["image"], batch["label"],
                                              aug_key)
         jax.block_until_ready(loss)
-        # consumer_wait_s accumulates while __next__ blocks on the prefetch
+        # consumer wait accumulates while the consumer blocks on the prefetch
         # queue: the delta over the measured window IS the device-idle time
         # attributable to input starvation during REAL train steps
-        wait0 = loader.diagnostics["consumer_wait_s"]
+        wait0 = consumer_wait(feed)
         t0 = time.perf_counter()
         for batch in it:
             params, opt_state, loss = train_step(params, opt_state,
@@ -127,8 +259,8 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
                 break
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
-        diag = loader.diagnostics
-        input_wait_s = diag["consumer_wait_s"] - wait0
+        input_wait_s = consumer_wait(feed) - wait0
+        diag = feed.diagnostics if hasattr(feed, "diagnostics") else {}
     samples = steps * global_batch
     return {
         "samples_per_sec": samples / dt,
@@ -138,6 +270,7 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
         "global_batch": global_batch,
         "wall_s": dt,
         "decode": decode,
+        "input": input_pipeline,
         "n_devices": len(devices),
         "final_loss": float(loss),
         "diagnostics": diag,
@@ -159,6 +292,10 @@ if __name__ == "__main__":
     parser.add_argument("--cache", choices=("null", "memory", "local-disk"),
                         default="null",
                         help="memory = host LRU; warm epochs skip all decode")
+    parser.add_argument("--input", choices=("petastorm", "tfdata"),
+                        default="petastorm",
+                        help="tfdata = north-star comparator: same jpegs via"
+                             " TFRecord + tf.data feeding the SAME train step")
     parser.add_argument("--skip-generate", action="store_true",
                         help="dataset-url already holds the dataset")
     parser.add_argument("--json", action="store_true",
@@ -169,7 +306,8 @@ if __name__ == "__main__":
         generate_dataset(url, args.rows, args.side)
     m = train(url, args.steps, args.global_batch, args.side,
               num_classes=args.num_classes, decode=args.decode,
-              workers=args.workers, prefetch=args.prefetch, cache=args.cache)
+              workers=args.workers, prefetch=args.prefetch, cache=args.cache,
+              input_pipeline=args.input)
     if args.json:
         import json
 
