@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One-command CI entry: build the native libraries, then run the full suite.
+#
+# Reference analog: /root/reference/docker/ (the reference's CI container) and
+# its tox/pytest entry points. Here the native build is on-demand (g++ via
+# petastorm_tpu.native.build, cached .so), so "build" is just forcing it once
+# up front where a toolchain failure surfaces as a CI error instead of a
+# silent host-decode fallback at test time.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+python - <<'PY'
+from petastorm_tpu.native import build
+for name in ("image_decode", "shm_arena"):
+    path = build.build(name, force=True)
+    assert path, f"native build of {name} failed (see warnings above)"
+    print(f"built {name}: {path}")
+PY
+
+echo "== test suite (8-device virtual CPU mesh; see tests/conftest.py) =="
+python -m pytest tests/ -q "$@"
+
+echo "== driver entry compile-check =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python __graft_entry__.py 8
+echo "CI OK"

@@ -245,215 +245,25 @@ def test_loader_factory_failure_does_not_leak_reader(tmp_path):
     assert threading.active_count() <= before
 
 
-# -- Spark DataFrame input (mocked pyspark, same approach as test_interop) ----
+# -- Spark DataFrame input (mocked pyspark, shared with the example) ----------
 #
-# The fake DataFrame's toPandas() raises: the converter's Spark path must
-# materialize on the "executors" (df.write.parquet) and never collect to the
-# driver (reference spark_dataset_converter.py:546-562).
+# The pinned mock lives in petastorm_tpu.test_util.mock_pyspark (also used by
+# examples/spark_converter/); toPandas() raises so the converter's Spark path
+# must materialize on the "executors" (df.write.parquet), never collect to
+# the driver (reference spark_dataset_converter.py:546-562).
 
-
-class _FakeVector:
-    def __init__(self, values):
-        self._values = np.asarray(values, dtype=np.float64)
-
-    def toArray(self):
-        return self._values
-
-
-class _FakeType:
-    def __init__(self, name, element=None):
-        self._name = name
-        self.elementType = element
-
-    @property
-    def type_name(self):
-        return self._name
-
-
-def _fake_type(name, element=None):
-    t = _FakeType(name, element)
-    t.__class__ = type(name, (_FakeType,), {})  # type(x).__name__ drives code
-    return t
-
-
-class _FakeField:
-    def __init__(self, name, data_type):
-        self.name = name
-        self.dataType = data_type
-
-
-class _FakeSchema:
-    def __init__(self, fields):
-        self.fields = fields
-
-    def json(self):
-        return "|".join(f"{f.name}:{type(f.dataType).__name__}"
-                        for f in self.fields)
-
-
-class _FakeCol:
-    def __init__(self, name):
-        self.name = name
-
-    def cast(self, target):
-        return ("cast", self.name, target)
+from petastorm_tpu.test_util.mock_pyspark import (  # noqa: E402
+    MockSparkDataFrame as _FakeSparkDataFrame,
+    build_mock_pyspark_modules,
+    mock_spark_dataframe as _spark_frame,
+)
 
 
 def _install_fake_pyspark(monkeypatch):
-    """Mock pyspark pinned to the EXACT API surface converter.py uses
-    (signatures per pyspark 3.5; see docs/operations.md 'Spark converter
-    verification'): ``pyspark.sql.functions.col(name: str)``,
-    ``pyspark.ml.functions.vector_to_array(col: Column, dtype: str)`` with
-    dtype in {'float32','float64'}.  Any call outside these signatures fails
-    the test instead of passing silently."""
     import sys
-    import types
 
-    root = types.ModuleType("pyspark")
-    sql = types.ModuleType("pyspark.sql")
-    sqlf = types.ModuleType("pyspark.sql.functions")
-    ml = types.ModuleType("pyspark.ml")
-    mlf = types.ModuleType("pyspark.ml.functions")
-
-    def _col(name):
-        assert isinstance(name, str) and name, \
-            f"pyspark.sql.functions.col takes a column-name string, got {name!r}"
-        return _FakeCol(name)
-
-    def _vector_to_array(col, dtype="float64"):
-        assert isinstance(col, _FakeCol), \
-            f"vector_to_array takes a Column (from col()), got {type(col)}"
-        assert dtype in ("float32", "float64"), \
-            f"vector_to_array dtype must be 'float32'/'float64', got {dtype!r}"
-        return ("v2a", col.name, dtype)
-
-    sqlf.col = _col
-    mlf.vector_to_array = _vector_to_array
-    for name, mod in (("pyspark", root), ("pyspark.sql", sql),
-                      ("pyspark.sql.functions", sqlf), ("pyspark.ml", ml),
-                      ("pyspark.ml.functions", mlf)):
+    for name, mod in build_mock_pyspark_modules().items():
         monkeypatch.setitem(sys.modules, name, mod)
-
-
-class _FakeSparkDataFrame:
-    """Pandas-backed stand-in: withColumn applies the fake expressions, write
-    splits into two 'executor' part files, toPandas() is forbidden."""
-
-    def __init__(self, pdf, schema, plan_tag):
-        self._pdf = pdf
-        self.schema = schema
-        self._plan_tag = plan_tag
-
-        class _QE:
-            def queryExecution(self_inner):
-                class _A:
-                    def analyzed(self2):
-                        class _S:
-                            def toString(self3):
-                                return plan_tag
-                        return _S()
-                return _A()
-        self._jdf = _QE()
-
-    def toPandas(self):
-        raise AssertionError("driver-side collection: the Spark path must"
-                            " materialize on executors")
-
-    def withColumn(self, name, expr):
-        pdf = self._pdf.copy()
-        fields = list(self.schema.fields)
-        idx = next(i for i, f in enumerate(fields) if f.name == name)
-        kind = expr[0]
-        if kind == "v2a":
-            _, src, dtype = expr
-            np_t = np.float32 if dtype == "float32" else np.float64
-            pdf[name] = [np.asarray(v.toArray(), dtype=np_t)
-                         for v in pdf[src]]
-            fields[idx] = _FakeField(name, _fake_type(
-                "ArrayType", _fake_type(
-                    "FloatType" if dtype == "float32" else "DoubleType")))
-        elif kind == "cast":
-            _, src, target = expr
-            # pin cast targets to valid Spark SQL type strings (Column.cast
-            # accepts a DDL-formatted type name)
-            assert target in ("float", "double", "array<float>",
-                              "array<double>"), \
-                f"Column.cast called with non-Spark type string {target!r}"
-            if target in ("float", "double"):
-                np_t = np.float32 if target == "float" else np.float64
-                pdf[name] = pdf[src].astype(np_t)
-                fields[idx] = _FakeField(name, _fake_type(
-                    "FloatType" if target == "float" else "DoubleType"))
-            else:  # array<float> / array<double>
-                np_t = np.float32 if "float" in target else np.float64
-                pdf[name] = [np.asarray(v, dtype=np_t) for v in pdf[src]]
-                fields[idx] = _FakeField(name, _fake_type(
-                    "ArrayType", _fake_type(
-                        "FloatType" if "float" in target else "DoubleType")))
-        else:
-            raise AssertionError(f"unknown fake expr {expr!r}")
-        return _FakeSparkDataFrame(pdf, _FakeSchema(fields),
-                                   self._plan_tag + f"+{name}:{kind}")
-
-    #: DataFrameWriter call sequences, one list per .write chain (pinned-API
-    #: assertion surface; cleared by tests that inspect it)
-    write_calls = []
-
-    @property
-    def write(self):
-        df = self
-        calls = []
-        _FakeSparkDataFrame.write_calls.append(calls)
-
-        class _Writer:
-            def mode(self_inner, m):
-                # converter.py must write mode('overwrite') into its fresh tmp
-                # dir (DataFrameWriter.mode accepts a saveMode string)
-                assert m == "overwrite", f"unexpected write mode {m!r}"
-                calls.append(("mode", m))
-                return self_inner
-
-            def option(self_inner, k, v):
-                # the two options the reference sets (spark_dataset_converter
-                # .py:553-555): parquet codec + target block size
-                assert k in ("compression", "parquet.block.size"), \
-                    f"unexpected DataFrameWriter.option key {k!r}"
-                if k == "parquet.block.size":
-                    assert isinstance(v, int) and v > 0, v
-                else:
-                    assert isinstance(v, str) and v, v
-                calls.append(("option", k, v))
-                return self_inner
-
-            def parquet(self_inner, url):
-                assert isinstance(url, str) and "://" in url or url.startswith("/"), \
-                    f"DataFrameWriter.parquet takes a path/URL string, got {url!r}"
-                calls.append(("parquet", url))
-                path = url[len("file://"):] if url.startswith("file://") else url
-                os.makedirs(path, exist_ok=True)
-                n = len(df._pdf)
-                for part, sl in enumerate((slice(0, n // 2), slice(n // 2, n))):
-                    table = pa.Table.from_pandas(df._pdf.iloc[sl],
-                                                 preserve_index=False)
-                    import pyarrow.parquet as pq
-                    pq.write_table(table,
-                                   os.path.join(path, f"part-{part:05d}.parquet"))
-                open(os.path.join(path, "_SUCCESS"), "w").close()
-        return _Writer()
-
-
-def _spark_frame(n=32):
-    pdf = pd.DataFrame({
-        "id": np.arange(n, dtype=np.int64),
-        "x": np.linspace(0, 1, n).astype(np.float64),
-        "vec": [_FakeVector([i, i + 0.5, i + 0.25]) for i in range(n)],
-    })
-    schema = _FakeSchema([
-        _FakeField("id", _fake_type("LongType")),
-        _FakeField("x", _fake_type("DoubleType")),
-        _FakeField("vec", _fake_type("VectorUDT")),
-    ])
-    return _FakeSparkDataFrame(pdf, schema, plan_tag=f"fake-plan-{n}")
 
 
 def test_spark_df_materializes_on_executors(tmp_path, monkeypatch):

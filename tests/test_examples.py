@@ -87,3 +87,14 @@ def test_preemption_example_exact_resume(tmp_path):
     assert seen_a + seen_b == 1024      # every row exactly once across runs
     assert seen_b > 0                   # the preemption really cut mid-epoch
     assert np.isfinite(loss)
+
+
+def test_spark_converter_example(tmp_path, capsys):
+    from examples.spark_converter.convert_and_feed import main
+
+    main(cache_dir=str(tmp_path / "cache"), rows=32)
+    out = capsys.readouterr().out
+    assert "converted: 32 rows" in out
+    assert "jax loader delivered 32 rows" in out
+    assert "torch DataLoader delivered 32 rows" in out
+    assert "fingerprint cache" in out
