@@ -46,3 +46,20 @@ def test_multiprocess_data_plane(tmp_path):
     # the interesting regime actually occurred: unequal drains forced pads
     assert sum(report["pad_counts"]) > 0
     assert len(set(report["drained_real_per_process"])) > 1
+
+
+def test_multiprocess_context_parallel(tmp_path):
+    """Ring attention's ppermute K/V rotation and Ulysses' all_to_all cross
+    REAL process boundaries: sequence-sharded loader delivery over a mesh
+    spanning 2 OS processes, outputs matching a float64 full-attention
+    reference on every host."""
+    from petastorm_tpu.parallel.selfcheck import run_context_parallel_check
+
+    report = run_context_parallel_check(num_processes=2,
+                                        devices_per_process=2,
+                                        workdir=str(tmp_path), timeout=240.0)
+    if report["timeout"]:
+        pytest.skip(f"context-parallel check timed out: {report['failures']}")
+    assert report["ok"], report["failures"]
+    assert report["err_ring"] < 2e-4
+    assert report["err_uly"] < 2e-4
