@@ -71,7 +71,8 @@ API = [
     ("petastorm_tpu.parallel.mesh", ["local_data_slice", "shard_options_from_jax",
                                  "data_parallel_mesh", "sharding_for_batch"]),
     ("petastorm_tpu.parallel.selfcheck", ["run_selfcheck",
-                                 "run_context_parallel_check"]),
+                                 "run_context_parallel_check",
+                                 "run_distributed_write_check"]),
     ("petastorm_tpu.parallel.write", ["distributed_write_dataset"]),
     ("petastorm_tpu.tools.copy_dataset", ["copy_dataset"]),
     ("petastorm_tpu.tools.show_metadata", ["describe"]),

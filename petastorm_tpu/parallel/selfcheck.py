@@ -24,6 +24,11 @@ subset of one shared device mesh, and drives
                        contract; see JaxDataLoader.drain docs)
 * elastic resume     - a second launch under a DIFFERENT process count
                        resumes from ``elastic_resume()`` of the saved cursors
+* coordinated writes - ``run_distributed_write_check``: the default
+                       ``sync_global_devices`` barrier path of
+                       ``distributed_write_dataset`` (never reachable from
+                       single-process tests), geometry sidecar merge, and
+                       exact all-host readback
 * context parallel   - ``run_context_parallel_check``: sequence-sharded
                        delivery plus ring attention (ppermute) and Ulysses
                        (all_to_all) over a mesh SPANNING the processes,
@@ -95,6 +100,8 @@ def _worker_main(args) -> None:
         _worker_resume(args)
     elif args.phase == "cp":
         _worker_cp(args)
+    elif args.phase == "write":
+        _worker_write(args)
     else:
         raise ValueError(f"unknown phase {args.phase!r}")
 
@@ -344,6 +351,85 @@ def run_context_parallel_check(num_processes: int = 2,
     # Ulysses runs only when the head count divides the device count; ring
     # alone still proves the cross-process collective path
     report["err_uly"] = max(uly) if uly else None
+    report["ok"] = not report["failures"]
+    return report
+
+
+def _worker_write(args) -> None:
+    """Coordinated multi-host dataset write with the DEFAULT sync path: real
+    ``multihost_utils.sync_global_devices`` barriers over Gloo (the in-repo
+    tests simulate hosts with a threading.Barrier; this executes the actual
+    collective), host-0 metadata stamp incl. merged geometry sidecars, then
+    every host reads the stamped dataset back and checksums it."""
+    import jax
+    import numpy as np
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.parallel.write import distributed_write_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    pid = jax.process_index()
+    count = jax.process_count()
+    schema = Schema("MpWrite", [
+        Field(_ID, np.int32),
+        Field("image", np.uint8, (None, None, 3),
+              CompressedImageCodec("png")),  # lossless: exact readback
+    ])
+    total = args.global_batch * 8
+    rng = np.random.default_rng(7)
+    all_rows = [{_ID: np.int32(i),
+                 "image": rng.integers(0, 255, ((16, 24) if i % 2 else (24, 16))
+                                       + (3,), dtype=np.uint8)}
+                for i in range(total)]
+    url = os.path.join(args.out, "mp_written_ds")
+    # DEFAULT coordination: process_index/count and sync_fn come from the JAX
+    # distributed runtime - the code path single-process tests cannot reach
+    files = distributed_write_dataset(url, schema, all_rows[pid::count],
+                                      row_group_size_rows=4)
+    ids = []
+    with make_batch_reader(url, num_epochs=1, workers_count=1) as r:
+        declared = r.declared_geometries
+        for cb in r.iter_batches():
+            ids.extend(np.asarray(cb.columns[_ID]).astype(int).tolist())
+    assert sorted(ids) == list(range(total)), (len(ids), total)
+    assert sorted(declared["image"]) == [(16, 24, 3), (24, 16, 3)], declared
+    with open(os.path.join(args.out, f"write_{pid}.json"), "w") as f:
+        json.dump({"process_id": pid, "files": len(files),
+                   "rows_read": len(ids),
+                   "geometries": sorted(declared["image"])}, f)
+
+
+def run_distributed_write_check(num_processes: int = 2,
+                                global_batch: int = 8,
+                                timeout: float = 240.0,
+                                workdir: Optional[str] = None) -> Dict:
+    """Multi-host coordinated write through the REAL sync_global_devices
+    barriers; see ``_worker_write``.  Returns {"ok", "failures", ...}."""
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="petastorm_tpu_wrcheck_")
+    os.makedirs(workdir, exist_ok=True)
+    report: Dict = {"ok": False, "timeout": False, "failures": [],
+                    "workdir": workdir}
+    logs: List[str] = []
+    report["logs"] = logs
+    error = _launch("write", num_processes, 1, "unused", workdir, timeout,
+                    logs, ["--global-batch", str(global_batch)])
+    if error:
+        report["failures"].append(error)
+        report["timeout"] = "timed out" in error
+        return report
+    workers = []
+    for pid in range(num_processes):
+        with open(os.path.join(workdir, f"write_{pid}.json")) as f:
+            workers.append(json.load(f))
+    report["rows_read"] = workers[0]["rows_read"]
+    report["files_per_host"] = [w["files"] for w in workers]
+    if any(w["rows_read"] != workers[0]["rows_read"] for w in workers):
+        report["failures"].append("hosts read back different row counts")
+    if any(w["files"] == 0 for w in workers):
+        report["failures"].append("a host wrote no part files")
     report["ok"] = not report["failures"]
     return report
 
@@ -643,7 +729,7 @@ def _main() -> int:
     parser.add_argument("--worker", action="store_true",
                         help="internal: run as a spawned worker process")
     parser.add_argument("--phase", default="pipeline",
-                        choices=["pipeline", "resume", "cp"])
+                        choices=["pipeline", "resume", "cp", "write"])
     parser.add_argument("--process-id", type=int, default=0)
     parser.add_argument("--num-processes", type=int, default=2)
     parser.add_argument("--coordinator", default=None)
