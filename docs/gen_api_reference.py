@@ -72,7 +72,8 @@ API = [
                                  "data_parallel_mesh", "sharding_for_batch"]),
     ("petastorm_tpu.parallel.selfcheck", ["run_selfcheck",
                                  "run_context_parallel_check",
-                                 "run_distributed_write_check"]),
+                                 "run_distributed_write_check",
+                                 "run_mesh2d_check"]),
     ("petastorm_tpu.parallel.write", ["distributed_write_dataset"]),
     ("petastorm_tpu.tools.copy_dataset", ["copy_dataset"]),
     ("petastorm_tpu.tools.show_metadata", ["describe"]),

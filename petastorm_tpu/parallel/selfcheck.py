@@ -24,6 +24,9 @@ subset of one shared device mesh, and drives
                        contract; see JaxDataLoader.drain docs)
 * elastic resume     - a second launch under a DIFFERENT process count
                        resumes from ``elastic_resume()`` of the saved cursors
+* dp x tp meshes     - ``run_mesh2d_check``: 2-D mesh delivery with the data
+                       axis crossing processes and tensor parallelism inside
+                       each, one jitted reduction over both axes
 * coordinated writes - ``run_distributed_write_check``: the default
                        ``sync_global_devices`` barrier path of
                        ``distributed_write_dataset`` (never reachable from
@@ -68,6 +71,9 @@ MASK_FIELD = "mask"
 #: head count of the context-parallel check's attention (Ulysses runs only
 #: when this divides the device count; ring has no such constraint)
 _CP_HEADS = 4
+#: vocab/hidden of the 2-D mesh check's embedding computation
+_M2D_VOCAB = 32
+_M2D_HIDDEN = 16
 _ID = "id"
 _VALUE = "value"
 _VALUE_DIM = 4
@@ -102,6 +108,8 @@ def _worker_main(args) -> None:
         _worker_cp(args)
     elif args.phase == "write":
         _worker_write(args)
+    elif args.phase == "mesh2d":
+        _worker_mesh2d(args)
     else:
         raise ValueError(f"unknown phase {args.phase!r}")
 
@@ -327,21 +335,11 @@ def run_context_parallel_check(num_processes: int = 2,
                         "x": rng.standard_normal((seq, dim)).astype(np.float32)}
                        for i in range(global_batch)],
                       row_group_size_rows=global_batch)
-    report: Dict = {"ok": False, "timeout": False, "failures": [],
-                    "workdir": workdir}
-    logs: List[str] = []
-    report["logs"] = logs
-    error = _launch("cp", num_processes, devices_per_process, dataset,
-                    workdir, timeout, logs,
-                    ["--global-batch", str(global_batch)])
-    if error:
-        report["failures"].append(error)
-        report["timeout"] = "timed out" in error
+    report, workers = _launch_and_collect(
+        "cp", num_processes, devices_per_process, dataset, workdir, timeout,
+        ["--global-batch", str(global_batch)])
+    if workers is None:
         return report
-    workers = []
-    for pid in range(num_processes):
-        with open(os.path.join(workdir, f"cp_{pid}.json")) as f:
-            workers.append(json.load(f))
     sums = {w["ring_sum"] for w in workers}
     if len(sums) != 1:
         report["failures"].append(
@@ -351,6 +349,151 @@ def run_context_parallel_check(num_processes: int = 2,
     # Ulysses runs only when the head count divides the device count; ring
     # alone still proves the cross-process collective path
     report["err_uly"] = max(uly) if uly else None
+    report["ok"] = not report["failures"]
+    return report
+
+
+def _worker_mesh2d(args) -> None:
+    """2-D mesh delivery with the DATA axis crossing the process boundary
+    and the MODEL axis inside each process (dp x tp, the standard pod
+    layout): sequence axis of 'tokens' sharded over 'model', batch over
+    'data', then one jitted computation with a tp-sharded weight whose mean
+    reduces over BOTH axes - psum inside each process, cross-process data
+    reduction over Gloo - must equal a local numpy reference and agree
+    bit-for-bit across hosts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.parallel.mesh import shard_options_from_jax
+    from petastorm_tpu.reader import make_reader
+
+    pid = jax.process_index()
+    devices = jax.devices()
+    per = len(jax.local_devices())
+    mesh = Mesh(np.asarray(devices).reshape(len(devices) // per, per),
+                ("data", "model"))
+    rep = NamedSharding(mesh, P())
+    cur, count = shard_options_from_jax()
+    reader = make_reader(args.dataset, cur_shard=cur, shard_count=count,
+                         shuffle_row_groups=False, num_epochs=1,
+                         workers_count=1)
+    with JaxDataLoader(reader, batch_size=args.global_batch, mesh=mesh,
+                       shardings={_ID: P("data"),
+                                  "tokens": P("data", "model")}) as loader:
+        batch = next(iter(loader))
+        ids_g, tokens_g = batch[_ID], batch["tokens"]
+
+    rng = np.random.default_rng(5)
+    emb_np = rng.standard_normal((_M2D_VOCAB, _M2D_HIDDEN)).astype(np.float32)
+    w_np = rng.standard_normal((_M2D_HIDDEN, _M2D_HIDDEN)).astype(np.float32)
+    w_sharding = NamedSharding(mesh, P(None, "model"))  # tp-sharded weight
+    W = jax.make_array_from_callback(w_np.shape, w_sharding,
+                                     lambda idx: w_np[idx])
+    emb = jax.make_array_from_callback(emb_np.shape, rep,
+                                       lambda idx: emb_np[idx])
+    loss_fn = jax.jit(
+        lambda t, w, e: jnp.mean(jnp.einsum("bsh,hk->bsk", e[t], w)),
+        out_shardings=rep)
+    loss = float(loss_fn(tokens_g, W, emb))
+
+    replicate = jax.jit(lambda t: t, out_shardings=rep)
+    ids = np.asarray(replicate(ids_g)).astype(int)
+    tokens = np.asarray(replicate(tokens_g))
+    S = tokens.shape[1]
+    expected = (ids[:, None] * 7 + np.arange(S)[None, :]) % _M2D_VOCAB
+    assert np.array_equal(tokens, expected), "2-D delivery scrambled rows"
+    ref = float(np.mean(np.einsum("bsh,hk->bsk", emb_np[tokens], w_np)))
+    err = abs(loss - ref) / max(abs(ref), 1e-9)
+    assert err < 1e-5, f"dp x tp collective diverged: {loss} vs {ref}"
+
+    # every addressable token shard must live inside this process's data row
+    lo = pid * (args.global_batch // jax.process_count())
+    hi = lo + args.global_batch // jax.process_count()
+    for sh in tokens_g.addressable_shards:
+        b_sl = sh.index[0]
+        # a replicated-delivery regression shows up as slice(None) bounds -
+        # which a coalescing check would wave through on process 0
+        assert b_sl.start is not None and b_sl.stop is not None, sh.index
+        assert lo <= b_sl.start and b_sl.stop <= hi, sh.index
+    with open(os.path.join(args.out, f"mesh2d_{pid}.json"), "w") as f:
+        json.dump({"process_id": pid, "loss": loss, "ref": ref,
+                   "mesh": {k: int(v) for k, v in mesh.shape.items()}}, f)
+
+
+def _launch_and_collect(phase: str, num_processes: int,
+                        devices_per_process: int, dataset: str, workdir: str,
+                        timeout: float, extra: Optional[List[str]] = None,
+                        result_prefix: Optional[str] = None):
+    """Shared launcher boilerplate: spawn the workers, wait, load their
+    result JSONs.  Returns ``(report, workers)``; ``workers`` is None when
+    the launch failed (``report['failures']``/``'timeout'`` say why)."""
+    report: Dict = {"ok": False, "timeout": False, "failures": [],
+                    "workdir": workdir}
+    logs: List[str] = []
+    report["logs"] = logs
+    error = _launch(phase, num_processes, devices_per_process, dataset,
+                    workdir, timeout, logs, extra)
+    if error:
+        report["failures"].append(error)
+        report["timeout"] = "timed out" in error
+        return report, None
+    workers = []
+    prefix = result_prefix or phase
+    for pid in range(num_processes):
+        with open(os.path.join(workdir, f"{prefix}_{pid}.json")) as f:
+            workers.append(json.load(f))
+    return report, workers
+
+
+def run_mesh2d_check(num_processes: int = 2, devices_per_process: int = 2,
+                     global_batch: int = 8, seq: int = 8,
+                     timeout: float = 240.0,
+                     workdir: Optional[str] = None) -> Dict:
+    """dp x tp delivery + collectives over a 2-D mesh whose data axis crosses
+    real process boundaries; see ``_worker_mesh2d``."""
+    import tempfile
+
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.schema import Field, Schema
+
+    assert global_batch % num_processes == 0 and \
+        global_batch >= num_processes, (
+        f"global_batch ({global_batch}) must divide over the data axis"
+        f" ({num_processes} processes)")
+    assert seq % devices_per_process == 0, (
+        f"seq ({seq}) must divide over the model axis"
+        f" ({devices_per_process} devices/process)")
+    workdir = workdir or tempfile.mkdtemp(prefix="petastorm_tpu_m2dcheck_")
+    os.makedirs(workdir, exist_ok=True)
+    # every parameter that shapes the written file is in the cache name, so
+    # a reused workdir can never serve a stale-geometry dataset
+    dataset = os.path.join(
+        workdir, f"m2d_b{global_batch}_s{seq}_np{num_processes}")
+    if not os.path.exists(dataset):
+        schema = Schema("Mesh2d", [
+            Field(_ID, np.int32),
+            Field("tokens", np.int32, (seq,)),
+        ])
+        total = global_batch * 4
+        write_dataset(dataset, schema,
+                      [{_ID: np.int32(i),
+                        "tokens": ((i * 7 + np.arange(seq)) % _M2D_VOCAB
+                                   ).astype(np.int32)}
+                       for i in range(total)],
+                      row_group_size_rows=global_batch // num_processes)
+    report, workers = _launch_and_collect(
+        "mesh2d", num_processes, devices_per_process, dataset, workdir,
+        timeout, ["--global-batch", str(global_batch)])
+    if workers is None:
+        return report
+    losses = {w["loss"] for w in workers}
+    if len(losses) != 1:
+        report["failures"].append(f"hosts realized different losses: {losses}")
+    report["loss"] = workers[0]["loss"]
+    report["mesh"] = workers[0]["mesh"]
     report["ok"] = not report["failures"]
     return report
 
@@ -410,20 +553,11 @@ def run_distributed_write_check(num_processes: int = 2,
 
     workdir = workdir or tempfile.mkdtemp(prefix="petastorm_tpu_wrcheck_")
     os.makedirs(workdir, exist_ok=True)
-    report: Dict = {"ok": False, "timeout": False, "failures": [],
-                    "workdir": workdir}
-    logs: List[str] = []
-    report["logs"] = logs
-    error = _launch("write", num_processes, 1, "unused", workdir, timeout,
-                    logs, ["--global-batch", str(global_batch)])
-    if error:
-        report["failures"].append(error)
-        report["timeout"] = "timed out" in error
+    report, workers = _launch_and_collect(
+        "write", num_processes, 1, "unused", workdir, timeout,
+        ["--global-batch", str(global_batch)])
+    if workers is None:
         return report
-    workers = []
-    for pid in range(num_processes):
-        with open(os.path.join(workdir, f"write_{pid}.json")) as f:
-            workers.append(json.load(f))
     report["rows_read"] = workers[0]["rows_read"]
     report["files_per_host"] = [w["files"] for w in workers]
     if any(w["rows_read"] != workers[0]["rows_read"] for w in workers):
@@ -729,7 +863,8 @@ def _main() -> int:
     parser.add_argument("--worker", action="store_true",
                         help="internal: run as a spawned worker process")
     parser.add_argument("--phase", default="pipeline",
-                        choices=["pipeline", "resume", "cp", "write"])
+                        choices=["pipeline", "resume", "cp", "write",
+                                 "mesh2d"])
     parser.add_argument("--process-id", type=int, default=0)
     parser.add_argument("--num-processes", type=int, default=2)
     parser.add_argument("--coordinator", default=None)
@@ -745,11 +880,31 @@ def _main() -> int:
     if args.worker:
         _worker_main(args)
         return 0
-    report = run_selfcheck(num_processes=args.num_processes,
-                           devices_per_process=args.devices_per_process,
-                           global_batch=args.global_batch,
-                           resume_processes=args.resume_processes,
-                           settle=args.settle, timeout=args.timeout)
+    # launcher mode: --phase picks which check to run (the 'resume' phase is
+    # part of the pipeline check, not standalone)
+    if args.phase == "pipeline":
+        report = run_selfcheck(num_processes=args.num_processes,
+                               devices_per_process=args.devices_per_process,
+                               global_batch=args.global_batch,
+                               resume_processes=args.resume_processes,
+                               settle=args.settle, timeout=args.timeout)
+    elif args.phase == "cp":
+        report = run_context_parallel_check(
+            num_processes=args.num_processes,
+            devices_per_process=args.devices_per_process,
+            timeout=args.timeout)
+    elif args.phase == "write":
+        report = run_distributed_write_check(
+            num_processes=args.num_processes, timeout=args.timeout)
+    elif args.phase == "mesh2d":
+        report = run_mesh2d_check(
+            num_processes=args.num_processes,
+            devices_per_process=args.devices_per_process,
+            timeout=args.timeout)
+    else:
+        print(f"--phase {args.phase} is not a standalone check (it runs"
+              " inside the pipeline check)")
+        return 2
     print(json.dumps(report, indent=2))
     return 0 if report["ok"] else 1
 

@@ -79,3 +79,18 @@ def test_multiprocess_distributed_write(tmp_path):
     assert report["ok"], report["failures"]
     assert report["rows_read"] == 64
     assert all(n > 0 for n in report["files_per_host"])
+
+
+def test_multiprocess_2d_mesh_dp_x_tp(tmp_path):
+    """The standard pod layout across REAL processes: 2-D mesh, data axis
+    crossing the process boundary, tensor parallelism inside each process;
+    sequence-sharded delivery plus one jitted reduction over both axes must
+    match a numpy reference and agree across hosts."""
+    from petastorm_tpu.parallel.selfcheck import run_mesh2d_check
+
+    report = run_mesh2d_check(num_processes=2, devices_per_process=2,
+                              workdir=str(tmp_path), timeout=240.0)
+    if report["timeout"]:
+        pytest.skip(f"2-D mesh check timed out: {report['failures']}")
+    assert report["ok"], report["failures"]
+    assert report["mesh"] == {"data": 2, "model": 2}
