@@ -395,9 +395,17 @@ def bench_north_star_train(tmp):
         return json.loads(out.stdout.strip().splitlines()[-1])
 
     ours, tfd = [], []
-    for _ in range(2):  # interleaved pairs: drift hits both equally
+    t0 = time.perf_counter()
+    pairs = 1
+    ours.append(run("petastorm"))
+    tfd.append(run("tfdata"))
+    # each run pays process start + jit compile (minutes on a slow day);
+    # spend a second interleaved pair only when the budget allows, so the
+    # whole bench cannot outgrow the driver's capture window
+    if time.perf_counter() - t0 < 480:
         ours.append(run("petastorm"))
         tfd.append(run("tfdata"))
+        pairs = 2
 
     def mean(ms, key):
         return sum(m[key] for m in ms) / len(ms)
@@ -407,7 +415,7 @@ def bench_north_star_train(tmp):
     oi, ti = mean(ours, "device_idle_pct"), mean(tfd, "device_idle_pct")
     return _emit("north_star_train_ratio", om / tm, "x", 0.9,
                  note=f"REAL ResNet-50 train steps ({ours[0]['steps']}/run,"
-                      " fresh-process interleaved A/B x2, cold cache):"
+                      f" fresh-process interleaved A/B x{pairs}, cold cache):"
                       f" ours {om:.0f} samples/s/chip @ {oi:.1f}% input idle"
                       f" vs tf.data {tm:.0f} @ {ti:.1f}%;"
                       " vs_baseline>=1.0 meets the >=0.9x-of-tf.data target")
