@@ -98,3 +98,27 @@ def test_spark_converter_example(tmp_path, capsys):
     assert "jax loader delivered 32 rows" in out
     assert "torch DataLoader delivered 32 rows" in out
     assert "fingerprint cache" in out
+
+
+def test_imagenet_tfdata_comparator_smoke(tmp_path):
+    """The north-star comparator path (--input tfdata): TFRecord build from
+    the stored jpegs, tf.data feed with the background device-transfer
+    thread, and the SAME train step - smoke-tested at tiny shapes so the
+    A/B harness the bench runs on the chip is covered by the suite too."""
+    pytest.importorskip("tensorflow")
+    from examples.imagenet.train_resnet_tpu import generate_dataset, train
+
+    url = str(tmp_path / "ds")
+    generate_dataset(url, rows=32, side=32)
+    m = train(url, steps=2, global_batch=8, side=32, num_classes=10,
+              workers=1, prefetch=2, input_pipeline="tfdata")
+    assert m["input"] == "tfdata"
+    assert m["steps"] == 2
+    assert m["samples_per_sec"] > 0
+    assert np.isfinite(m["final_loss"])
+
+    # scan mode over the SAME feed: K steps per dispatch
+    m2 = train(url, steps=4, global_batch=8, side=32, num_classes=10,
+               workers=1, prefetch=2, input_pipeline="tfdata", scan_steps=2)
+    assert m2["scan_steps"] == 2 and m2["steps"] == 4
+    assert np.isfinite(m2["final_loss"])
