@@ -476,6 +476,12 @@ class JaxDataLoader:
                 [col, np.zeros((pad,) + col.shape[1:], dtype=col.dtype)])
                 for name, col in cols.items()}
         if self._valid_mask is not None:
+            if self._valid_mask in cols:
+                # the schema collision is caught at construction; a
+                # transform_fn can still mint the name at runtime
+                raise PetastormTpuError(
+                    f"transform_fn produced a field named {self._valid_mask!r},"
+                    " which collides with valid_mask_field; rename one")
             mask = np.zeros(self._local_rows, np.float32)
             mask[:valid_rows] = 1.0
             cols[self._valid_mask] = mask

@@ -515,3 +515,49 @@ def test_valid_mask_field_validation(num_ds):
         JaxDataLoader(reader2, batch_size=8, mesh=mesh,
                       valid_mask_field="vec")
     reader2.stop(); reader2.join()
+
+
+def test_valid_mask_rides_device_shuffle_buffer(tmp_path):
+    """The mask column is a uniform device field, so it must ride the HBM
+    exchange-shuffle buffer like any data field, and the held-back partial
+    tail batch must still arrive LAST with its zero-mask padding."""
+    schema = Schema("M", [Field("id", np.int64)])
+    url = str(tmp_path / "ds")
+    write_dataset(url, schema, [{"id": i} for i in range(72)],
+                  row_group_size_rows=8)
+    mesh = data_parallel_mesh()
+    reader = make_reader(url, shuffle_row_groups=False)
+    with JaxDataLoader(reader, batch_size=16, mesh=mesh,
+                       shardings={"id": P("data")},
+                       device_shuffle_capacity=2, device_shuffle_seed=1,
+                       valid_mask_field="mask", drop_last=False) as loader:
+        batches = list(loader)
+    assert len(batches) == 5  # 4 full + the 8-row padded tail
+    tail = batches[-1]
+    assert tail["_valid_rows"] == 8
+    assert np.asarray(tail["mask"]).tolist() == [1.0] * 8 + [0.0] * 8
+    for b in batches[:-1]:
+        assert np.asarray(b["mask"]).tolist() == [1.0] * 16
+    ids = sorted(int(i) for b in batches
+                 for i, m in zip(np.asarray(b["id"]), np.asarray(b["mask"]))
+                 if m == 1.0)
+    assert ids == list(range(72))
+
+
+def test_valid_mask_transform_collision_raises(num_ds):
+    """A transform_fn minting a field with the mask's name must fail loudly
+    (the schema collision is caught at construction; this one can only
+    surface at runtime)."""
+    url, _ = num_ds
+    mesh = data_parallel_mesh()
+    reader = make_reader(url, schema_fields=["idx", "vec"])
+
+    def sneaky(cols):
+        cols["mask"] = np.ones_like(cols["idx"], dtype=np.float32)
+        return cols
+
+    with pytest.raises(PetastormTpuError, match="collides with"):
+        with JaxDataLoader(reader, batch_size=8, mesh=mesh,
+                           transform_fn=sneaky,
+                           valid_mask_field="mask") as loader:
+            next(iter(loader))
