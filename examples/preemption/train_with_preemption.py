@@ -96,7 +96,14 @@ def train(url: str, batch_size: int = 32, preempt_at: int = 3,
             params, opt_state, loss = step(params, opt_state, b["x"], b["y"])
             seen_a += int(b["x"].shape[0])
         # preemption: flush what is already in flight, then the cursor is
-        # EXACT (multi-host pods: drain() aligns batch counts automatically)
+        # EXACT.  This example is SINGLE-host, so drain() never emits
+        # alignment pads and skipping on '_valid_rows' below is safe.  On a
+        # multi-host POD do NOT copy this branch: '_valid_rows' is
+        # host-local and branching on it diverges collective control flow
+        # (a hang) - construct the loader with valid_mask_field="mask" and
+        # run EVERY drained step, weighting the loss by the mask
+        # (docs/operations.md "Checkpoint / resume" has the full pattern,
+        # executed for real by petastorm-tpu-selfcheck).
         for b in loader.drain():
             if b.get("_valid_rows", 1) == 0:
                 continue
