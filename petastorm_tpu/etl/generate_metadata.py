@@ -127,9 +127,12 @@ def generate_metadata(dataset_url: str,
                          require_stored_schema=False))
     geometries = None
     if rescan_geometries:
+        # keep an empty scan result as {} (not None): the rescan is
+        # authoritative, so finding nothing must stamp an empty contract
+        # rather than silently preserving the stale one
         geometries = scan_geometries(dataset_url,
                                      storage_options=storage_options,
-                                     schema=schema) or None
+                                     schema=schema)
     # schema=None -> stamp_dataset_metadata reads the schema JSON from file KV.
     # A rescan saw the WHOLE dataset, so its geometry set REPLACES the stamped
     # one (stale shapes from rewritten files must disappear, not merge).

@@ -343,7 +343,11 @@ def stamp_dataset_metadata(url: str, schema: Optional[Schema] = None,
         SCHEMA_METADATA_KEY: schema.to_json().encode(),
         ROW_GROUPS_METADATA_KEY: json.dumps({"files": counts}).encode(),
     }
-    if geometries:
+    # an EMPTY dict with merge_geometries=False is meaningful: an authoritative
+    # rescan found no image geometries, so the stamped contract must become
+    # empty (write_metadata_file's KV merge would otherwise preserve the stale
+    # key and the "REPLACE" semantics of --scan-geometries would silently fail)
+    if geometries or (geometries is not None and not merge_geometries):
         merged: Dict[str, set] = {n: {tuple(int(d) for d in s) for s in shapes}
                                   for n, shapes in geometries.items()}
         existing_raw = (_read_kv_metadata(fs, root).get(GEOMETRIES_METADATA_KEY)

@@ -185,6 +185,10 @@ class JaxDataLoader:
             raise PetastormTpuError("batch_size must be >= 1")
         self._global_batch = batch_size
         self._local_rows = self._local_layout()
+        if self._mesh is not None:
+            for name in self._fields:
+                if name in self._mixed_decode:
+                    self._validate_mixed_scatter_layout(name)
 
         #: HBM-resident exchange shuffle over whole device batches (the TPU
         #: analog of the reference's GPU-tensor BatchedDataLoader buffers,
@@ -329,6 +333,55 @@ class JaxDataLoader:
             axis = self._mesh.axis_names[0] if self._mesh is not None else "data"
             spec = PartitionSpec(axis)
         return spec
+
+    def _validate_mixed_scatter_layout(self, name: str) -> None:
+        """Construction-time contract for 'device-mixed' mesh delivery: this
+        host's addressable batch-axis shards must tile one contiguous block
+        of exactly ``_local_rows`` rows (``_scatter_local_rows`` slices one
+        host-local np.ndarray).  Depends only on (mesh, spec, global batch) -
+        dim-0 slices of a batch-axis NamedSharding are independent of the
+        trailing image dims - so a misconfigured mesh/spec fails fast here,
+        not with an opaque shape error from
+        ``make_array_from_single_device_arrays`` after the first decode."""
+        spec = self._spec_for(name)
+        batch_axis = spec[0] if len(spec) else None
+        if batch_axis is None and self._local_rows < self._global_batch:
+            # replicated batch is fine single-host (the host holds the full
+            # batch); across processes each host holds only its local rows,
+            # so a 'replicated' array would silently diverge per host
+            raise PetastormTpuError(
+                f"field {name!r}: decode_placement='device-mixed' requires the"
+                " batch axis to be sharded when the batch spans processes"
+                " (PartitionSpec leading entry is None, but this host"
+                f" materializes only {self._local_rows} of the"
+                f" {self._global_batch}-row global batch)."
+                f" mesh={self._mesh!r} spec={spec!r}")
+        batch_sharding = NamedSharding(self._mesh, PartitionSpec(batch_axis))
+        global_shape = (self._global_batch,)
+        idx_map = batch_sharding.addressable_devices_indices_map(global_shape)
+        spans = sorted(
+            ((sl[0].start or 0,
+              sl[0].stop if sl[0].stop is not None else global_shape[0])
+             for sl in idx_map.values()))
+        lo = spans[0][0]
+        covered = lo
+        for a, b in spans:
+            if a > covered:   # gap: another process' rows sit between ours
+                raise PetastormTpuError(
+                    f"field {name!r}: this host's addressable batch-axis"
+                    f" shards are not contiguous (gap at rows [{covered},"
+                    f" {a}) inside local span [{lo}, {spans[-1][1]}))."
+                    " decode_placement='device-mixed' requires a mesh whose"
+                    " device order keeps each process' batch rows contiguous;"
+                    f" mesh={self._mesh!r} spec={spec!r}")
+            covered = max(covered, b)
+        if covered - lo != self._local_rows:
+            raise PetastormTpuError(
+                f"field {name!r}: addressable batch shards cover"
+                f" {covered - lo} rows but this host owns {self._local_rows};"
+                f" mesh={self._mesh!r} spec={spec!r} is not a plain"
+                " batch-sharded layout supported by"
+                " decode_placement='device-mixed'")
 
     def _local_layout(self) -> int:
         """Rows of the global batch this process materializes."""

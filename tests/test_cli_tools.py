@@ -297,6 +297,52 @@ def test_generate_metadata_scan_geometries(tmp_path):
             g + (3,) for g in geoms)  # stale shape replaced away
 
 
+def test_scan_geometries_empty_rescan_clears_contract(tmp_path):
+    """--scan-geometries REPLACE semantics must hold even when the rescan
+    finds NOTHING: an empty authoritative scan stamps an empty contract
+    (the KV merge in write_metadata_file would otherwise silently preserve
+    the stale geometry key)."""
+    pytest.importorskip("cv2")
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.etl.generate_metadata import main as gen_main
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    schema = Schema("ScanGeoEmpty", [
+        Field("idx", np.int64),
+        Field("image", np.uint8, (None, None, 3),
+              CompressedImageCodec("jpeg", quality=90)),
+    ])
+    rng = np.random.default_rng(5)
+    url = str(tmp_path / "ds")
+    write_dataset(url, schema,
+                  [{"idx": i,
+                    "image": rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)}
+                   for i in range(4)])
+    with make_batch_reader(url, num_epochs=1) as r:
+        assert r.declared_geometries == {"image": [(16, 16, 3)]}
+
+    # an external engine rewrites the image cells to unparseable bytes: the
+    # header scan now finds no geometry at all
+    import glob
+    import os
+
+    for path in glob.glob(os.path.join(url, "*.parquet")):
+        table = pq.read_table(path)
+        junk = pa.array([b"not-an-image"] * table.num_rows, pa.binary())
+        idx = table.schema.get_field_index("image")
+        table = table.set_column(idx, table.schema.field(idx), junk)
+        pq.write_table(table, path)
+
+    assert gen_main([url, "--scan-geometries"]) == 0
+    with make_batch_reader(url, num_epochs=1) as r:
+        assert r.declared_geometries == {}  # stale (16,16,3) contract cleared
+
+
 def test_image_dims_header_parse():
     """Header-only geometry parse: png IHDR, jpeg SOF, jpeg with legal 0xFF
     fill bytes before the marker, and junk."""
