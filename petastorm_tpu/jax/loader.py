@@ -83,6 +83,18 @@ class JaxDataLoader:
     weight per-example losses by it instead of branching on the host-local
     ``'_valid_rows'`` (which differs across hosts on drained pads and would
     diverge pod control flow; see ``drain()``).
+
+    ``stack_batches=K`` (scan-feed delivery): each delivered unit is a stack
+    of K consecutive batches - device arrays of shape ``(K, batch, ...)``
+    sharded ``PartitionSpec(None, *spec)`` - shipped in ONE transfer, for
+    consumers running K train steps per dispatch via ``lax.scan``.  Both the
+    per-unit transfer count and the per-call dispatch RPC amortize K-fold.
+    Semantics shift to stack granularity: ``drop_last=True`` also drops a
+    final short stack; with ``drop_last=False`` missing steps and partial
+    rows zero-pad, ``'_valid_rows'`` becomes a per-step ``(K,)`` int array,
+    the valid mask is ``(K, batch)``, and ``drain()``/``state_dict()`` count
+    whole stacks.  Incompatible with ``device_shuffle_capacity`` and
+    multi-bucket ``pad_shapes``.
     """
 
     def __init__(self,
@@ -105,10 +117,22 @@ class JaxDataLoader:
                  trace_dir: Optional[str] = None,
                  device_shuffle_capacity: int = 0,
                  device_shuffle_seed: Optional[int] = None,
-                 valid_mask_field: Optional[str] = None):
+                 valid_mask_field: Optional[str] = None,
+                 stack_batches: int = 1):
         self._reader = reader
         self._mesh = mesh
         self._specs = shardings
+        #: K > 1 = scan-feed delivery: each delivered unit stacks K
+        #: consecutive batches as (K, batch, ...) device arrays shipped in ONE
+        #: transfer, for consumers that run K train steps per dispatch via
+        #: ``lax.scan`` (amortizes the fixed per-call dispatch RPC of
+        #: tunneled/remote TPU runtimes AND the per-transfer dispatch, which
+        #: the hand-stacked ``jnp.stack`` pattern still paid K times).
+        #: Reference analog: none - the TPU-native replacement for feeding
+        #: BatchedDataLoader one batch per step (petastorm/pytorch.py:257-367)
+        if stack_batches < 1:
+            raise PetastormTpuError("stack_batches must be >= 1")
+        self._stack = int(stack_batches)
         # each entry: one target tuple, or a LIST of bucket tuples - the
         # smallest bucket fitting the batch is chosen per batch, bounding XLA
         # recompiles to the bucket count (SURVEY.md section 7 hard part (d))
@@ -194,6 +218,21 @@ class JaxDataLoader:
         #: analog of the reference's GPU-tensor BatchedDataLoader buffers,
         #: petastorm/pytorch_shuffling_buffer.py) - composes with the host
         #: shuffling buffer below, which mixes rows before batch assembly
+        if self._stack > 1:
+            bucketed = [n for n, b in self._pad_shapes.items() if len(b) > 1]
+            if bucketed:
+                raise PetastormTpuError(
+                    f"stack_batches={self._stack} needs one static shape per"
+                    f" field, but {bucketed} use multi-bucket pad_shapes (the"
+                    " bucket choice could differ between the K stacked"
+                    " batches); give them a single pad target instead.")
+            if device_shuffle_capacity:
+                raise PetastormTpuError(
+                    "stack_batches cannot be combined with"
+                    " device_shuffle_capacity: the HBM exchange buffer holds"
+                    " single batches. Use the host shuffling buffer"
+                    " (shuffling_queue_capacity) instead.")
+
         self._device_buffer = None
         if device_shuffle_capacity:
             if self._host_fields:
@@ -451,7 +490,14 @@ class JaxDataLoader:
             self._host_push(_Error(exc))
 
     def _transfer(self) -> None:
-        """Stage 2: host batches -> device dispatch -> consumer queue."""
+        """Stage 2: host batches -> device dispatch -> consumer queue.
+
+        In stack mode (``stack_batches=K``) this stage groups K consecutive
+        host batches and ships them as ONE ``(K, batch, ...)`` unit; the
+        final short group is zero-padded to K steps (``drop_last=False``) or
+        dropped (``drop_last=True``, mirroring the row-level semantics).
+        """
+        group = []
         try:
             while not self._stop_event.is_set():
                 try:
@@ -465,9 +511,20 @@ class JaxDataLoader:
                     return
                 if isinstance(item, _Done):
                     break
-                self._emit(item)
+                if self._stack > 1:
+                    group.append(item)
+                    if len(group) == self._stack:
+                        self._emit_stack(group)
+                        group = []
+                else:
+                    self._emit(item)
             else:
                 return  # stopped
+            if group and not self._drop_last:
+                # partial final stack: zero-pad the missing steps so the
+                # consumer's (K, ...) jit signature never changes;
+                # '_valid_rows' and the valid mask mark the real rows
+                self._emit_stack(group)
             if self._device_buffer is not None:
                 for resident in self._device_buffer.drain():
                     if self._stop_event.is_set():
@@ -524,10 +581,8 @@ class JaxDataLoader:
             # the global shape (and the consumer's jit signature) never changes -
             # XLA recompiles per shape, and uneven shards break global assembly.
             # '_valid_rows' tells the consumer how many rows are real.
-            pad = self._local_rows - valid_rows
-            cols = {name: np.concatenate(
-                [col, np.zeros((pad,) + col.shape[1:], dtype=col.dtype)])
-                for name, col in cols.items()}
+            cols = {name: _pad_rows(col, self._local_rows)
+                    for name, col in cols.items()}
         if self._valid_mask is not None:
             if self._valid_mask in cols:
                 # the schema collision is caught at construction; a
@@ -579,6 +634,174 @@ class JaxDataLoader:
             return
         self._push(device_batch)
 
+    def _emit_stack(self, group) -> None:
+        """Stack-mode emit: K consecutive host batches -> ONE delivered unit
+        of ``(K, batch, ...)`` device arrays, shipped in a single transfer.
+
+        Per-step semantics match ``_emit`` exactly (transform_fn runs per
+        batch BEFORE stacking, dtype promotion once on the stacked array).
+        A short group (epoch end / drain with ``drop_last=False``) zero-pads
+        the missing steps; partial row batches zero-pad their rows - in both
+        cases ``'_valid_rows'`` becomes a per-step int array and the valid
+        mask (shape ``(K, batch)``) marks the real rows, so a ``lax.scan``
+        consumer runs all K steps with a constant signature and weights by
+        the mask (the pod-safe pattern, see ``drain()``).
+        """
+        K, local = self._stack, self._local_rows
+        real_steps = len(group)
+        prepped, valids = [], []
+        for hb in group:
+            cols = {n: hb.columns[n] for n in self._fields
+                    if n not in self._device_decode}
+            if self._transform_fn is not None:
+                cols = self._transform_fn(cols)
+                if self._valid_mask is not None and self._valid_mask in cols:
+                    raise PetastormTpuError(
+                        f"transform_fn produced a field named"
+                        f" {self._valid_mask!r}, which collides with"
+                        " valid_mask_field; rename one")
+            valid = hb.num_rows
+            if valid < local:
+                # zero-pad partial rows even without a mesh: the (K, B, ...)
+                # stack needs one static per-step shape
+                cols = {name: _pad_rows(col, local)
+                        for name, col in cols.items()}
+            prepped.append(cols)
+            valids.append(valid)
+
+        device_batch = {}
+        for name in self._device_decode:
+            if name in self._fields:
+                decode = (self._decode_mixed_stack
+                          if name in self._mixed_decode else self._decode_stack)
+                device_batch[name] = decode(name, group)
+
+        staged: Dict[str, np.ndarray] = {}
+        for name in (list(prepped[0]) if prepped else []):
+            steps = [np.ascontiguousarray(p[name]) for p in prepped]
+            steps += [np.zeros_like(steps[-1])] * (K - real_steps)
+            arr = np.stack(steps)                      # (K, local, *trailing)
+            feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
+            if arr.dtype != feed_dtype:
+                arr = arr.astype(feed_dtype)
+            self._emitted_layout[name] = (arr.shape[2:], arr.dtype)
+            if self._mesh is not None:
+                sharding, sl, global_shape = self._placement_for(
+                    name, arr.shape[2:])
+                arr = arr[(slice(None), slice(None)) + sl[2:]]
+                device_batch[name] = jax.make_array_from_process_local_data(
+                    sharding, arr, global_shape)
+            else:
+                staged[name] = arr
+        if self._valid_mask is not None:
+            mask = np.zeros((K, local), np.float32)
+            for k, v in enumerate(valids):
+                mask[k, :v] = 1.0
+            name = self._valid_mask
+            self._emitted_layout[name] = ((), np.dtype(np.float32))
+            sharding, _, global_shape = self._placement_for(name, ())
+            device_batch[name] = jax.make_array_from_process_local_data(
+                sharding, mask, global_shape)
+        if staged:
+            # ONE device_put for the whole stack: K steps of data ride a
+            # single fixed-cost dispatch instead of K (the whole point)
+            device_batch.update(jax.device_put(staged))
+        jax.block_until_ready(device_batch)
+        for name in self._host_fields:
+            steps = [_pad_host_col(hb.columns[name], local) for hb in group]
+            steps += [_host_filler(steps[-1])] * (K - real_steps)
+            device_batch[name] = np.stack(steps)
+        if real_steps < K or any(v < local for v in valids):
+            device_batch["_valid_rows"] = np.asarray(
+                valids + [0] * (K - real_steps), dtype=np.int64)
+        self._push(device_batch)
+
+    def _decode_stack(self, name: str, group) -> jax.Array:
+        """Stack-mode variant of ``_decode_on_device``: the K batches'
+        coefficient planes ship as ONE ``(K, local, ...)`` transfer and the
+        on-chip dequant+IDCT+upsample+color runs once over the whole stack
+        (``ops/jpeg.decode_coefficients`` handles leading batch dims)."""
+        from petastorm_tpu.native.image import unpack_coef_columns
+        from petastorm_tpu.ops.jpeg import decode_coefficients
+
+        K, local = self._stack, self._local_rows
+        per = [unpack_coef_columns(name, hb.columns) for hb in group]
+        layout0 = per[0][2]
+        for _, _, lay in per[1:]:
+            if ((lay.height, lay.width, lay.components)
+                    != (layout0.height, layout0.width, layout0.components)):
+                raise PetastormTpuError(
+                    f"field {name!r}: jpeg geometry changed between stacked"
+                    " batches - decode_placement='device' requires one"
+                    " geometry dataset-wide (use 'device-mixed')")
+
+        stacked_planes = []
+        for c in range(len(layout0.components)):
+            steps = [_pad_rows(planes[c], local) for planes, _, _ in per]
+            steps += [np.zeros_like(steps[-1])] * (K - len(per))
+            stacked_planes.append(np.stack(steps))   # (K, local, bh, bw, 64)
+        qt_steps = [_pad_rows(qtabs, local, fill=1) for _, qtabs, _ in per]
+        qt_steps += [np.ones_like(qt_steps[-1])] * (K - len(per))
+        jqt = np.stack(qt_steps)                     # (K, local, ncomp, 64)
+        sampling = tuple((h, v) for (h, v, _, _) in layout0.components)
+        field = self._schema[name]
+        if self._mesh is None:
+            jp, jq = jax.device_put((tuple(stacked_planes), jqt))
+            out = decode_coefficients(
+                jp, jq, image_size=(layout0.height, layout0.width),
+                sampling=sampling)
+        else:
+            spec = self._spec_for(name)
+            batch_sharding = NamedSharding(
+                self._mesh,
+                PartitionSpec(None, spec[0] if len(spec) else None))
+            jp = tuple(jax.make_array_from_process_local_data(
+                batch_sharding, p, (K, self._global_batch) + p.shape[2:])
+                for p in stacked_planes)
+            jq = jax.make_array_from_process_local_data(
+                batch_sharding, jqt, (K, self._global_batch) + jqt.shape[2:])
+            out = decode_coefficients(
+                jp, jq, image_size=(layout0.height, layout0.width),
+                sampling=sampling)
+            if any(ax is not None for ax in spec[1:]):
+                out = jax.device_put(
+                    out, NamedSharding(self._mesh, PartitionSpec(None, *spec)))
+        if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 4:
+            out = out[..., None]  # honor a declared (H, W, 1) grayscale shape
+        return out
+
+    def _decode_mixed_stack(self, name: str, group) -> jax.Array:
+        """Stack-mode variant of ``_decode_mixed_on_device``: the K batches'
+        cells decode as one flat ``K*local``-row bucket pass (host-local, as
+        ever), then reshape to ``(K, local, ...)`` and scatter along the
+        batch axis."""
+        import jax.numpy as jnp
+
+        from petastorm_tpu.native.image import (COEF_COLUMN_SEP,
+                                                MIXED_CELL_SUFFIX)
+
+        K, local = self._stack, self._local_rows
+        key = f"{name}{COEF_COLUMN_SEP}{MIXED_CELL_SUFFIX}"
+        flat = np.concatenate([hb.columns[key] for hb in group])
+        n = len(flat)   # real cells form a prefix: only the LAST batch is short
+        out = self._decode_mixed_flat(name, flat, K * local)
+        field = self._schema[name]
+        if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 3:
+            out = out[..., None]
+        if n < K * local:
+            out = jnp.concatenate(
+                [out, jnp.zeros((K * local - n,) + out.shape[1:], out.dtype)])
+        out = out.reshape((K, local) + out.shape[1:])
+        if self._mesh is not None:
+            out = self._scatter_stacked_rows(name, out)
+        return out
+
+    def _scatter_stacked_rows(self, name: str, out) -> jax.Array:
+        """(K, local, ...) host-local decoded rows -> one global mesh array
+        of shape (K, global, ...); the stack axis is unsharded, the batch
+        axis scatters exactly like ``_scatter_local_rows``."""
+        return self._scatter_batch_axis(name, out, lead=1)
+
     def _decode_mixed_on_device(self, name: str, columns: Dict[str, np.ndarray]
                                 ) -> jax.Array:
         """Finish the hybrid decode of a MIXED-geometry field
@@ -592,22 +815,35 @@ class JaxDataLoader:
         the padding rows are cheap: the on-chip half is ~0.4 ms per 64
         images (RESULTS.md on-chip ops table).
         """
-        import jax.numpy as jnp
-
         from petastorm_tpu.native.image import (COEF_COLUMN_SEP,
-                                                MIXED_CELL_SUFFIX,
-                                                _layout_from_meta)
-        from petastorm_tpu.ops.jpeg import decode_coefficients
+                                                MIXED_CELL_SUFFIX)
 
         field = self._schema[name]
-        target = self._mixed_target(name)
         col = columns[f"{name}{COEF_COLUMN_SEP}{MIXED_CELL_SUFFIX}"]
+        n = len(col)
+        out = self._decode_mixed_flat(name, col, max(self._local_rows, n))
+        if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 3:
+            out = out[..., None]
+        if self._mesh is not None:
+            out = self._scatter_local_rows(name, out, n)
+        return out
+
+    def _decode_mixed_flat(self, name: str, col, batch_pad: int) -> jax.Array:
+        """Bucket-decode one flat column of mixed-geometry cells; every
+        bucket is padded to ``batch_pad`` rows (the static compile size).
+        Returns ``(len(col), *target)`` rows in column order, on the default
+        device (the decode is host-local; mesh placement happens after)."""
+        import jax.numpy as jnp
+
+        from petastorm_tpu.native.image import _layout_from_meta
+        from petastorm_tpu.ops.jpeg import decode_coefficients
+
+        target = self._mixed_target(name)
         n = len(col)
         groups: Dict[bytes, list] = {}
         for i, cell in enumerate(col):
             groups.setdefault(cell[2].tobytes(), []).append(i)
         self._mixed_geometries.setdefault(name, set()).update(groups)
-        batch_pad = max(self._local_rows, n)
         # every bucket stays at the STATIC batch_pad length end to end - no op
         # in this method ever sees a data-dependent group size, so compiles
         # are bounded by the distinct geometries (decode/fit) plus the
@@ -667,12 +903,7 @@ class JaxDataLoader:
                    else parts[0])
         # one static-shape gather scatters rows back into batch order and
         # drops the pad rows in the same pass
-        out = stacked[jnp.asarray(flat_idx)]
-        if len(field.shape) == 3 and field.shape[2] == 1 and out.ndim == 3:
-            out = out[..., None]
-        if self._mesh is not None:
-            out = self._scatter_local_rows(name, out, n)
-        return out
+        return stacked[jnp.asarray(flat_idx)]
 
     def _check_declared_geometry(self, name: str, layout) -> None:
         """Warn (once per geometry) when a batch reveals an image geometry
@@ -717,20 +948,33 @@ class JaxDataLoader:
             out = jnp.concatenate(
                 [out, jnp.zeros((self._local_rows - n,) + out.shape[1:],
                                 out.dtype)])
+        return self._scatter_batch_axis(name, out, lead=0)
+
+    def _scatter_batch_axis(self, name: str, out, lead: int) -> jax.Array:
+        """Shared scatter: a host-local array whose batch axis sits at
+        position ``lead`` (0 = plain batch, 1 = stacked ``(K, local, ...)``)
+        becomes one global mesh array; any leading axes stay unsharded.
+        The construction-time contract (``_validate_mixed_scatter_layout``)
+        guarantees the addressable shards tile one contiguous block."""
         spec = self._spec_for(name)
-        batch_sharding = NamedSharding(
-            self._mesh, PartitionSpec(spec[0] if len(spec) else None))
-        global_shape = (self._global_batch,) + tuple(out.shape[1:])
-        idx_map = batch_sharding.addressable_devices_indices_map(global_shape)
-        starts = [(sl[0].start or 0) for sl in idx_map.values()]
+        sharding = NamedSharding(
+            self._mesh,
+            PartitionSpec(*((None,) * lead),
+                          spec[0] if len(spec) else None))
+        global_shape = (tuple(out.shape[:lead]) + (self._global_batch,)
+                        + tuple(out.shape[lead + 1:]))
+        idx_map = sharding.addressable_devices_indices_map(global_shape)
+        starts = [(sl[lead].start or 0) for sl in idx_map.values()]
         lo = min(starts)
+        prefix = (slice(None),) * lead
         shards = []
         for dev, sl in idx_map.items():
-            a = (sl[0].start or 0) - lo
-            b = (sl[0].stop if sl[0].stop is not None else global_shape[0]) - lo
-            shards.append(jax.device_put(out[a:b], dev))
+            a = (sl[lead].start or 0) - lo
+            b = (sl[lead].stop if sl[lead].stop is not None
+                 else global_shape[lead]) - lo
+            shards.append(jax.device_put(out[prefix + (slice(a, b),)], dev))
         return jax.make_array_from_single_device_arrays(
-            global_shape, batch_sharding, shards)
+            global_shape, sharding, shards)
 
     def _decode_on_device(self, name: str, columns: Dict[str, np.ndarray]
                           ) -> jax.Array:
@@ -762,11 +1006,8 @@ class JaxDataLoader:
             if n < self._local_rows:
                 # zero coefficient blocks decode to flat gray padding rows
                 # ('_valid_rows' marks how many are real, as for host fields)
-                pad = self._local_rows - n
-                planes = [np.concatenate(
-                    [p, np.zeros((pad,) + p.shape[1:], p.dtype)]) for p in planes]
-                qtabs = np.concatenate(
-                    [qtabs, np.ones((pad,) + qtabs.shape[1:], qtabs.dtype)])
+                planes = [_pad_rows(p, self._local_rows) for p in planes]
+                qtabs = _pad_rows(qtabs, self._local_rows, fill=1)
             spec = self._spec_for(name)
             batch_sharding = NamedSharding(
                 self._mesh, PartitionSpec(spec[0] if len(spec) else None))
@@ -785,13 +1026,28 @@ class JaxDataLoader:
             out = out[..., None]  # honor a declared (H, W, 1) grayscale shape
         return out
 
+    def _delivery_spec(self, name: str) -> PartitionSpec:
+        """The PartitionSpec a delivered array for ``name`` actually uses:
+        the user's spec, with an unsharded leading stack axis prepended in
+        stack mode (the K stacked batches ride the same devices their rows
+        would ride individually)."""
+        spec = self._spec_for(name)
+        if self._stack > 1:
+            return PartitionSpec(None, *spec)
+        return spec
+
+    def _delivery_global(self, trailing: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Global shape of a delivered array with per-row ``trailing`` dims."""
+        lead = (self._stack,) if self._stack > 1 else ()
+        return lead + (self._global_batch,) + trailing
+
     def _placement_for(self, name: str, trailing: Tuple[int, ...]
                        ) -> Tuple[NamedSharding, Tuple[slice, ...], Tuple[int, ...]]:
         key = (name, trailing)
         hit = self._placement_cache.get(key)
-        global_shape = (self._global_batch,) + trailing
+        global_shape = self._delivery_global(trailing)
         if hit is None:
-            sharding = NamedSharding(self._mesh, self._spec_for(name))
+            sharding = NamedSharding(self._mesh, self._delivery_spec(name))
             sl = local_data_slice(sharding, global_shape)
             hit = (sharding, sl)
             self._placement_cache[key] = hit
@@ -821,6 +1077,8 @@ class JaxDataLoader:
                "delivered_batches": self._delivered_batches,
                "consumer_wait_s": self._consumer_wait_s,
                "finished": self._finished}
+        if self._stack > 1:
+            out["stack_batches"] = self._stack
         if self._mixed_geometries:
             # distinct jpeg geometries decoded per 'device-mixed' field: the
             # on-chip decode compiles once per entry (bounded-compile contract)
@@ -934,9 +1192,13 @@ class JaxDataLoader:
         ``tests/test_multiprocess_distributed.py``.
 
         With ``drop_last=True`` a final partial batch's rows are dropped
-        exactly as they would be at an epoch end; training that checkpoints
-        mid-epoch should use ``drop_last=False`` (mesh consumers get the
-        zero-padded ``'_valid_rows'`` tail batch).
+        exactly as they would be at an epoch end - and in stack mode
+        (``stack_batches=K``) the accumulating short stack is dropped too,
+        discarding up to K-1 FULL batches whose rows the reader cursor has
+        already passed.  Training that checkpoints mid-epoch should use
+        ``drop_last=False`` (mesh consumers get zero-padded ``'_valid_rows'``
+        tails; stack consumers get a zero-padded final stack with per-step
+        counts).
         """
         if not hasattr(self._reader, "quiesce"):
             raise PetastormTpuError(
@@ -1015,7 +1277,10 @@ class JaxDataLoader:
                             pad[name] = _zero_array(shape, sharding, dtype)
                         else:
                             pad[name] = np.zeros(shape, dtype)  # host field
-                pad["_valid_rows"] = 0
+                # stack mode: per-step counts, all zero (shape matches the
+                # real units' '_valid_rows' array so consumer code is uniform)
+                pad["_valid_rows"] = (np.zeros(self._stack, np.int64)
+                                      if self._stack > 1 else 0)
                 yield pad
         return _aligned()
 
@@ -1048,7 +1313,7 @@ class JaxDataLoader:
                 sharding, _ = self._placement_cache[(name, trailing)]
             elif name == self._valid_mask:
                 trailing = ()
-                sharding = NamedSharding(self._mesh, self._spec_for(name))
+                sharding = NamedSharding(self._mesh, self._delivery_spec(name))
                 dtype = np.float32
             elif name in self._device_decode:
                 # mixed fields may declare a variable shape; their static
@@ -1056,7 +1321,7 @@ class JaxDataLoader:
                 trailing = (self._mixed_target(name)
                             if name in self._mixed_decode
                             else tuple(field.shape))
-                sharding = NamedSharding(self._mesh, self._spec_for(name))
+                sharding = NamedSharding(self._mesh, self._delivery_spec(name))
                 dtype = np.uint8
             else:
                 if self._transform_fn is not None:
@@ -1076,14 +1341,15 @@ class JaxDataLoader:
                         " pod's global shapes - checkpoint at a step boundary"
                         " instead")
                 trailing = tuple(buckets[0]) if buckets else tuple(field.shape)
-                sharding = NamedSharding(self._mesh, self._spec_for(name))
+                sharding = NamedSharding(self._mesh, self._delivery_spec(name))
                 dtype = jax_feed_dtype(field.dtype, keep_wide=self._keep_wide)
-            layout[name] = ((self._global_batch,) + trailing, sharding, dtype)
+            layout[name] = (self._delivery_global(trailing), sharding, dtype)
+        lead = (self._stack,) if self._stack > 1 else ()
         for name in self._host_fields:
             field = self._schema[name]
             shape = tuple(d if d is not None else 0 for d in field.shape)
             host_dtype = field.dtype if field.dtype.kind not in "USOMm" else object
-            layout[name] = ((self._local_rows,) + shape, None, host_dtype)
+            layout[name] = (lead + (self._local_rows,) + shape, None, host_dtype)
         return layout
 
     def state_dict(self) -> Dict:
@@ -1091,7 +1357,10 @@ class JaxDataLoader:
 
         ``reader`` is the underlying work-item cursor (pass back via
         ``make_reader(..., resume_from=...)`` / ``resume_reader_kwargs``);
-        ``delivered_batches`` counts device batches handed to the consumer.
+        ``delivered_batches`` counts device UNITS handed to the consumer -
+        single batches, or whole ``(K, batch, ...)`` stacks in stack mode
+        (``stack_batches=K``), so cursor granularity follows delivery
+        granularity.
         Mid-epoch the reader cursor can run ahead of deliveries by the
         in-flight window - both producer-stage queues (2x ``prefetch``) plus
         ALL ``device_shuffle_capacity`` resident batches - so keep buffers
@@ -1104,7 +1373,8 @@ class JaxDataLoader:
                 " state_dict(); checkpoint/resume needs a petastorm_tpu Reader")
         return {"reader": self._reader.state_dict(),
                 "delivered_batches": self._delivered_batches,
-                "global_batch": self._global_batch}
+                "global_batch": self._global_batch,
+                "stack_batches": self._stack}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -1182,6 +1452,41 @@ def make_jax_loader(dataset_url: str,
         reader.stop()
         reader.join()
         raise
+
+
+def _pad_rows(col: np.ndarray, rows: int, fill=0) -> np.ndarray:
+    """Pad a column's leading axis to ``rows`` with ``fill`` (the one shared
+    tail-pad policy: zeros for data/coefficient planes, ones for quant
+    tables)."""
+    if len(col) >= rows:
+        return col
+    shape = (rows - len(col),) + col.shape[1:]
+    filler = np.zeros(shape, col.dtype) if fill == 0 else np.full(
+        shape, fill, col.dtype)
+    return np.concatenate([col, filler])
+
+
+def _host_filler(tmpl: np.ndarray) -> np.ndarray:
+    """A zero-information array shaped like ``tmpl`` for missing host-side
+    steps/rows (object cells fill with None, numeric with zeros)."""
+    if tmpl.dtype == object:
+        return np.full(tmpl.shape, None, dtype=object)
+    return np.zeros_like(tmpl)
+
+
+def _pad_host_col(col: np.ndarray, rows: int) -> np.ndarray:
+    """Pad a host-side column to ``rows`` entries for stack assembly (object
+    cells pad with None, numeric with zeros - same policy as the step filler
+    ``_host_filler``)."""
+    col = np.asarray(col)
+    if len(col) >= rows:
+        return col
+    if col.dtype == object:
+        filler = np.full((rows - len(col),) + col.shape[1:], None,
+                         dtype=object)
+    else:
+        filler = np.zeros((rows - len(col),) + col.shape[1:], col.dtype)
+    return np.concatenate([col, filler])
 
 
 def _normalize_buckets(name: str, spec) -> list:

@@ -111,7 +111,9 @@ def decode_coefficients(planes: Sequence[jax.Array],
     Args:
       planes: per component, int16 (N, blocks_h, blocks_w, 64) in natural
         order - the arrays from ``native.image.read_jpeg_coefficients_column``.
-      qtabs: uint16 (N, ncomp, 64) quant tables (natural order).
+        Extra leading batch dims are fine (e.g. (K, N, bh, bw, 64) stacks).
+      qtabs: uint16 (N, ncomp, 64) quant tables (natural order), with the
+        same leading batch dims as ``planes``.
       image_size: (height, width) of the full image.
       sampling: per component (h_samp, v_samp) JPEG sampling factors.
       out_dtype: uint8 (default) for pixels, or a float dtype to skip the
@@ -127,7 +129,9 @@ def decode_coefficients(planes: Sequence[jax.Array],
     max_v = max(s[1] for s in sampling)
     comps = []
     for c, coefs in enumerate(planes):
-        spatial = _idct_blocks(coefs, qtabs[:, c, :])
+        # ellipsis indexing: any leading batch dims work, e.g. the loader's
+        # stacked (K, N, ...) scan-feed planes decode in one call
+        spatial = _idct_blocks(coefs, qtabs[..., c, :])
         h_samp, v_samp = sampling[c]
         ch = -(-height * v_samp // max_v)  # ceil
         cw = -(-width * h_samp // max_h)
