@@ -464,6 +464,25 @@ def bench_train_stall(tmp):
             env=env, timeout=900, check=True)
         return json.loads(out.stdout.strip().splitlines()[-1])
 
+    # peak dense FLOP/s per chip by device kind (bf16 systolic peak - XLA's
+    # default f32 matmul precision on TPU rides the bf16 MXU path)
+    peak_flops = {"TPU v5 lite": 197e12, "TPU v5e": 197e12,
+                  "TPU v4": 275e12, "TPU v3": 123e12, "TPU v2": 45e12}
+
+    def mfu_pct(m, flops_from=None):
+        """Model-FLOPs utilization: XLA's own cost-analysis FLOPs for the
+        compiled train dispatch (fwd+bwd+optimizer), per sample, times the
+        measured samples/s/chip, over the chip's peak.  ``flops_from``
+        supplies the per-sample FLOPs for scan-mode runs (XLA counts a
+        lax.scan body once, so the scan executable's figure is unusable;
+        the scan=1 run of the same model/shapes is the right source)."""
+        src = flops_from or m
+        f, kind = src.get("flops_per_sample"), m.get("device_kind", "")
+        peak = peak_flops.get(kind)
+        if not f or not peak:
+            return None
+        return 100.0 * m["samples_per_sec_per_chip"] * f / peak
+
     cold = run("null")
     # warm host LRU: epochs after the first skip parquet+entropy-decode -
     # the steady state for any dataset that fits host RAM
@@ -480,6 +499,16 @@ def bench_train_stall(tmp):
           note=f"{warm['steps']} real train steps, global_batch="
                f"{warm['global_batch']}, decode={warm['decode']},"
                " warm memory LRU; vs round-1 recorded 1230")
+    warm_mfu = mfu_pct(warm)
+    if warm_mfu is not None:
+        _emit("imagenet_train_mfu_pct", warm_mfu, "%", 100.0,
+              note=f"scan=1 warm: {warm['samples_per_sec_per_chip']:.0f}"
+                   f" samples/s/chip x {warm['flops_per_sample']:.3g}"
+                   " FLOP/sample (XLA cost_analysis of the compiled"
+                   " fwd+bwd+optimizer dispatch) over"
+                   f" {peak_flops.get(warm.get('device_kind', ''), 0):.3g}"
+                   f" peak FLOP/s ({warm.get('device_kind')}); vs_baseline"
+                   " = fraction of chip peak (host-independent)")
     line = _emit("imagenet_train_samples_per_sec_per_chip",
                  cold["samples_per_sec_per_chip"], "samples/sec/chip",
                  1230.0,  # round-1 RESULTS.md recorded 1230-1340 on this chip
@@ -494,9 +523,26 @@ def bench_train_stall(tmp):
     _emit("imagenet_train_warm_scan8_samples_per_sec_per_chip",
           scan8["samples_per_sec_per_chip"], "samples/sec/chip", 1230.0,
           note=f"{scan8['steps']} real train steps, 8 steps/dispatch via"
-               " lax.scan, warm memory LRU; device_idle_pct is not"
-               " comparable in scan mode (consumer wait overlaps in-flight"
-               " device work); vs round-1 recorded 1230")
+               " lax.scan fed by JaxDataLoader(stack_batches=8) - one"
+               " (8, B, ...) transfer per dispatch; warm memory LRU;"
+               " vs round-1 recorded 1230")
+    scan8_mfu = mfu_pct(scan8, flops_from=warm)
+    if scan8_mfu is not None:
+        _emit("imagenet_train_warm_scan8_mfu_pct", scan8_mfu, "%", 100.0,
+              note=f"scan=8 warm: {scan8['samples_per_sec_per_chip']:.0f}"
+                   f" samples/s/chip x {warm['flops_per_sample']:.3g}"
+                   " FLOP/sample (XLA cost_analysis of the scan=1 compiled"
+                   " step - the scan body is identical math) over chip peak;"
+                   " vs_baseline = fraction of chip peak")
+    if "input_stall_pct" in scan8:
+        _emit("imagenet_train_scan8_input_stall_pct",
+              scan8["input_stall_pct"], "%", 100.0,
+              note="scan-valid stall: measured wall minus a same-session"
+                   " compute floor (identical dispatch count on ONE resident"
+                   " stacked unit, no input pipeline in the loop), as % of"
+                   " wall - valid where consumer_wait is not (scan overlaps"
+                   f" it with device work). scan=1 warm comparison:"
+                   f" {warm.get('input_stall_pct', float('nan')):.1f}%")
     return line
 
 

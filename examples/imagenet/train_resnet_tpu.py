@@ -278,32 +278,68 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
             return {"image": jnp.stack([b["image"] for b in bs]),
                     "label": jnp.stack([b["label"] for b in bs])}
 
-        def run_unit(p, o, unit, key):
-            fn = train_step if scan_steps <= 1 else train_scan
-            return fn(p, o, unit["image"], unit["label"], key)
+        # AOT-compile the step once: the SAME executable runs the loop AND
+        # reports XLA's FLOP estimate for the whole dispatch - the MFU
+        # numerator comes from the compiler, not a hand-derived constant
+        unit0 = pull_unit()
+        fn = train_step if scan_steps <= 1 else train_scan
+        exe = fn.lower(params, opt_state, unit0["image"], unit0["label"],
+                       aug_key).compile()
+        try:
+            flops_per_dispatch = float(exe.cost_analysis()["flops"])
+        except (KeyError, TypeError, IndexError):
+            flops_per_dispatch = None  # backend without a cost model
 
-        # warmup: compile, fill queues
-        params, opt_state, loss = run_unit(params, opt_state, pull_unit(),
-                                           aug_key)
+        def run_unit(p, o, unit, key):
+            return exe(p, o, unit["image"], unit["label"], key)
+
+        # warmup: fill queues, settle dispatch
+        params, opt_state, loss = run_unit(params, opt_state, unit0, aug_key)
         jax.block_until_ready(loss)
         # consumer wait accumulates while the consumer blocks on the prefetch
         # queue: the delta over the measured window IS the device-idle time
         # attributable to input starvation during REAL train steps
         wait0 = consumer_wait(feed)
+        n_disp = 0
         t0 = time.perf_counter()
         while step < steps:
             params, opt_state, loss = run_unit(params, opt_state, pull_unit(),
                                                jax.random.fold_in(aug_key, step))
             step += max(scan_steps, 1)
+            n_disp += 1
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
         input_wait_s = consumer_wait(feed) - wait0
+        # compute floor: the SAME number of dispatches on one RESIDENT unit -
+        # no input pipeline inside the loop, so (dt - compute_dt) is the
+        # input-attributable stall.  Unlike consumer_wait, this is valid in
+        # scan mode too (consumer wait there overlaps in-flight device work).
+        unit_f = pull_unit()
+        p2, o2 = params, opt_state
+        t1 = time.perf_counter()
+        for i in range(n_disp):
+            p2, o2, loss2 = run_unit(p2, o2, unit_f,
+                                     jax.random.fold_in(aug_key, 1 << 20 | i))
+        jax.block_until_ready(loss2)
+        compute_dt = time.perf_counter() - t1
         diag = feed.diagnostics if hasattr(feed, "diagnostics") else {}
     samples = step * global_batch
+    # per-sample FLOPs only from the SINGLE-step lowering: XLA's cost model
+    # counts a lax.scan body ONCE (verified: scan=8 reports exactly 1/8th),
+    # so the scan executable's figure is not per-sample-meaningful - callers
+    # wanting scan-mode MFU should take flops_per_sample from a scan=1 run
+    # of the same shapes (bench.py does exactly that)
+    flops_per_sample = (flops_per_dispatch / global_batch
+                        if flops_per_dispatch and scan_steps <= 1 else None)
     return {
+        "flops_per_dispatch": flops_per_dispatch,
         "samples_per_sec": samples / dt,
         "samples_per_sec_per_chip": samples / dt / len(devices),
         "device_idle_pct": 100.0 * input_wait_s / dt,
+        "input_stall_pct": 100.0 * max(0.0, dt - compute_dt) / dt,
+        "compute_floor_wall_s": compute_dt,
+        "flops_per_sample": flops_per_sample,
+        "device_kind": devices[0].device_kind,
         "steps": step,
         "scan_steps": scan_steps,
         "global_batch": global_batch,
