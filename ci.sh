@@ -19,7 +19,20 @@ for name in ("image_decode", "shm_arena"):
 PY
 
 echo "== test suite (8-device virtual CPU mesh; see tests/conftest.py) =="
-python -m pytest tests/ -q "$@"
+# COV=1 ./ci.sh adds line coverage; the figure is recorded in RESULTS.md.
+# Uses pytest-cov when installed, else the stdlib sys.monitoring collector
+# (tools/run_coverage.py - coverage.py is uninstallable in the zero-egress
+# build env). Runs inside docker/Dockerfile, which pins this toolchain
+# (docker/environment.lock.md).
+if [ "${COV:-0}" = "1" ]; then
+    if python -c "import pytest_cov" 2>/dev/null; then
+        python -m pytest tests/ -q --cov=petastorm_tpu --cov-report=term "$@"
+    else
+        python tools/run_coverage.py "$@"
+    fi
+else
+    python -m pytest tests/ -q "$@"
+fi
 
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
