@@ -806,12 +806,12 @@ class JaxDataLoader:
         (decode_placement='device-mixed').
 
         The batch's object cells are re-grouped by jpeg geometry; each
-        geometry bucket's planes are padded to the full batch size (so XLA
-        compiles the on-chip decode exactly once per geometry, never per
-        data-dependent group size), decoded, fitted (pad/crop) to the static
-        target, then scattered back into batch order.  The wasted FLOPs on
-        the padding rows are cheap: the on-chip half is ~0.4 ms per 64
-        images (RESULTS.md on-chip ops table).
+        geometry bucket's planes are padded to a power-of-two size (never a
+        data-dependent one - compiles stay bounded by geometries x
+        log2(batch), see ``_decode_mixed_flat``), decoded, fitted
+        (pad/crop) to the static target, then scattered back into batch
+        order.  The wasted FLOPs on the padding rows are cheap: the on-chip
+        half is ~0.4 ms per 64 images (RESULTS.md on-chip ops table).
         """
         from petastorm_tpu.native.image import (COEF_COLUMN_SEP,
                                                 MIXED_CELL_SUFFIX)
@@ -827,10 +827,17 @@ class JaxDataLoader:
         return out
 
     def _decode_mixed_flat(self, name: str, col, batch_pad: int) -> jax.Array:
-        """Bucket-decode one flat column of mixed-geometry cells; every
-        bucket is padded to ``batch_pad`` rows (the static compile size).
-        Returns ``(len(col), *target)`` rows in column order, on the default
-        device (the decode is host-local; mesh placement happens after)."""
+        """Bucket-decode one flat column of mixed-geometry cells.  Each
+        bucket pads its group to the next power of two (min 8, capped at
+        ``batch_pad``) - NOT to the full batch: padding every bucket to
+        ``batch_pad`` made a G-geometry batch decode and transfer G x the
+        data, which measurably handed the hybrid-decode win back to the
+        host path (bench ``imagenet_ingest_mixed_samples_per_sec``).
+        Power-of-two sizes keep every op static-shaped with compiles
+        bounded by geometries x log2(batch) (decode/fit) plus the distinct
+        per-batch size compositions (concat/gather).  Returns
+        ``(len(col), *target)`` rows in column order, on the default device
+        (the decode is host-local; mesh placement happens after)."""
         import jax.numpy as jnp
 
         from petastorm_tpu.native.image import _layout_from_meta
@@ -842,28 +849,26 @@ class JaxDataLoader:
         for i, cell in enumerate(col):
             groups.setdefault(cell[2].tobytes(), []).append(i)
         self._mixed_geometries.setdefault(name, set()).update(groups)
-        # every bucket stays at the STATIC batch_pad length end to end - no op
-        # in this method ever sees a data-dependent group size, so compiles
-        # are bounded by the distinct geometries (decode/fit) plus the
-        # distinct per-batch geometry-subset sizes (concat/gather)
         parts = []
         flat_idx = np.empty(n, dtype=np.int64)
-        for g, (key, idxs) in enumerate(groups.items()):
+        offset = 0
+        for key, idxs in groups.items():
             layout = _layout_from_meta(np.frombuffer(key, dtype=np.int32))
             self._check_declared_geometry(name, layout)
             k = len(idxs)
+            pad_k = min(max(8, 1 << (k - 1).bit_length()), batch_pad)
             planes = []
             for c in range(len(layout.components)):
                 stack = np.stack([col[i][0][c] for i in idxs])
-                if k < batch_pad:
+                if k < pad_k:
                     stack = np.concatenate(
-                        [stack, np.zeros((batch_pad - k,) + stack.shape[1:],
+                        [stack, np.zeros((pad_k - k,) + stack.shape[1:],
                                          stack.dtype)])
                 planes.append(stack)
             qtabs = np.stack([col[i][1] for i in idxs])
-            if k < batch_pad:
+            if k < pad_k:
                 qtabs = np.concatenate(
-                    [qtabs, np.ones((batch_pad - k,) + qtabs.shape[1:],
+                    [qtabs, np.ones((pad_k - k,) + qtabs.shape[1:],
                                     qtabs.dtype)])
             sampling = tuple((h, v) for (h, v, _, _) in layout.components)
             jp, jq = jax.device_put((tuple(planes), qtabs))
@@ -895,8 +900,9 @@ class JaxDataLoader:
                    (0, target[1] - img.shape[2])]
             if img.ndim == 4:
                 pad.append((0, 0))
-            parts.append(jnp.pad(img, pad))        # (batch_pad, *target)
-            flat_idx[np.asarray(idxs)] = g * batch_pad + np.arange(k)
+            parts.append(jnp.pad(img, pad))        # (pad_k, *target)
+            flat_idx[np.asarray(idxs)] = offset + np.arange(k)
+            offset += pad_k
         stacked = (jnp.concatenate(parts, axis=0) if len(parts) > 1
                    else parts[0])
         # one static-shape gather scatters rows back into batch order and
@@ -1079,7 +1085,8 @@ class JaxDataLoader:
             out["stack_batches"] = self._stack
         if self._mixed_geometries:
             # distinct jpeg geometries decoded per 'device-mixed' field: the
-            # on-chip decode compiles once per entry (bounded-compile contract)
+            # on-chip decode compiles once per entry PER power-of-two group
+            # size (bounded: geometries x log2(batch), _decode_mixed_flat)
             out["mixed_decode_geometries"] = {
                 name: len(keys) for name, keys in self._mixed_geometries.items()}
             if self._declared_geometries:

@@ -113,3 +113,20 @@ def create_test_dataset(url: str,
     write_dataset(url, schema, rows, row_group_size_rows=row_group_size_rows,
                   **write_kwargs)
     return rows
+
+
+def write_wide_dataset(url: str, n_cols: int = 8, n_rowgroups: int = 8,
+                       rows_per_rg: int = 32, vec_len: int = 16,
+                       seed: int = 0) -> None:
+    """A many-column 'wide' parquet dataset: an ``id`` int64 column plus
+    ``n_cols - 1`` float32 vector columns - the shape where per-column-chunk
+    remote reads would hurt most.  Shared by the remote-latency tests and
+    ``bench.py``'s latent-vs-local config so both measure the same dataset."""
+    schema = Schema("Wide", [Field("id", np.int64)] + [
+        Field(f"c{i}", np.float32, (vec_len,)) for i in range(n_cols - 1)])
+    rng = np.random.default_rng(seed)
+    rows = [dict({"id": i},
+                 **{f"c{c}": rng.standard_normal(vec_len).astype(np.float32)
+                    for c in range(n_cols - 1)})
+            for i in range(n_rowgroups * rows_per_rg)]
+    write_dataset(url, schema, rows, row_group_size_rows=rows_per_rg)

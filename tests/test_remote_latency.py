@@ -24,10 +24,9 @@ import numpy as np
 import pytest
 
 from petastorm_tpu.errors import PetastormTpuError
-from petastorm_tpu.etl.writer import write_dataset
 from petastorm_tpu.reader import make_batch_reader, make_reader
-from petastorm_tpu.schema import Field, Schema
 from petastorm_tpu.test_util.latency_fs import latent_filesystem
+from petastorm_tpu.test_util.synthetic import write_wide_dataset
 
 N_COLS = 8
 N_ROWGROUPS = 8
@@ -36,16 +35,11 @@ ROWS_PER_RG = 32
 
 @pytest.fixture(scope="module")
 def wide_ds(tmp_path_factory):
-    """Many-column dataset: the shape where per-column reads would hurt."""
+    """Many-column dataset: the shape where per-column reads would hurt
+    (shared builder with bench.py's latent-vs-local config)."""
     url = str(tmp_path_factory.mktemp("latent") / "wide")
-    schema = Schema("Wide", [Field("id", np.int64)] + [
-        Field(f"c{i}", np.float32, (16,)) for i in range(N_COLS - 1)])
-    rng = np.random.default_rng(0)
-    rows = [dict({"id": i},
-                 **{f"c{c}": rng.standard_normal(16).astype(np.float32)
-                    for c in range(N_COLS - 1)})
-            for i in range(N_ROWGROUPS * ROWS_PER_RG)]
-    write_dataset(url, schema, rows, row_group_size_rows=ROWS_PER_RG)
+    write_wide_dataset(url, n_cols=N_COLS, n_rowgroups=N_ROWGROUPS,
+                       rows_per_rg=ROWS_PER_RG)
     return url
 
 
