@@ -47,11 +47,38 @@ BASELINE_SAMPLES_PER_SEC = 709.84  # reference hello_world (BASELINE.md)
 #: round-2 recorded values (RESULTS.md) - regression reference for configs the
 #: reference publishes no number for.  This box's absolute rates drift +-30%
 #: between sessions (RESULTS.md environment caveat); treat vs_baseline here as
-#: a round-over-round regression tripwire, not a precision comparison.
+#: a round-over-round regression tripwire, not a precision comparison.  Each
+#: drifting config's NOTE also carries a same-session anchor (raw-pyarrow
+#: ceiling fraction / host-decode ratio / shared-core-model agreement) that
+#: IS drift-immune - compare those across rounds for the real signal.
 R2 = {"mnist_rows_per_sec": 430_000.0,
       "imagenet_ingest_samples_per_sec": 2900.0,
       "converter_rows_per_sec": 305_000.0,
       "ngram_windows_per_sec": 164_000.0}
+
+def _raw_ceiling_rows_per_sec(url, repeats: int = 3) -> float:
+    """Same-session anchor (VERDICT r4 item 6): raw pyarrow table reads of
+    the SAME dataset - the host+pyarrow ceiling with zero framework code.
+    Each drifting CPU metric's note reports its rate as a fraction of this
+    ceiling, a figure immune to the +-30% host weather (a normalized rate
+    that moves round-over-round is code, not drift).  NOT used to rescale
+    vs_baseline: no single calibration workload drifts identically to every
+    config (verified: mnist ran 1.37x its round-2 rate in the round-4
+    session while ingest ran 0.81x), so a shared multiplier would just swap
+    one distortion for another."""
+    import pyarrow.dataset as pads
+
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        n = pads.dataset(url, format="parquet").to_table().num_rows
+    return repeats * n / (time.perf_counter() - t0)
+
+
+def _ceiling_note(rate: float, url) -> str:
+    ceiling = _raw_ceiling_rows_per_sec(url)
+    return (f"; same-session raw-pyarrow ceiling {ceiling:.0f} rows/s on the"
+            f" SAME data - this config at {100 * rate / ceiling:.1f}% of it"
+            " (the drift-immune anchor to compare across rounds)")
 
 
 def _median(rates):
@@ -110,7 +137,8 @@ def bench_mnist(tmp):
             next(it)
         rate = n / (time.perf_counter() - t0)
     return _emit("mnist_rows_per_sec", rate, "rows/sec",
-                 R2["mnist_rows_per_sec"], note="vs round-2 recorded value")
+                 R2["mnist_rows_per_sec"],
+                 note="vs round-2 recorded value" + _ceiling_note(rate, url))
 
 
 # -- remote IO under injected latency (VERDICT r4 item 4) ---------------------
@@ -268,7 +296,8 @@ def bench_imagenet(tmp):
     return _emit("imagenet_ingest_samples_per_sec", rate, "samples/sec",
                  R2["imagenet_ingest_samples_per_sec"],
                  note=f"decode={'hybrid-device' if placement else 'host'};"
-                      " median-of-3 vs round-2 recorded max-of-3")
+                      " median-of-3 vs round-2 recorded max-of-3"
+                      + _ceiling_note(rate, url))
 
 
 def bench_imagenet_mixed(tmp):
@@ -335,18 +364,18 @@ def bench_imagenet_mixed(tmp):
     uniform = next((ln["value"] for ln in _EMITTED
                     if ln["metric"] == "imagenet_ingest_samples_per_sec"),
                    None)
+    # same-session anchor: the host decode of the SAME mixed data measured
+    # seconds ago - vs_baseline is the device-vs-host speedup, immune to
+    # host drift (VERDICT r4 item 6)
     return _emit(
         "imagenet_ingest_mixed_samples_per_sec", mixed_rate, "samples/sec",
-        R2["imagenet_ingest_samples_per_sec"],
+        max(host_rate, 1e-6),
         note=f"2-geometry jpeg dataset {geoms} via device-mixed"
-             f" (bucket-pad-scatter), pad target {target}; same-session"
-             f" host decode of the SAME mixed data: {host_rate:.0f}"
-             " samples/s (ratio"
-             f" {mixed_rate / max(host_rate, 1e-6):.2f}x);"
+             f" (bucket-pad-scatter), pad target {target}; vs_baseline ="
+             " ratio to the same-session HOST decode of the SAME mixed data"
+             f" ({host_rate:.0f} samples/s - the drift-immune anchor);"
              f" uniform-geometry device decode this session:"
-             f" {uniform if uniform is not None else 'n/a'};"
-             " vs_baseline uses the round-2 UNIFORM ingest constant"
-             " (no prior mixed number exists)")
+             f" {uniform if uniform is not None else 'n/a'}")
 
 
 # -- north star: same jpeg dataset through ours vs best-effort tf.data --------
@@ -732,10 +761,20 @@ def bench_cold_floor(tmp):
                  f" {pred:.0f} vs measured cold {cold:.0f} samples/s/chip"
                  f" ({100 * cold / pred:.0f}% of prediction) - cold is the"
                  " 1-core floor, mitigated by host cores (~14/chip on v5e),"
-                 " not by code")
-    # reference constant: round-4 capacity on this host (drifts +-30%)
+                 " not by code."
+                 " vs_baseline = measured/predicted, the SAME-SESSION model"
+                 " anchor (the round-4 absolute constant 4287 is retired -"
+                 " it drifted with the host, r4 capture hit 0.593 of it in"
+                 f" one session); ingest capacity this session: {ingest:.0f}")
+        # same-session anchor: how well the model holds, not how fast the
+        # host happened to be (VERDICT r4 item 6)
+        return _emit("cold_input_floor_samples_per_sec", ingest,
+                     "samples/sec", ingest * pred / cold, note=note)
+    note += ("; no same-session train rates on this backend - vs_baseline"
+             " pinned to 1.0 (model anchor unavailable, absolute recorded"
+             " for reference only)")
     return _emit("cold_input_floor_samples_per_sec", ingest, "samples/sec",
-                 4287.0, note=note)
+                 ingest, note=note)
 
 
 # -- config 4: converter ------------------------------------------------------
@@ -771,11 +810,12 @@ def bench_converter(tmp):
                     rows += int(next(iter(b.values())).shape[0])
                 rates.append(rows / (time.perf_counter() - t0))
         rate = _median(rates)
+        suffix = _ceiling_note(rate, os.path.join(tmp, "conv"))
     finally:
         conv.delete()
     return _emit("converter_rows_per_sec", rate, "rows/sec",
                  R2["converter_rows_per_sec"],
-                 note="median-of-3 vs round-2 recorded max-of-3")
+                 note="median-of-3 vs round-2 recorded max-of-3" + suffix)
 
 
 # -- config 5: ngram windows --------------------------------------------------
@@ -816,7 +856,8 @@ def bench_ngram(tmp):
     rate = _median([run() for _ in range(3)])
     return _emit("ngram_windows_per_sec", rate, "windows/sec",
                  R2["ngram_windows_per_sec"],
-                 note="median-of-3 vs round-2 recorded max-of-3")
+                 note="median-of-3 vs round-2 recorded max-of-3"
+                      + _ceiling_note(rate, url))
 
 
 def main() -> None:
