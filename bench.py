@@ -56,6 +56,20 @@ R2 = {"mnist_rows_per_sec": 430_000.0,
       "converter_rows_per_sec": 305_000.0,
       "ngram_windows_per_sec": 164_000.0}
 
+def _force_device_completion(batch):
+    """End-of-segment device sync: fetch ONE element of a device array.
+    The only sync that reliably waits on tunneled runtimes -
+    jax.block_until_ready has been observed there both as a no-op (early
+    session) and as a full ~115 ms network round trip per call (degraded
+    weather), either of which poisons per-batch timing."""
+    import jax
+
+    for v in (batch.values() if hasattr(batch, "values") else [batch]):
+        if isinstance(v, jax.Array):
+            jax.device_get(v.ravel()[0])
+            return
+
+
 def _raw_ceiling_rows_per_sec(url, repeats: int = 3) -> float:
     """Same-session anchor (VERDICT r4 item 6): raw pyarrow table reads of
     the SAME dataset - the host+pyarrow ceiling with zero framework code.
@@ -282,15 +296,18 @@ def bench_imagenet(tmp):
         with JaxDataLoader(r, batch_size=32, prefetch=3) as loader:
             it = iter(loader)
             for _ in range(16):
-                jax.block_until_ready(next(it))
+                b = next(it)
+            _force_device_completion(b)   # warmup fully landed
             rates = []
             for _ in range(3):
                 n = 0
                 t0 = time.perf_counter()
                 for _ in range(32):
                     b = next(it)
-                    jax.block_until_ready(b)
                     n += int(b["image"].shape[0])
+                # ONE sync per segment (per-batch syncs poison the timing on
+                # tunneled runtimes, see _force_device_completion)
+                _force_device_completion(b)
                 rates.append(n / (time.perf_counter() - t0))
     rate = _median(rates)
     return _emit("imagenet_ingest_samples_per_sec", rate, "samples/sec",
@@ -341,15 +358,16 @@ def bench_imagenet_mixed(tmp):
                                pad_shapes={"image": target}) as loader:
                 it = iter(loader)
                 for _ in range(16):
-                    jax.block_until_ready(next(it))
+                    b = next(it)
+                _force_device_completion(b)
                 rates = []
                 for _ in range(3):
                     n = 0
                     t0 = time.perf_counter()
                     for _ in range(24):
                         b = next(it)
-                        jax.block_until_ready(b)
                         n += int(b["image"].shape[0])
+                    _force_device_completion(b)
                     rates.append(n / (time.perf_counter() - t0))
         return _median(rates)
 
@@ -621,21 +639,31 @@ def bench_train_stall(tmp):
             env=env, timeout=900, check=True)
         return json.loads(out.stdout.strip().splitlines()[-1])
 
-    # peak dense FLOP/s per chip by device kind (bf16 systolic peak - XLA's
-    # default f32 matmul precision on TPU rides the bf16 MXU path)
+    # nominal dense bf16 peaks by device kind - the FALLBACK denominator
+    # only: the example's same-session matmul probe is authoritative, because
+    # a tunneled chip's device_kind label can misrepresent the hardware
+    # (this box's 'TPU v5 lite' sustained ~5x the nominal v5e peak)
     peak_flops = {"TPU v5 lite": 197e12, "TPU v5e": 197e12,
                   "TPU v4": 275e12, "TPU v3": 123e12, "TPU v2": 45e12}
+
+    def peak_for(m):
+        measured = m.get("measured_peak_flops")
+        if measured:
+            return measured, "same-session matmul probe"
+        kind = m.get("device_kind", "")
+        return peak_flops.get(kind), f"nominal {kind} table value"
 
     def mfu_pct(m, flops_from=None):
         """Model-FLOPs utilization: XLA's own cost-analysis FLOPs for the
         compiled train dispatch (fwd+bwd+optimizer), per sample, times the
-        measured samples/s/chip, over the chip's peak.  ``flops_from``
-        supplies the per-sample FLOPs for scan-mode runs (XLA counts a
-        lax.scan body once, so the scan executable's figure is unusable;
-        the scan=1 run of the same model/shapes is the right source)."""
+        measured samples/s/chip, over the chip's MEASURED peak (same FMA=2
+        convention on both sides).  ``flops_from`` supplies the per-sample
+        FLOPs for scan-mode runs (XLA counts a lax.scan body once, so the
+        scan executable's figure is unusable; the scan=1 run of the same
+        model/shapes is the right source)."""
         src = flops_from or m
-        f, kind = src.get("flops_per_sample"), m.get("device_kind", "")
-        peak = peak_flops.get(kind)
+        f = src.get("flops_per_sample")
+        peak, _ = peak_for(m)
         if not f or not peak:
             return None
         return 100.0 * m["samples_per_sec_per_chip"] * f / peak
@@ -658,14 +686,16 @@ def bench_train_stall(tmp):
                " warm memory LRU; vs round-1 recorded 1230")
     warm_mfu = mfu_pct(warm)
     if warm_mfu is not None:
+        peak, peak_src = peak_for(warm)
         _emit("imagenet_train_mfu_pct", warm_mfu, "%", 100.0,
               note=f"scan=1 warm: {warm['samples_per_sec_per_chip']:.0f}"
                    f" samples/s/chip x {warm['flops_per_sample']:.3g}"
                    " FLOP/sample (XLA cost_analysis of the compiled"
                    " fwd+bwd+optimizer dispatch) over"
-                   f" {peak_flops.get(warm.get('device_kind', ''), 0):.3g}"
-                   f" peak FLOP/s ({warm.get('device_kind')}); vs_baseline"
-                   " = fraction of chip peak (host-independent)")
+                   f" {peak:.3g} peak FLOP/s ({peak_src};"
+                   f" device_kind {warm.get('device_kind')!r}, nominal"
+                   f" {peak_flops.get(warm.get('device_kind', ''), 0):.3g});"
+                   " vs_baseline = fraction of chip peak (host-independent)")
     line = _emit("imagenet_train_samples_per_sec_per_chip",
                  cold["samples_per_sec_per_chip"], "samples/sec/chip",
                  1230.0,  # round-1 RESULTS.md recorded 1230-1340 on this chip
@@ -685,11 +715,13 @@ def bench_train_stall(tmp):
                " vs round-1 recorded 1230")
     scan8_mfu = mfu_pct(scan8, flops_from=warm)
     if scan8_mfu is not None:
+        peak, peak_src = peak_for(scan8)
         _emit("imagenet_train_warm_scan8_mfu_pct", scan8_mfu, "%", 100.0,
               note=f"scan=8 warm: {scan8['samples_per_sec_per_chip']:.0f}"
                    f" samples/s/chip x {warm['flops_per_sample']:.3g}"
                    " FLOP/sample (XLA cost_analysis of the scan=1 compiled"
-                   " step - the scan body is identical math) over chip peak;"
+                   " step - the scan body is identical math) over"
+                   f" {peak:.3g} peak FLOP/s ({peak_src});"
                    " vs_baseline = fraction of chip peak")
     if "input_stall_pct" in scan8:
         _emit("imagenet_train_scan8_input_stall_pct",
@@ -799,18 +831,21 @@ def bench_converter(tmp):
                                "shuffle_row_groups": False}) as loader:
             it = iter(loader)
             for _ in range(24):
-                jax.block_until_ready(next(it))
+                b = next(it)
+            _force_device_completion(b)
             rates = []
             for _ in range(3):
                 rows = 0
                 t0 = time.perf_counter()
                 for _ in range(32):
                     b = next(it)
-                    jax.block_until_ready(b)
                     rows += int(next(iter(b.values())).shape[0])
+                _force_device_completion(b)
                 rates.append(rows / (time.perf_counter() - t0))
         rate = _median(rates)
-        suffix = _ceiling_note(rate, os.path.join(tmp, "conv"))
+        # anchor on the EXACT materialized dataset the loader read, not the
+        # cache parent (debris/second materializations would inflate it)
+        suffix = _ceiling_note(rate, conv.cache_url)
     finally:
         conv.delete()
     return _emit("converter_rows_per_sec", rate, "rows/sec",

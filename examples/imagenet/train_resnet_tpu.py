@@ -278,6 +278,54 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
             return {"image": jnp.stack([b["image"] for b in bs]),
                     "label": jnp.stack([b["label"] for b in bs])}
 
+        def measure_peak_flops():
+            """Same-session matmul peak probe: the MFU DENOMINATOR is
+            measured, not read off a spec sheet (a tunneled chip's
+            device_kind label is not proof of its speed).  Chained bf16
+            4096x4096 matmuls in ONE dispatch (lax.fori_loop), timed at TWO
+            iteration counts and differenced - the marginal slope cancels
+            the fixed dispatch+fetch round trip (~100 ms on this tunnel).
+            Every timing here ends in a VALUE FETCH, not block_until_ready:
+            on the tunneled runtime block_until_ready returns immediately
+            (measured: 256 chained matmuls "completed" in 30 us), so only
+            fetching a result actually waits for the device.  FLOPs counted
+            as 2*n^3 per matmul - the same FMA=2 convention as XLA's
+            cost_analysis numerator.  (Round-5 capture: 192 TFLOP/s - the
+            nominal v5e 197 within 3%.)"""
+            if jax.default_backend() == "cpu":
+                return None  # minutes on CPU, and MFU is a chip metric
+            n = 4096
+            a = jax.random.normal(jax.random.PRNGKey(0), (n, n),
+                                  jnp.bfloat16) * 0.01
+            b = jax.random.normal(jax.random.PRNGKey(1), (n, n),
+                                  jnp.bfloat16) * 0.01
+
+            def make_burn(iters):
+                @jax.jit
+                def burn(a, b):
+                    out = jax.lax.fori_loop(0, iters, lambda i, c: c @ b, a)
+                    return (out.astype(jnp.float32) ** 2).sum()
+                return burn
+
+            lo, hi = 128, 512
+            burns = {it: make_burn(it) for it in (lo, hi)}
+            for it in (lo, hi):
+                float(burns[it](a, b))  # compile + settle
+            # INTERLEAVED passes, min per size: the probe's own matmuls feed
+            # the in-process dispatch degradation, so lo-then-hi in sequence
+            # would time the two sizes under different fixed overheads and
+            # bias the slope; alternating and taking minima cancels it
+            best = {lo: float("inf"), hi: float("inf")}
+            for _ in range(3):
+                for it in (lo, hi):
+                    t0 = time.perf_counter()
+                    float(burns[it](a, b))  # the fetch IS the sync
+                    best[it] = min(best[it], time.perf_counter() - t0)
+            slope = (best[hi] - best[lo]) / (hi - lo)
+            if slope <= 0:
+                return None  # drift swamped the probe; fall back to nominal
+            return 2 * n ** 3 / slope
+
         # AOT-compile the step once: the SAME executable runs the loop AND
         # reports XLA's FLOP estimate for the whole dispatch - the MFU
         # numerator comes from the compiler, not a hand-derived constant
@@ -293,9 +341,14 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
         def run_unit(p, o, unit, key):
             return exe(p, o, unit["image"], unit["label"], key)
 
-        # warmup: fill queues, settle dispatch
+        # warmup: fill queues, settle dispatch.  Every measured window below
+        # ends in a VALUE FETCH (float(loss)), never block_until_ready: the
+        # tunneled runtime's block_until_ready returns without waiting
+        # (verified with the peak probe above), and the loss chains through
+        # every step's params, so fetching it waits for ALL queued compute -
+        # the wall times below include full device completion
         params, opt_state, loss = run_unit(params, opt_state, unit0, aug_key)
-        jax.block_until_ready(loss)
+        float(loss)
         # consumer wait accumulates while the consumer blocks on the prefetch
         # queue: the delta over the measured window IS the device-idle time
         # attributable to input starvation during REAL train steps
@@ -307,7 +360,7 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
                                                jax.random.fold_in(aug_key, step))
             step += max(scan_steps, 1)
             n_disp += 1
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         input_wait_s = consumer_wait(feed) - wait0
         # compute floor: the SAME number of dispatches on one RESIDENT unit -
@@ -320,8 +373,13 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
         for i in range(n_disp):
             p2, o2, loss2 = run_unit(p2, o2, unit_f,
                                      jax.random.fold_in(aug_key, 1 << 20 | i))
-        jax.block_until_ready(loss2)
+        float(loss2)
         compute_dt = time.perf_counter() - t1
+        # the probe runs LAST: this box's tunneled dispatch path degrades
+        # under sustained in-process load (RESULTS.md environment caveat),
+        # so running ~1300 probe matmuls BEFORE the measured windows was
+        # observed to poison them (dispatch cost 4 ms -> ~70 ms)
+        measured_peak = measure_peak_flops()
         diag = feed.diagnostics if hasattr(feed, "diagnostics") else {}
     samples = step * global_batch
     # per-sample FLOPs only from the SINGLE-step lowering: XLA's cost model
@@ -339,6 +397,7 @@ def train(dataset_url: str, steps: int, global_batch: int, side: int,
         "input_stall_pct": 100.0 * max(0.0, dt - compute_dt) / dt,
         "compute_floor_wall_s": compute_dt,
         "flops_per_sample": flops_per_sample,
+        "measured_peak_flops": measured_peak,
         "device_kind": devices[0].device_kind,
         "steps": step,
         "scan_steps": scan_steps,
