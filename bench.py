@@ -277,6 +277,7 @@ def _ensure_imagenet(tmp):
 
 
 def bench_imagenet(tmp):
+    _require_device_runtime()
     from petastorm_tpu.jax import JaxDataLoader
     from petastorm_tpu.reader import make_batch_reader
 
@@ -324,6 +325,7 @@ def bench_imagenet_mixed(tmp):
     number from bench_imagenet for cross-reference).  Round 4 proved mixed
     decode works; this proves the bucketing does not give the hybrid win
     back."""
+    _require_device_runtime()
     import numpy as np
 
     import jax
@@ -407,6 +409,7 @@ def bench_north_star(tmp):
     A/B/A/B so tunnel/CPU drift hits both equally (RESULTS.md hygiene).
     Harness contract: reference petastorm/benchmark/throughput.py:113-174.
     """
+    _require_device_runtime()
     import numpy as np
 
     url = _ensure_imagenet(tmp)
@@ -519,17 +522,43 @@ def _child_env():
     return env
 
 
+_BACKEND_CACHE: dict = {}
+
+
 def _backend_in_child(env):
     """Probe the default backend in a CHILD so the parent process never
     initializes the device runtime (train subprocesses must own the chip
-    exclusively - a second tunnel client timeshares dispatch)."""
+    exclusively - a second tunnel client timeshares dispatch).  A hung
+    tunnel (observed: first device op never returns) yields 'unreachable'
+    instead of hanging the whole bench - device configs then SKIP while the
+    host-only configs (incl. the hello_world headline) still emit."""
     import subprocess
 
-    probe = subprocess.run(
-        [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
-        timeout=300)
-    return probe.stdout.strip()
+    key = env.get("JAX_PLATFORMS", "")
+    if key in _BACKEND_CACHE:
+        return _BACKEND_CACHE[key]
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; x = jax.numpy.ones((2, 2));"
+             " float((x @ x).sum()); print(jax.default_backend())"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env, timeout=300)
+        result = probe.stdout.strip().splitlines()[-1] if probe.stdout.strip() else ""
+    except subprocess.TimeoutExpired:
+        result = "unreachable"
+    _BACKEND_CACHE[key] = result
+    return result
+
+
+def _require_device_runtime():
+    """Raise (-> a recorded per-config error, not a hang) when the device
+    runtime cannot complete one op; the caller would otherwise initialize
+    jax IN-PROCESS and hang the entire bench on a dead tunnel."""
+    if _backend_in_child(_child_env()) == "unreachable":
+        raise RuntimeError(
+            "device runtime unreachable (probe op never returned);"
+            " skipping this device-touching config")
 
 
 def bench_north_star_train(tmp):
@@ -545,7 +574,12 @@ def bench_north_star_train(tmp):
     import subprocess
 
     env = _child_env()
-    on_chip = _backend_in_child(env) not in ("cpu", "")
+    backend = _backend_in_child(env)
+    if backend == "unreachable":
+        raise RuntimeError("device runtime unreachable (probe op never"
+                           " returned); skipping - train children would hang"
+                           " against a dead tunnel")
+    on_chip = backend not in ("cpu", "")
     if on_chip:
         url = _ensure_imagenet(tmp)
         shape = ["--steps", "200", "--global-batch", "32", "--side", "224"]
@@ -611,7 +645,12 @@ def bench_train_stall(tmp):
     env = _child_env()
     # this config runs FIRST so the parent has not initialized the device
     # runtime and the train subprocesses own the chip exclusively
-    on_chip = _backend_in_child(env) not in ("cpu", "")
+    backend = _backend_in_child(env)
+    if backend == "unreachable":
+        raise RuntimeError("device runtime unreachable (probe op never"
+                           " returned); skipping - train children would hang"
+                           " against a dead tunnel")
+    on_chip = backend not in ("cpu", "")
     if on_chip:
         url = _ensure_imagenet(tmp)
         shape = ["--steps", "200", "--global-batch", "32", "--side", "224"]
@@ -785,7 +824,7 @@ def bench_cold_floor(tmp):
     # the model note only holds when the train rates came from the SAME
     # 224px dataset measured here - on a cpu backend bench_train_stall used
     # the tiny 64px fallback, an incomparable workload
-    if _backend_in_child(_child_env()) in ("cpu", ""):
+    if _backend_in_child(_child_env()) in ("cpu", "", "unreachable"):
         cold = warm = None
     if cold and warm:
         pred = 1.0 / (1.0 / warm + 1.0 / ingest)
@@ -812,6 +851,7 @@ def bench_cold_floor(tmp):
 # -- config 4: converter ------------------------------------------------------
 
 def bench_converter(tmp):
+    _require_device_runtime()
     import numpy as np
     import pyarrow as pa
 
