@@ -103,7 +103,10 @@ def train(url: str, batch_size: int = 32, preempt_at: int = 3,
         # (a hang) - construct the loader with valid_mask_field="mask" and
         # run EVERY drained step, weighting the loss by the mask
         # (docs/operations.md "Checkpoint / resume" has the full pattern,
-        # executed for real by petastorm-tpu-selfcheck).
+        # executed for real by petastorm-tpu-selfcheck).  Scan-feed loaders
+        # (stack_batches=K) drain WHOLE stacks with per-step '_valid_rows'
+        # arrays and a (K, B) mask - same contract at stack granularity,
+        # executed across real processes by the selfcheck's shuffled phase.
         for b in loader.drain():
             if b.get("_valid_rows", 1) == 0:
                 continue
