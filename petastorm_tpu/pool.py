@@ -27,6 +27,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 import traceback
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Iterable, Optional
@@ -171,6 +172,11 @@ class SerialExecutor(ExecutorBase):
     def join(self) -> None:
         pass
 
+    @property
+    def diagnostics(self) -> dict:
+        return {**super().diagnostics,
+                "in_queue_size": self._items.qsize()}
+
 
 class ThreadedExecutor(ExecutorBase):
     """Bounded-queue thread pool (reference ThreadPool, thread_pool.py:78-221).
@@ -185,14 +191,22 @@ class ThreadedExecutor(ExecutorBase):
                  profiling_enabled: bool = False):
         super().__init__()
         self._workers_count = workers_count
-        # SimpleQueue (C implementation) + bound semaphores instead of
-        # queue.Queue: the data handoff itself becomes a C call (no python
-        # mutex + two condition notifies per op); the semaphores still cost
-        # python-level sync but their waiters only pile up at the bounds.
-        # Measured: modest but consistent gain on a contended 1-core host.
-        # reference bounds ventilation at workers_count + 2 (reader.py:45-47,412)
-        # and treats a non-positive results size as unbounded
-        self._in_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        # Queue choice is correctness-driven (hang post-mortem, RESULTS.md):
+        # a full-suite run wedged with one worker stuck INSIDE
+        # SimpleQueue.get(timeout=0.05) past its deadline while join()
+        # waited on it forever — reproduced twice with full stacks by
+        # tools/stress_soak.py.  The C SimpleQueue's timed get is the only
+        # primitive in that loop whose multi-CONSUMER timeout path we cannot
+        # vouch for (N workers consume _in_queue concurrently and items can
+        # be stolen between a consumer's lock grant and its GIL
+        # reacquisition), so the input side uses the pure-python queue.Queue,
+        # whose Condition-based timeout is correct by construction.  The
+        # output side keeps the faster C SimpleQueue: it has exactly ONE
+        # consumer (the reader thread), which closes the steal window.
+        # Bounds live in the semaphores either way (reference bounds
+        # ventilation at workers_count + 2, reader.py:45-47,412, and treats
+        # a non-positive results size as unbounded).
+        self._in_queue: "queue.Queue[Any]" = queue.Queue()
         self._in_slots = threading.BoundedSemaphore(in_queue_size or workers_count + 2)
         self._out_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
         self._out_slots = threading.BoundedSemaphore(
@@ -207,19 +221,27 @@ class ThreadedExecutor(ExecutorBase):
         self._profiling_enabled = profiling_enabled
         self._profiles = []
         self._profiles_lock = threading.Lock()
+        # per-worker heartbeat: [ordinal-or-None, monotonic-since].  Written
+        # only by the owning worker (single-writer per slot, no lock needed);
+        # read by diagnostics to attribute a pipeline stall to the exact
+        # worker and work item (RESULTS.md hang watch item).
+        self._worker_state: list = []
 
     def start(self, worker_factory: WorkerFactory) -> None:
         if self._threads:
             raise PetastormTpuError("Executor already started")
         for i in range(self._workers_count):
             fn = worker_factory()
+            self._worker_state.append([None, time.monotonic()])
             t = threading.Thread(target=self._worker_loop,
-                                 args=(fn, self._profiling_enabled and i == 0),
+                                 args=(fn, i, self._profiling_enabled and i == 0),
                                  name=f"petastorm-tpu-worker-{i}", daemon=True)
             t.start()
             self._threads.append(t)
 
-    def _worker_loop(self, fn: Callable, profile_this_worker: bool = False) -> None:
+    def _worker_loop(self, fn: Callable, index: int = 0,
+                     profile_this_worker: bool = False) -> None:
+        state = self._worker_state[index]
         profile = None
         if profile_this_worker:
             import cProfile
@@ -231,6 +253,11 @@ class ThreadedExecutor(ExecutorBase):
             except queue.Empty:
                 continue
             self._in_slots.release()
+            # timestamp BEFORE ordinal: a concurrent diagnostics read between
+            # the two writes must never pair the new item with the old
+            # idle-since time (it would report the whole idle gap as "stuck")
+            state[1] = time.monotonic()
+            state[0] = getattr(item, "ordinal", "?")
             try:
                 if profile is not None:
                     try:
@@ -250,6 +277,8 @@ class ThreadedExecutor(ExecutorBase):
             except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
                 result = _Failure(exc)
             self._put_result_stop_aware(result)
+            state[0] = None
+            state[1] = time.monotonic()
         if profile is not None:
             with self._profiles_lock:
                 self._profiles.append(profile)
@@ -291,11 +320,23 @@ class ThreadedExecutor(ExecutorBase):
         self._stopped = True
         self._stop_event.set()
 
-    def join(self) -> None:
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for worker threads.  ``timeout`` (total, across all workers)
+        bounds the wait when a worker may be wedged inside user code — e.g.
+        after a stall abort: the threads are daemonic, so abandoning them
+        cannot block process exit, and a warning names what was abandoned."""
         if not self._stopped:
             raise PetastormTpuError("call stop() before join()")
+        deadline = None if timeout is None else time.monotonic() + timeout
         for t in self._threads:
-            t.join()
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+        alive = [t.name for t in self._threads if t.is_alive()]
+        if alive:
+            logger.warning(
+                "Abandoning %d wedged daemon worker thread(s) %s after %.0fs;"
+                " pipeline state: %s", len(alive), alive, timeout or 0,
+                self.diagnostics)
         if self._profiling_enabled and self._profiles:
             stats = self.profile_stats()
             if stats is not None:
@@ -323,10 +364,22 @@ class ThreadedExecutor(ExecutorBase):
 
     @property
     def diagnostics(self) -> dict:
+        now = time.monotonic()
+        # snapshot each slot's ordinal ONCE: the worker may clear it between
+        # a guard and a second read, which would emit a spurious None entry
+        busy = []
+        for i, s in enumerate(self._worker_state):
+            ordinal = s[0]
+            if ordinal is not None:
+                busy.append((i, ordinal, round(now - s[1], 3)))
         return {**super().diagnostics,
                 "in_queue_size": self._in_queue.qsize(),
                 "results_queue_size": self._out_queue.qsize(),
-                "workers_count": self._workers_count}
+                "workers_count": self._workers_count,
+                # [(worker index, item ordinal, seconds on it)] for workers
+                # currently inside fn(item) - a stalled pipeline names the
+                # exact worker and work item instead of wedging silently
+                "workers_busy": busy}
 
 
 def _process_worker_main(worker_factory, in_queue, out_queue, stop_event):
@@ -467,6 +520,11 @@ class _ProcessExecutor(ExecutorBase):
         diag = {**super().diagnostics, "workers_count": self._workers_count,
                 "workers_alive": sum(p.is_alive() for p in self._procs),
                 "shm_transport": self._arena is not None}
+        try:  # mp.Queue.qsize raises NotImplementedError on some platforms
+            diag["in_queue_size"] = self._in_queue.qsize()
+            diag["results_queue_size"] = self._out_queue.qsize()
+        except NotImplementedError:
+            pass
         if self._arena is not None:
             diag["shm_free_bytes"] = self._arena.free_bytes()
         return diag

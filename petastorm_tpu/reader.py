@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import os
 import queue
+import time
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -44,7 +45,7 @@ from petastorm_tpu.etl.indexing import get_row_group_indexes
 from petastorm_tpu.etl.metadata import open_dataset
 from petastorm_tpu.fs import FilesystemFactory
 from petastorm_tpu.plan import ElasticResumePlan, ReadPlan, elastic_resume_plan
-from petastorm_tpu.pool import Ventilator, make_executor
+from petastorm_tpu.pool import Ventilator, WorkerError, make_executor
 from petastorm_tpu.schema import Schema
 from petastorm_tpu.transform import TransformSpec, transform_schema
 from petastorm_tpu.worker import RowGroupDecoderWorker
@@ -53,6 +54,25 @@ logger = logging.getLogger(__name__)
 
 _GET_TIMEOUT_S = 0.5
 _DEFAULT_RESULTS_QUEUE_BATCHES = 10  # batches are whole rowgroups; keep RAM bounded
+# stall detection (see Reader._next_batch)
+
+
+def _env_seconds(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring non-numeric %s=%r (using %.0f)",
+                       name, raw, default)
+        return default
+
+
+# defaults; re-read from the environment at every Reader construction so
+# setting the vars after `import petastorm_tpu` still takes effect
+_STALL_WARN_S = 120.0
+_STALL_ABORT_S = 0.0
 
 
 def make_reader(dataset_url: str,
@@ -465,6 +485,13 @@ class Reader:
         self._executor = executor
         self._num_epochs = num_epochs
         self._stopped = False
+        self._stall_aborted = False
+        # latched per reader: env wins over the module defaults (which tests
+        # may monkeypatch); <= 0 disables the respective behavior
+        self._stall_warn_s = _env_seconds("PETASTORM_TPU_STALL_WARN_S",
+                                          _STALL_WARN_S)
+        self._stall_abort_s = _env_seconds("PETASTORM_TPU_STALL_ABORT_S",
+                                           _STALL_ABORT_S)
         self.last_row_consumed = False
         #: set by make_reader after construction (decode_placement='device')
         self.device_decode_fields: list = []
@@ -565,7 +592,16 @@ class Reader:
                 and self._consumed_items >= self._expected_items)
 
     def _next_batch(self) -> ColumnBatch:
-        """Next non-empty ColumnBatch, or StopIteration at end of all epochs."""
+        """Next non-empty ColumnBatch, or StopIteration at end of all epochs.
+
+        Stall detection: when no result arrives for PETASTORM_TPU_STALL_WARN_S
+        seconds (default 120) a WARNING names the stuck workers and their work
+        items (executor heartbeats); PETASTORM_TPU_STALL_ABORT_S (default off)
+        escalates a longer stall to a WorkerError so a wedged pipeline fails
+        loudly instead of waiting forever.
+        """
+        last_progress = time.monotonic()
+        warned_at = 0.0
         while True:
             if self._stopped:
                 raise ReaderClosedError("Reader was stopped mid-iteration")
@@ -575,7 +611,26 @@ class Reader:
             try:
                 batch = self._executor.get(timeout=_GET_TIMEOUT_S)
             except queue.Empty:
+                stalled = time.monotonic() - last_progress
+                if self._stall_abort_s > 0 and stalled > self._stall_abort_s:
+                    self._stall_aborted = True
+                    diag = self.diagnostics  # snapshot before stop() mutates it
+                    # stop the pipeline like the worker-failure path does:
+                    # a caller that catches this must not inherit a live
+                    # ventilator + polling workers
+                    self.stop()
+                    raise WorkerError(
+                        f"No result for {stalled:.0f}s (PETASTORM_TPU_"
+                        f"STALL_ABORT_S={self._stall_abort_s:.0f}); pipeline"
+                        f" state: {diag}")
+                if (self._stall_warn_s > 0 and stalled > self._stall_warn_s
+                        and stalled - warned_at > self._stall_warn_s):
+                    warned_at = stalled
+                    logger.warning(
+                        "Reader has produced no batch for %.0fs; pipeline"
+                        " state: %s", stalled, self.diagnostics)
                 continue
+            last_progress = time.monotonic()
             self._consumed_items += 1
             if batch.ordinal is not None:
                 self._ordinals_seen = True
@@ -671,8 +726,19 @@ class Reader:
         self._executor.stop()
 
     def join(self) -> None:
-        """Wait for the pool workers and ventilator to exit (after stop())."""
+        """Wait for the pool workers and ventilator to exit (after stop()).
+
+        After a stall abort the wait is bounded: the executor abandons any
+        worker still wedged inside user code (daemon threads) instead of
+        trading the iteration hang the abort just broke for a close hang.
+        """
         self._ventilator.join()
+        if self._stall_aborted:
+            try:
+                self._executor.join(timeout=5.0)
+                return
+            except TypeError:  # executor flavor without bounded join
+                pass
         self._executor.join()
 
     def __enter__(self):

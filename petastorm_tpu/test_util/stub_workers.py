@@ -54,6 +54,23 @@ class PidWorker:
         return lambda _x: os.getpid()
 
 
+class BlockingWorker:
+    """Wedges on items equal to the trigger until ``release`` is set —
+    drives the stall-detection diagnostics (thread pool only: the event is
+    shared in-process)."""
+
+    def __init__(self, release, trigger=1):
+        self.release = release
+        self.trigger = trigger
+
+    def __call__(self):
+        def fn(x):
+            if getattr(x, "item", x) == self.trigger:
+                self.release.wait()
+            return x
+        return fn
+
+
 class HardCrashWorker:
     """Simulates an OOM-kill/segfault: the worker PROCESS dies without a
     traceback (os._exit bypasses exception handling entirely)."""

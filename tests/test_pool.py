@@ -96,6 +96,85 @@ def test_diagnostics():
         assert d["workers_count"] == 2
 
 
+def test_workers_busy_heartbeat_names_stuck_item():
+    """A wedged worker is attributable: diagnostics report (worker index,
+    item ordinal, seconds stuck) while it is inside fn (RESULTS.md hang
+    watch item -> stall diagnostics)."""
+    import threading
+
+    from petastorm_tpu.pool import VentilatedItem
+    from petastorm_tpu.test_util.stub_workers import BlockingWorker
+
+    release = threading.Event()
+    with ThreadedExecutor(workers_count=2) as ex:
+        ex.start(BlockingWorker(release, trigger=7))
+        ex.put(VentilatedItem(7, 7))
+        deadline = time.monotonic() + 10
+        busy = []
+        while time.monotonic() < deadline:
+            busy = ex.diagnostics["workers_busy"]
+            if busy:
+                break
+            time.sleep(0.02)
+        assert busy, "stuck worker never appeared in workers_busy"
+        (_idx, ordinal, stuck_s) = busy[0]
+        assert ordinal == 7 and stuck_s >= 0
+        release.set()
+        got = ex.get(timeout=10)
+        assert got.item == 7
+        # after completion the heartbeat clears
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and ex.diagnostics["workers_busy"]:
+            time.sleep(0.02)
+        assert ex.diagnostics["workers_busy"] == []
+
+
+def test_reader_stall_warns_and_aborts(tmp_path, monkeypatch, caplog):
+    """A pipeline that stops producing results warns with the pipeline state
+    and (with PETASTORM_TPU_STALL_ABORT_S) raises instead of wedging."""
+    import logging
+    import threading
+
+    import numpy as np
+
+    from petastorm_tpu import reader as reader_mod
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+    from petastorm_tpu.transform import TransformSpec
+
+    url = str(tmp_path / "ds")
+    schema = Schema("S", [Field("x", np.int64)])
+    write_dataset(url, schema, [{"x": i} for i in range(8)],
+                  row_group_size_rows=4)
+
+    release = threading.Event()
+
+    def wedge(cols):
+        release.wait()
+        return cols
+
+    monkeypatch.setattr(reader_mod, "_STALL_WARN_S", 0.3)
+    monkeypatch.setattr(reader_mod, "_STALL_ABORT_S", 1.5)
+    t0 = time.monotonic()
+    try:
+        with make_batch_reader(url, reader_pool_type="thread",
+                               workers_count=1, shuffle_row_groups=False,
+                               transform_spec=TransformSpec(wedge)) as r:
+            with caplog.at_level(logging.WARNING,
+                                 logger="petastorm_tpu.reader"):
+                with pytest.raises(WorkerError) as ei:
+                    next(iter(r.iter_batches()))
+            assert "workers_busy" in str(ei.value)
+            assert any("no batch" in rec.message for rec in caplog.records)
+        # the exit above must NOT wedge on joining the still-blocked worker:
+        # after a stall abort the executor join is bounded and abandons it
+        # (daemonic), logging what it abandoned
+        assert time.monotonic() - t0 < 30
+    finally:
+        release.set()  # let the abandoned daemon thread finish and exit
+
+
 def _plan(n=6):
     rgs = [RowGroupRef(f"/f{i}", 0, 5, i) for i in range(n)]
     return ReadPlan(rgs, shuffle_row_groups=False)
