@@ -192,20 +192,20 @@ class ThreadedExecutor(ExecutorBase):
         super().__init__()
         self._workers_count = workers_count
         # Queue choice is correctness-driven (hang post-mortem, RESULTS.md):
-        # a full-suite run wedged with one worker stuck INSIDE
-        # SimpleQueue.get(timeout=0.05) past its deadline while join()
-        # waited on it forever — reproduced twice with full stacks by
-        # tools/stress_soak.py.  The C SimpleQueue's timed get is the only
-        # primitive in that loop whose multi-CONSUMER timeout path we cannot
-        # vouch for (N workers consume _in_queue concurrently and items can
-        # be stolen between a consumer's lock grant and its GIL
-        # reacquisition), so the input side uses the pure-python queue.Queue,
-        # whose Condition-based timeout is correct by construction.  The
-        # output side keeps the faster C SimpleQueue: it has exactly ONE
-        # consumer (the reader thread), which closes the steal window.
-        # Bounds live in the semaphores either way (reference bounds
-        # ventilation at workers_count + 2, reader.py:45-47,412, and treats
-        # a non-positive results size as unbounded).
+        # CPython's SimpleQueue.get(timeout) WEDGES under multiple
+        # concurrent consumers — when a waiter wins the internal lock but a
+        # sibling steals the item before it reacquires the GIL, the
+        # remaining timeout is recomputed without clamping and a negative
+        # value means an INFINITE lock wait (confirmed by disassembly and
+        # reproduced standalone: tools/simplequeue_wedge_repro.py; it froze
+        # a full suite run via this very pool).  _in_queue has N worker
+        # consumers, so it uses the pure-python queue.Queue, whose
+        # Condition-based timeout is correct by construction.  The output
+        # side keeps the faster C SimpleQueue: it has exactly ONE consumer
+        # (the reader thread), which closes the steal window.  Bounds live
+        # in the semaphores either way (reference bounds ventilation at
+        # workers_count + 2, reader.py:45-47,412, and treats a non-positive
+        # results size as unbounded).
         self._in_queue: "queue.Queue[Any]" = queue.Queue()
         self._in_slots = threading.BoundedSemaphore(in_queue_size or workers_count + 2)
         self._out_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
