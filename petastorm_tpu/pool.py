@@ -371,7 +371,9 @@ class ThreadedExecutor(ExecutorBase):
         for i, s in enumerate(self._worker_state):
             ordinal = s[0]
             if ordinal is not None:
-                busy.append((i, ordinal, round(now - s[1], 3)))
+                # clamp: the worker may stamp a newer time between our `now`
+                # snapshot and this read
+                busy.append((i, ordinal, round(max(0.0, now - s[1]), 3)))
         return {**super().diagnostics,
                 "in_queue_size": self._in_queue.qsize(),
                 "results_queue_size": self._out_queue.qsize(),
@@ -382,8 +384,16 @@ class ThreadedExecutor(ExecutorBase):
                 "workers_busy": busy}
 
 
-def _process_worker_main(worker_factory, in_queue, out_queue, stop_event):
-    """Worker-process entrypoint (module-level: must be picklable for spawn)."""
+def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
+                         index=0, heartbeats=None):
+    """Worker-process entrypoint (module-level: must be picklable for spawn).
+
+    ``heartbeats``: optional lock-free shared double array, 2 slots per
+    worker: [ordinal (-1 = idle), wall-clock since] — same stall-attribution
+    contract as ThreadedExecutor's ``workers_busy``, crossing the process
+    boundary via shared memory.  Wall clock (time.time), not monotonic:
+    monotonic clocks are not comparable across processes on all platforms.
+    """
     try:
         fn = worker_factory()
     except BaseException as exc:  # noqa: BLE001
@@ -391,6 +401,7 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event):
         return
     if hasattr(fn, "stop_event"):  # shm encoder: abort full-arena waits on stop
         fn.stop_event = stop_event
+    base = 2 * index
     while not stop_event.is_set():
         try:
             item = in_queue.get(timeout=_POLL_S)
@@ -398,11 +409,23 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event):
             continue
         if item is _ProcessExecutor._STOP_SENTINEL_VALUE:
             break
+        if heartbeats is not None:
+            try:
+                ordinal = float(item.ordinal)
+            except (AttributeError, TypeError, ValueError):
+                ordinal = -2.0  # busy, ordinal unknown
+            # timestamp before ordinal (same reasoning as the thread pool:
+            # a concurrent read must never pair a new item with an old time)
+            heartbeats[base + 1] = time.time()
+            heartbeats[base] = ordinal
         try:
             result = fn(item)
         except BaseException as exc:  # noqa: BLE001
             result = _Failure(exc)
         out_queue.put(result)
+        if heartbeats is not None:
+            heartbeats[base] = -1.0
+            heartbeats[base + 1] = time.time()
 
 
 class _ProcessExecutor(ExecutorBase):
@@ -435,6 +458,7 @@ class _ProcessExecutor(ExecutorBase):
         self._stop_event = self._ctx.Event()
         self._procs = []
         self._arena = None
+        self._heartbeats = None
         self._shm_size_bytes = shm_size_bytes
         if use_shm is None:  # auto: use the native transport when it builds
             from petastorm_tpu.native import is_available
@@ -451,10 +475,15 @@ class _ProcessExecutor(ExecutorBase):
 
             self._arena = SharedArena.create(self._shm_size_bytes)
             worker_factory = ShmResultEncoder(worker_factory, self._arena.name)
+        # lock-free heartbeat slots (single-writer per pair; see
+        # _process_worker_main) - powers workers_busy across processes
+        self._heartbeats = self._ctx.RawArray("d", 2 * self._workers_count)
         for i in range(self._workers_count):
+            self._heartbeats[2 * i] = -1.0
             p = self._ctx.Process(
                 target=_process_worker_main,
-                args=(worker_factory, self._in_queue, self._out_queue, self._stop_event),
+                args=(worker_factory, self._in_queue, self._out_queue,
+                      self._stop_event, i, self._heartbeats),
                 name=f"petastorm-tpu-worker-{i}", daemon=True)
             p.start()
             self._procs.append(p)
@@ -525,6 +554,19 @@ class _ProcessExecutor(ExecutorBase):
             diag["results_queue_size"] = self._out_queue.qsize()
         except NotImplementedError:
             pass
+        if self._heartbeats is not None:
+            now = time.time()
+            busy = []
+            for i in range(self._workers_count):
+                ordinal = self._heartbeats[2 * i]
+                if ordinal != -1.0:  # -1 = idle; -2 = busy, ordinal unknown
+                    # clamp: the worker may stamp a newer wall-clock time
+                    # between our `now` snapshot and this read (and
+                    # time.time() can step backwards under NTP)
+                    busy.append((i, int(ordinal) if ordinal >= 0 else "?",
+                                 round(max(0.0, now
+                                           - self._heartbeats[2 * i + 1]), 3)))
+            diag["workers_busy"] = busy
         if self._arena is not None:
             diag["shm_free_bytes"] = self._arena.free_bytes()
         return diag

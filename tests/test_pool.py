@@ -129,6 +129,34 @@ def test_workers_busy_heartbeat_names_stuck_item():
         assert ex.diagnostics["workers_busy"] == []
 
 
+def test_process_pool_workers_busy_heartbeat():
+    """The heartbeat contract crosses the process boundary: a worker busy
+    inside fn shows up in workers_busy with its item ordinal, via the
+    lock-free shared slots (docs/operations.md stall diagnostics)."""
+    from petastorm_tpu.pool import VentilatedItem, _ProcessExecutor
+    from petastorm_tpu.test_util.stub_workers import SleepyWorker
+
+    with _ProcessExecutor(workers_count=1) as ex:
+        ex.start(SleepyWorker(4.0))
+        ex.put(VentilatedItem(9, "x"))
+        deadline = time.monotonic() + 30
+        busy = []
+        while time.monotonic() < deadline:
+            busy = ex.diagnostics.get("workers_busy", [])
+            if busy:
+                break
+            time.sleep(0.1)
+        assert busy and busy[0][:2] == (0, 9) and busy[0][2] >= 0, busy
+        got = ex.get(timeout=60)
+        assert got.item == "x"
+        # idle again once the result is delivered
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and ex.diagnostics.get("workers_busy")):
+            time.sleep(0.05)
+        assert ex.diagnostics.get("workers_busy") == []
+
+
 def test_reader_stall_warns_and_aborts(tmp_path, monkeypatch, caplog):
     """A pipeline that stops producing results warns with the pipeline state
     and (with PETASTORM_TPU_STALL_ABORT_S) raises instead of wedging."""
