@@ -15,10 +15,8 @@ Usage:  python tools/stress_soak.py [--seconds 14400] [--dump /tmp/soak_dump.txt
 """
 import argparse
 import collections
-import faulthandler
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -30,29 +28,9 @@ from petastorm_tpu.codecs import NdarrayCodec
 from petastorm_tpu.etl.writer import write_dataset
 from petastorm_tpu.reader import make_batch_reader
 from petastorm_tpu.schema import Field, Schema
+from tools.soak_common import start_progress_watchdog, validated_dataset
 
 ROWS = 192  # 48 rowgroups x 4 rows
-
-
-def capture_os_thread_state(out):
-    """Append each OS thread's syscall args and kernel wait channel.
-
-    /proc/<tid>/syscall shows the blocked syscall number and its raw args -
-    for futex waits, whether a timeout struct was passed (arg4 != 0).
-    """
-    me = os.getpid()
-    for tid in sorted(os.listdir(f"/proc/{me}/task")):
-        base = f"/proc/{me}/task/{tid}"
-        try:
-            with open(f"{base}/comm") as f:
-                comm = f.read().strip()
-            with open(f"{base}/wchan") as f:
-                wchan = f.read().strip()
-            with open(f"{base}/syscall") as f:
-                syscall = f.read().strip()
-        except OSError:
-            continue
-        out.write(f"tid {tid} [{comm}] wchan={wchan} syscall={syscall}\n")
 
 
 def main():
@@ -64,36 +42,20 @@ def main():
     ap.add_argument("--dataset", default="/tmp/stress_soak_ds")
     args = ap.parse_args()
 
-    if not os.path.exists(args.dataset):
+    def build(url):
         schema = Schema("Stress", [
             Field("id", np.int64),
             Field("payload", np.float32, (64,), NdarrayCodec()),
         ])
-        write_dataset(args.dataset, schema,
+        write_dataset(url, schema,
                       [{"id": i, "payload": np.full(64, i, np.float32)}
                        for i in range(ROWS)],
                       row_group_size_rows=4)
 
+    validated_dataset(args.dataset, ROWS, build)
     progress = [0]
-
-    def monitor():
-        last, last_t = progress[0], time.time()
-        while True:
-            time.sleep(10)
-            if progress[0] != last:
-                last, last_t = progress[0], time.time()
-                continue
-            if time.time() - last_t > args.wedge_after:
-                with open(args.dump, "w") as f:
-                    f.write(f"WEDGE: no batch for {time.time() - last_t:.0f}s"
-                            f" at progress={last}\n\n")
-                    faulthandler.dump_traceback(file=f, all_threads=True)
-                    f.write("\n-- OS thread state --\n")
-                    capture_os_thread_state(f)
-                print(f"WEDGED - evidence in {args.dump}", flush=True)
-                os._exit(3)
-
-    threading.Thread(target=monitor, daemon=True).start()
+    start_progress_watchdog(progress, args.wedge_after, args.dump,
+                            label="stress_soak")
 
     t_start = time.time()
     i = 0
