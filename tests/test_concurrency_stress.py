@@ -131,6 +131,40 @@ def test_native_decode_fanout_matches_single_thread(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+def test_concurrency_fuzz_smoke(tmp_path):
+    """A bounded slice of tools/concurrency_fuzz.py runs in CI: a dozen
+    seeded random configurations (pool flavor x workers x epochs x
+    consumption pattern), each asserting the exact-multiset invariant.
+    The open-ended version is `python tools/concurrency_fuzz.py`."""
+    import collections
+    import random
+
+    from petastorm_tpu.reader import make_batch_reader
+    from tools import concurrency_fuzz as fuzz
+
+    datasets = fuzz.build_datasets(str(tmp_path))
+    for seed in range(12):
+        rnd = random.Random(seed)
+        url = rnd.choice(datasets)
+        epochs = rnd.randint(1, 2)
+        cfg = dict(reader_pool_type=rnd.choice(["thread", "thread", "serial"]),
+                   workers_count=rnd.choice([1, 4, 8]),
+                   num_epochs=epochs,
+                   shuffle_row_groups=rnd.random() < 0.8,
+                   shuffle_seed=rnd.randint(0, 999),
+                   results_queue_size=rnd.choice([2, 10]))
+        mode = rnd.choice(["plain", "resume", "shards"])
+        if mode == "plain":
+            seen = fuzz.run_plain(make_batch_reader, url, cfg)
+        elif mode == "resume":
+            seen = fuzz.run_resume(make_batch_reader, url, cfg, rnd)
+        else:
+            seen = fuzz.run_shards(make_batch_reader, url, cfg, rnd)
+        counts = collections.Counter(seen)
+        assert sorted(counts) == list(range(fuzz.ROWS)), (seed, mode, cfg)
+        assert set(counts.values()) == {epochs}, (seed, mode, cfg)
+
+
 def test_scaling_microbench_smoke(tmp_path):
     """The committed scaling microbench runs end-to-end and reports one JSON
     line per worker count."""

@@ -80,6 +80,66 @@ def run_resume(make_batch_reader, url, cfg, rnd):
     return seen
 
 
+_JAX_READY = False
+
+
+def _ensure_cpu_jax():
+    global _JAX_READY
+    if not _JAX_READY:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # axon sitecustomize override
+        _JAX_READY = True
+
+
+def run_loader(make_batch_reader, url, cfg, rnd):
+    """Random drain point + resume through JaxDataLoader (single CPU device),
+    with random batch size, stack_batches, and HBM shuffle settings.  Honors
+    the `_valid_rows` contract: scalar for plain partial batches, (K,) per
+    step for stacked units."""
+    _ensure_cpu_jax()
+    from petastorm_tpu.jax import JaxDataLoader
+
+    batch = rnd.choice([4, 8, 16])
+    stack = rnd.choice([1, 1, 2, 4])
+    loader_kw = dict(batch_size=batch, drop_last=False, stack_batches=stack)
+    if stack == 1 and rnd.random() < 0.5:
+        # device shuffle is single-batch by contract (the loader refuses the
+        # stack_batches combination with a clear error)
+        loader_kw.update(device_shuffle_capacity=rnd.choice([2, 3]),
+                         device_shuffle_seed=rnd.randint(0, 9))
+    seen = []
+
+    def extend(u):
+        ids = np.asarray(u["id"])
+        if stack > 1:
+            valid = np.asarray(u.get("_valid_rows", [ids.shape[1]] * stack))
+            for k in range(ids.shape[0]):
+                seen.extend(int(v) for v in ids[k][:int(valid[k])])
+        else:
+            n = int(np.asarray(u.get("_valid_rows", ids.shape[0])))
+            seen.extend(int(v) for v in ids[:n])
+
+    with make_batch_reader(url, **cfg) as r:
+        with JaxDataLoader(r, **loader_kw) as loader:
+            it = iter(loader)
+            for _ in range(rnd.randint(0, 6)):
+                try:
+                    u = next(it)
+                except StopIteration:
+                    break
+                extend(u)
+            for u in loader.drain():
+                extend(u)
+            state = loader.state_dict()
+    assert state["reader"]["ordinal_exact"], state
+    with make_batch_reader(url, resume_from=state["reader"], **cfg) as r:
+        with JaxDataLoader(r, **loader_kw) as loader:
+            for u in loader:
+                extend(u)
+    return seen
+
+
 def run_shards(make_batch_reader, url, cfg, rnd):
     union = []
     # one layout for BOTH shards: mixing shard modes across shards is an
@@ -126,7 +186,7 @@ def main():
             shuffle_seed=rnd.randint(0, 999),
             results_queue_size=rnd.choice([2, 10]),
         )
-        mode = rnd.choice(["plain", "resume", "resume", "shards"])
+        mode = rnd.choice(["plain", "resume", "resume", "shards", "loader"])
         try:
             if mode == "plain":
                 seen = run_plain(make_batch_reader, url, cfg)
@@ -134,6 +194,10 @@ def main():
                 if cfg["reader_pool_type"] == "process":
                     cfg["reader_pool_type"] = "thread"  # keep resume fast
                 seen = run_resume(make_batch_reader, url, cfg, rnd)
+            elif mode == "loader":
+                if cfg["reader_pool_type"] == "process":
+                    cfg["reader_pool_type"] = "thread"
+                seen = run_loader(make_batch_reader, url, cfg, rnd)
             else:
                 seen = run_shards(make_batch_reader, url, cfg, rnd)
             counts = collections.Counter(seen)
