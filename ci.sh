@@ -34,6 +34,27 @@ else
     python -m pytest tests/ -q "$@"
 fi
 
+echo "== telemetry smoke (tools/diagnose.py on a synthetic dataset) =="
+# a short telemetered read must render the bottleneck report, name a
+# dominant stage, and export parseable Chrome trace_event JSON
+JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, tempfile
+from petastorm_tpu.tools.diagnose import main
+
+trace_path = os.path.join(tempfile.mkdtemp(), "trace.json")
+rc = main(["--synthetic", "--rows", "60", "--row-group-size", "10",
+           "--trace-out", trace_path])
+assert rc == 0, f"diagnose exited {rc}"
+with open(trace_path) as f:
+    trace = json.load(f)
+spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+assert spans, "trace has no spans"
+for key in ("ts", "dur", "tid", "pid", "name", "cat"):
+    assert key in spans[0], f"span missing {key}"
+assert any(e["name"] == "decode" for e in spans), "no decode spans"
+print(f"telemetry smoke OK ({len(spans)} spans)")
+PY
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

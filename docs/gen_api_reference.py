@@ -77,6 +77,12 @@ API = [
     ("petastorm_tpu.parallel.write", ["distributed_write_dataset"]),
     ("petastorm_tpu.tools.copy_dataset", ["copy_dataset"]),
     ("petastorm_tpu.tools.show_metadata", ["describe"]),
+    ("petastorm_tpu.telemetry", ["Telemetry", "NullTelemetry",
+                                 "MetricsRegistry", "Counter", "Gauge",
+                                 "Histogram", "TraceBuffer", "resolve",
+                                 "enable", "enabled_from_env",
+                                 "render_pipeline_report", "dominant_stage"]),
+    ("petastorm_tpu.tools.diagnose", ["run_diagnosis"]),
 ]
 
 

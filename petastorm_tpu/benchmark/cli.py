@@ -43,6 +43,16 @@ def build_parser() -> argparse.ArgumentParser:
                              " (--method jax only)")
     parser.add_argument("--no-shuffle", action="store_true",
                         help="disable rowgroup shuffling")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="record pipeline telemetry over the reader's"
+                             " whole life (warmup INCLUDED - stage counters"
+                             " will exceed the measured-cycle sample count):"
+                             " metrics ride the JSON output as 'metrics' and"
+                             " the human output appends the pipeline"
+                             " bottleneck report")
+    parser.add_argument("--trace-out", metavar="PATH", default=None,
+                        help="write a Chrome trace_event JSON of the run to"
+                             " PATH (open in Perfetto); implies --telemetry")
     parser.add_argument("--json", action="store_true",
                         help="print one JSON line instead of human-readable text")
     parser.add_argument("--isolated", action="store_true",
@@ -52,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+
+    telemetry = None
+    if args.telemetry or args.trace_out:
+        from petastorm_tpu.telemetry import Telemetry
+        telemetry = Telemetry()
 
     if args.isolated:
         from petastorm_tpu.benchmark.throughput import run_isolated
@@ -69,14 +84,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             shuffle_row_groups=not args.no_shuffle,
             simulated_step_s=args.simulated_step_ms / 1000.0,
             device_decode_fields=args.decode_device,
-            prefetch=args.prefetch)
+            prefetch=args.prefetch, telemetry=telemetry)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(
             args.dataset_url, field_regex=args.field_regex,
             warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
             pool_type=args.pool_type, workers_count=args.workers_count,
-            read_method=args.method, shuffle_row_groups=not args.no_shuffle)
+            read_method=args.method, shuffle_row_groups=not args.no_shuffle,
+            telemetry=telemetry)
+
+    if telemetry is not None and args.trace_out and not args.isolated:
+        telemetry.export_chrome_trace(args.trace_out)
 
     if args.json:
         print(result.to_json())
@@ -88,6 +107,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             line += (f", input stall {result.input_stall_percent:.1f}%"
                      f" (prefetch depth {result.prefetch_depth_avg:.1f})")
         print(line)
+        if result.metrics:
+            # metrics may come from THIS process' recorder or from the
+            # isolated child's JSON snapshot; the report renders either
+            from petastorm_tpu.telemetry import render_pipeline_report
+            print(render_pipeline_report(result.metrics))
+        if args.trace_out:
+            print(f"chrome trace written to {args.trace_out}"
+                  " (load in Perfetto / chrome://tracing)")
     return 0
 
 

@@ -41,6 +41,10 @@ class BenchmarkResult:
     input_stall_percent: "float | None" = None
     #: mean prefetch-queue depth sampled at each batch (capacity = healthy)
     prefetch_depth_avg: "float | None" = None
+    #: telemetry snapshot (petastorm_tpu.telemetry.Telemetry.snapshot()) when
+    #: the run was telemetered - stage busy seconds, queue waits, counters;
+    #: feed it to telemetry.render_pipeline_report() for the bottleneck view
+    metrics: "dict | None" = None
 
     def to_json(self) -> str:
         d = {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
@@ -82,24 +86,29 @@ def reader_throughput(dataset_url: str,
                       read_method: str = "row",
                       shuffle_row_groups: bool = True,
                       transform_spec=None,
-                      storage_options: Optional[dict] = None) -> BenchmarkResult:
+                      storage_options: Optional[dict] = None,
+                      telemetry=None) -> BenchmarkResult:
     """Measure raw reader throughput in samples/sec.
 
     ``read_method='row'`` counts one sample per ``next()`` (make_reader);
     ``'batch'`` iterates make_batch_reader and counts rows per columnar batch.
+    ``telemetry``: optional petastorm_tpu.telemetry recorder; when enabled its
+    snapshot rides back on ``BenchmarkResult.metrics``.
     Reference: ``reader_throughput`` (benchmark/throughput.py:113-174).
     """
     from petastorm_tpu.reader import make_batch_reader, make_reader
+    from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
     if read_method not in ("row", "batch"):
         raise ValueError(f"read_method must be 'row' or 'batch', got {read_method!r}")
     factory = make_reader if read_method == "row" else make_batch_reader
+    tele = _resolve_telemetry(telemetry)
     clock = _CpuClock()
     with factory(dataset_url, schema_fields=list(field_regex) if field_regex else None,
                  reader_pool_type=pool_type, workers_count=workers_count,
                  shuffle_row_groups=shuffle_row_groups, num_epochs=None,
                  transform_spec=transform_spec,
-                 storage_options=storage_options) as reader:
+                 storage_options=storage_options, telemetry=tele) as reader:
         it = iter(reader)
 
         def consume(cycles: int) -> int:
@@ -116,7 +125,8 @@ def reader_throughput(dataset_url: str,
         wall = time.perf_counter() - t0
         cpu = clock.stop()
     return BenchmarkResult(samples_per_sec=samples / wall, wall_s=wall,
-                           samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu)
+                           samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu,
+                           metrics=tele.snapshot() if tele.enabled else None)
 
 
 def jax_loader_throughput(dataset_url: str,
@@ -130,7 +140,8 @@ def jax_loader_throughput(dataset_url: str,
                           storage_options: Optional[dict] = None,
                           simulated_step_s: float = 0.0,
                           device_decode_fields: Sequence[str] = (),
-                          prefetch: int = 2) -> BenchmarkResult:
+                          prefetch: int = 2,
+                          telemetry=None) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
@@ -147,7 +158,9 @@ def jax_loader_throughput(dataset_url: str,
 
     from petastorm_tpu.jax import JaxDataLoader
     from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
+    tele = _resolve_telemetry(telemetry)
     clock = _CpuClock()
     reader = make_batch_reader(
         dataset_url, schema_fields=list(field_regex) if field_regex else None,
@@ -155,7 +168,8 @@ def jax_loader_throughput(dataset_url: str,
         shuffle_row_groups=shuffle_row_groups,
         num_epochs=None, storage_options=storage_options,
         decode_placement=({f: "device" for f in device_decode_fields}
-                          if device_decode_fields else None))
+                          if device_decode_fields else None),
+        telemetry=tele)
     try:
         loader = JaxDataLoader(reader, batch_size=batch_size, prefetch=prefetch)
     except Exception:
@@ -195,7 +209,8 @@ def jax_loader_throughput(dataset_url: str,
     return BenchmarkResult(samples_per_sec=samples / wall, wall_s=wall,
                            samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu,
                            input_stall_percent=100.0 * wait_s / wall,
-                           prefetch_depth_avg=depth_sum / max(depth_n, 1))
+                           prefetch_depth_avg=depth_sum / max(depth_n, 1),
+                           metrics=tele.snapshot() if tele.enabled else None)
 
 
 def run_isolated(cli_args: List[str]) -> BenchmarkResult:

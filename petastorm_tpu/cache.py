@@ -20,6 +20,8 @@ import tempfile
 from abc import ABC, abstractmethod
 from typing import Any, Callable
 
+from petastorm_tpu.telemetry import resolve as _resolve_telemetry
+
 logger = logging.getLogger(__name__)
 
 _MISSING = object()  # sentinel: cache miss vs a legitimately-None entry
@@ -34,6 +36,24 @@ class CacheBase(ABC):
         """Release the cache's resources (files, memory); the cache is
         unusable afterwards.  No-op by default."""
         pass
+
+    def _record_lookup(self, hit: bool) -> None:
+        """Count a get() as cache.hits / cache.misses (no-op recorder by
+        default; see petastorm_tpu.telemetry)."""
+        tele = getattr(self, "_telemetry", None)
+        if tele is not None and tele.enabled:
+            tele.counter("cache.hits" if hit else "cache.misses").add(1)
+
+    def __getstate__(self):
+        # a live Telemetry is not picklable (locks, trace buffer); the
+        # process-pool worker's copy re-resolves from its own env
+        state = dict(self.__dict__)
+        state.pop("_telemetry", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._telemetry = _resolve_telemetry(None)
 
 
 class NullCache(CacheBase):
@@ -53,13 +73,14 @@ class InMemoryCache(CacheBase):
     available, else ``sys.getsizeof``.
     """
 
-    def __init__(self, size_limit_bytes: int = 4 * 2 ** 30):
+    def __init__(self, size_limit_bytes: int = 4 * 2 ** 30, telemetry=None):
         from collections import OrderedDict as _OD
 
         self._entries: "_OD[str, Any]" = _OD()
         self._sizes: dict = {}
         self._size_limit = size_limit_bytes
         self._total = 0
+        self._telemetry = _resolve_telemetry(telemetry)
         import threading
 
         self._lock = threading.Lock()
@@ -124,6 +145,7 @@ class InMemoryCache(CacheBase):
             entry = self._entries.get(key, _MISSING)
             if entry is not _MISSING:
                 self._entries.move_to_end(key)
+        self._record_lookup(entry is not _MISSING)
         if entry is not _MISSING:
             return self._copy_value(entry)
         value = fill_cache_func()
@@ -157,9 +179,11 @@ class LocalDiskCache(CacheBase):
     eviction sweep is best-effort.
     """
 
-    def __init__(self, path: str, size_limit_bytes: int = 10 * 2 ** 30):
+    def __init__(self, path: str, size_limit_bytes: int = 10 * 2 ** 30,
+                 telemetry=None):
         self._dir = path
         self._size_limit = size_limit_bytes
+        self._telemetry = _resolve_telemetry(telemetry)
         os.makedirs(path, exist_ok=True)
 
     def _entry_path(self, key: str) -> str:
@@ -171,6 +195,7 @@ class LocalDiskCache(CacheBase):
             with open(path, "rb") as f:
                 value = pickle.load(f)
             os.utime(path)  # LRU touch
+            self._record_lookup(True)
             return value
         except FileNotFoundError:
             pass
@@ -180,6 +205,7 @@ class LocalDiskCache(CacheBase):
                 os.remove(path)
             except OSError:
                 pass
+        self._record_lookup(False)
         value = fill_cache_func()
         tmp_fd, tmp_path = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
         try:
@@ -225,15 +251,18 @@ class LocalDiskCache(CacheBase):
 
 
 def make_cache(cache_type: str = "null", cache_location: str = None,
-               cache_size_limit: int = None) -> CacheBase:
+               cache_size_limit: int = None, telemetry=None) -> CacheBase:
     """'null' | 'local-disk' | 'memory' (reference: reader.py:126-131; 'memory'
-    is new here - decoded-batch LRU in host RAM)."""
+    is new here - decoded-batch LRU in host RAM).  ``telemetry``: optional
+    petastorm_tpu.telemetry recorder for cache.hits / cache.misses counters."""
     if cache_type in (None, "null", "none"):
         return NullCache()
     if cache_type == "local-disk":
         if not cache_location:
             cache_location = os.path.join(tempfile.gettempdir(), "petastorm_tpu_cache")
-        return LocalDiskCache(cache_location, cache_size_limit or 10 * 2 ** 30)
+        return LocalDiskCache(cache_location, cache_size_limit or 10 * 2 ** 30,
+                              telemetry=telemetry)
     if cache_type == "memory":
-        return InMemoryCache(cache_size_limit or 4 * 2 ** 30)
+        return InMemoryCache(cache_size_limit or 4 * 2 ** 30,
+                             telemetry=telemetry)
     raise ValueError(f"Unknown cache_type {cache_type!r}")

@@ -52,6 +52,8 @@ from petastorm_tpu.native.image import COEF_COLUMN_SEP as _COEF_SEP
 from petastorm_tpu.parallel.mesh import local_data_slice
 from petastorm_tpu.shuffle import (NoopShufflingBuffer, RandomShufflingBuffer,
                                    iter_batched)
+from petastorm_tpu.telemetry import NULL_CONTEXT as _NULL_CONTEXT
+from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -118,8 +120,21 @@ class JaxDataLoader:
                  device_shuffle_capacity: int = 0,
                  device_shuffle_seed: Optional[int] = None,
                  valid_mask_field: Optional[str] = None,
-                 stack_batches: int = 1):
+                 stack_batches: int = 1,
+                 telemetry=None):
         self._reader = reader
+        #: pipeline telemetry (petastorm_tpu.telemetry): defaults to the
+        #: reader's recorder so one object observes reader -> pool -> loader;
+        #: no-op unless enabled.  Loader stages: 'host-assemble' (per raw
+        #: reader batch: field selection, pad-to-bucket), 'host-prep' (per
+        #: delivered batch: transform_fn, row padding, mask) and
+        #: 'device-transfer' (make_array / device_put / commit).
+        self._telemetry = _resolve_telemetry(
+            telemetry if telemetry is not None
+            else getattr(reader, "telemetry", None))
+        self._m_consumer_wait = self._telemetry.counter(
+            "loader.consumer_wait_s")
+        self._m_delivered = self._telemetry.counter("loader.batches_delivered")
         self._mesh = mesh
         self._specs = shardings
         #: K > 1 = scan-feed delivery: each delivered unit stacks K
@@ -477,12 +492,20 @@ class JaxDataLoader:
         """Stage 1: reader batches -> host-assembled local batches."""
         try:
             local_bs = self._local_rows
+            tele = self._telemetry
 
             def prepared():
                 for raw in self._reader.iter_batches():
                     if self._stop_event.is_set():
                         return
-                    yield self._prepare(raw)
+                    # 'host-assemble' (per RAW reader batch: pad-to-bucket,
+                    # field selection) is a distinct stage from 'host-prep'
+                    # (per DELIVERED batch in _emit) - one shared name would
+                    # mix two granularities and corrupt count/mean/p50
+                    with (tele.stage("host-assemble", rows=raw.num_rows)
+                          if tele.enabled else _NULL_CONTEXT):
+                        out = self._prepare(raw)
+                    yield out
 
             for out in iter_batched(prepared(), self._make_buffer(), local_bs):
                 if self._stop_event.is_set():
@@ -594,40 +617,47 @@ class JaxDataLoader:
         return cols, valid_rows
 
     def _emit(self, host_batch: ColumnBatch) -> None:
-        cols, valid_rows = self._prep_cols(
-            host_batch,
-            pad_to=self._local_rows if self._mesh is not None else None)
-        device_batch = {}
-        for name in self._device_decode:
-            if name in self._fields:
-                decode = (self._decode_mixed_on_device
-                          if name in self._mixed_decode
-                          else self._decode_on_device)
-                device_batch[name] = decode(name, host_batch.columns)
-        if self._valid_mask is not None:
-            mask = np.zeros(self._local_rows, np.float32)
-            mask[:valid_rows] = 1.0
-            cols[self._valid_mask] = mask
-        staged: Dict[str, np.ndarray] = {}
-        for name, col in cols.items():
-            arr = np.ascontiguousarray(col)
-            feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
-            if arr.dtype != feed_dtype:
-                arr = arr.astype(feed_dtype)
-            self._emitted_layout[name] = (arr.shape[1:], arr.dtype)
-            if self._mesh is not None:
-                sharding, sl, global_shape = self._placement_for(name, arr.shape[1:])
-                arr = arr[(slice(None),) + sl[1:]]  # sequence/model-axis slice
-                device_batch[name] = jax.make_array_from_process_local_data(
-                    sharding, arr, global_shape)
-            else:
-                staged[name] = arr
-        if staged:
-            # ONE device_put for all fields: each call pays a fixed dispatch
-            # cost (an RPC on tunneled TPU runtimes), so a small label column
-            # must not cost as much as the image column it rides with
-            device_batch.update(jax.device_put(staged))
-        self._commit(device_batch)
+        tele = self._telemetry
+        traced = tele.enabled
+        with (tele.stage("host-prep", rows=host_batch.num_rows)
+              if traced else _NULL_CONTEXT):
+            cols, valid_rows = self._prep_cols(
+                host_batch,
+                pad_to=self._local_rows if self._mesh is not None else None)
+            if self._valid_mask is not None:
+                mask = np.zeros(self._local_rows, np.float32)
+                mask[:valid_rows] = 1.0
+                cols[self._valid_mask] = mask
+        transfer_stage = (tele.stage("device-transfer", rows=valid_rows)
+                          if traced else _NULL_CONTEXT)
+        with transfer_stage:
+            device_batch = {}
+            for name in self._device_decode:
+                if name in self._fields:
+                    decode = (self._decode_mixed_on_device
+                              if name in self._mixed_decode
+                              else self._decode_on_device)
+                    device_batch[name] = decode(name, host_batch.columns)
+            staged: Dict[str, np.ndarray] = {}
+            for name, col in cols.items():
+                arr = np.ascontiguousarray(col)
+                feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
+                if arr.dtype != feed_dtype:
+                    arr = arr.astype(feed_dtype)
+                self._emitted_layout[name] = (arr.shape[1:], arr.dtype)
+                if self._mesh is not None:
+                    sharding, sl, global_shape = self._placement_for(name, arr.shape[1:])
+                    arr = arr[(slice(None),) + sl[1:]]  # sequence/model-axis slice
+                    device_batch[name] = jax.make_array_from_process_local_data(
+                        sharding, arr, global_shape)
+                else:
+                    staged[name] = arr
+            if staged:
+                # ONE device_put for all fields: each call pays a fixed dispatch
+                # cost (an RPC on tunneled TPU runtimes), so a small label column
+                # must not cost as much as the image column it rides with
+                device_batch.update(jax.device_put(staged))
+            self._commit(device_batch)
         for name in self._host_fields:
             device_batch[name] = host_batch.columns[name]
         if self._mesh is not None and valid_rows < self._local_rows:
@@ -660,52 +690,60 @@ class JaxDataLoader:
         """
         K, local = self._stack, self._local_rows
         real_steps = len(group)
+        tele = self._telemetry
+        traced = tele.enabled
         prepped, valids = [], []
-        for hb in group:
-            # pad even without a mesh: the (K, B, ...) stack needs one
-            # static per-step shape
-            cols, valid = self._prep_cols(hb, pad_to=local)
-            prepped.append(cols)
-            valids.append(valid)
+        with (tele.stage("host-prep", steps=real_steps)
+              if traced else _NULL_CONTEXT):
+            for hb in group:
+                # pad even without a mesh: the (K, B, ...) stack needs one
+                # static per-step shape
+                cols, valid = self._prep_cols(hb, pad_to=local)
+                prepped.append(cols)
+                valids.append(valid)
 
-        device_batch = {}
-        for name in self._device_decode:
-            if name in self._fields:
-                decode = (self._decode_mixed_stack
-                          if name in self._mixed_decode else self._decode_stack)
-                device_batch[name] = decode(name, group)
+        transfer_stage = (tele.stage("device-transfer", steps=real_steps)
+                          if traced else _NULL_CONTEXT)
+        with transfer_stage:
+            device_batch = {}
+            for name in self._device_decode:
+                if name in self._fields:
+                    decode = (self._decode_mixed_stack
+                              if name in self._mixed_decode
+                              else self._decode_stack)
+                    device_batch[name] = decode(name, group)
 
-        staged: Dict[str, np.ndarray] = {}
-        for name in (list(prepped[0]) if prepped else []):
-            steps = [np.ascontiguousarray(p[name]) for p in prepped]
-            steps += [np.zeros_like(steps[-1])] * (K - real_steps)
-            arr = np.stack(steps)                      # (K, local, *trailing)
-            feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
-            if arr.dtype != feed_dtype:
-                arr = arr.astype(feed_dtype)
-            self._emitted_layout[name] = (arr.shape[2:], arr.dtype)
-            if self._mesh is not None:
-                sharding, sl, global_shape = self._placement_for(
-                    name, arr.shape[2:])
-                arr = arr[(slice(None), slice(None)) + sl[2:]]
+            staged: Dict[str, np.ndarray] = {}
+            for name in (list(prepped[0]) if prepped else []):
+                steps = [np.ascontiguousarray(p[name]) for p in prepped]
+                steps += [np.zeros_like(steps[-1])] * (K - real_steps)
+                arr = np.stack(steps)                      # (K, local, *trailing)
+                feed_dtype = jax_feed_dtype(arr.dtype, keep_wide=self._keep_wide)
+                if arr.dtype != feed_dtype:
+                    arr = arr.astype(feed_dtype)
+                self._emitted_layout[name] = (arr.shape[2:], arr.dtype)
+                if self._mesh is not None:
+                    sharding, sl, global_shape = self._placement_for(
+                        name, arr.shape[2:])
+                    arr = arr[(slice(None), slice(None)) + sl[2:]]
+                    device_batch[name] = jax.make_array_from_process_local_data(
+                        sharding, arr, global_shape)
+                else:
+                    staged[name] = arr
+            if self._valid_mask is not None:
+                mask = np.zeros((K, local), np.float32)
+                for k, v in enumerate(valids):
+                    mask[k, :v] = 1.0
+                name = self._valid_mask
+                self._emitted_layout[name] = ((), np.dtype(np.float32))
+                sharding, _, global_shape = self._placement_for(name, ())
                 device_batch[name] = jax.make_array_from_process_local_data(
-                    sharding, arr, global_shape)
-            else:
-                staged[name] = arr
-        if self._valid_mask is not None:
-            mask = np.zeros((K, local), np.float32)
-            for k, v in enumerate(valids):
-                mask[k, :v] = 1.0
-            name = self._valid_mask
-            self._emitted_layout[name] = ((), np.dtype(np.float32))
-            sharding, _, global_shape = self._placement_for(name, ())
-            device_batch[name] = jax.make_array_from_process_local_data(
-                sharding, mask, global_shape)
-        if staged:
-            # ONE device_put for the whole stack: K steps of data ride a
-            # single fixed-cost dispatch instead of K (the whole point)
-            device_batch.update(jax.device_put(staged))
-        self._commit(device_batch)
+                    sharding, mask, global_shape)
+            if staged:
+                # ONE device_put for the whole stack: K steps of data ride a
+                # single fixed-cost dispatch instead of K (the whole point)
+                device_batch.update(jax.device_put(staged))
+            self._commit(device_batch)
         for name in self._host_fields:
             steps = [_pad_host_col(hb.columns[name], local) for hb in group]
             steps += [_host_filler(steps[-1])] * (K - real_steps)
@@ -1113,6 +1151,12 @@ class JaxDataLoader:
     # -- consumer -------------------------------------------------------------
 
     @property
+    def telemetry(self):
+        """The pipeline telemetry recorder this loader records into (the
+        reader's by default; petastorm_tpu.telemetry)."""
+        return self._telemetry
+
+    @property
     def diagnostics(self) -> Dict:
         """Per-stage queue depths + reader diagnostics (SURVEY.md section 5:
         the TPU build's observability story).  ``prefetch_depth`` near
@@ -1171,7 +1215,12 @@ class JaxDataLoader:
         while True:
             try:
                 value = self._out.get(timeout=_QUEUE_POLL_S)
-                self._consumer_wait_s += time.perf_counter() - wait_start
+                waited = time.perf_counter() - wait_start
+                self._consumer_wait_s += waited
+                if self._telemetry.enabled:
+                    self._m_consumer_wait.add(waited)
+                    self._telemetry.gauge("loader.prefetch_depth").set(
+                        self._out.qsize())
                 break
             except queue.Empty:
                 if self._stop_event.is_set():
@@ -1199,6 +1248,7 @@ class JaxDataLoader:
             self._stop_trace()
             raise value.exc
         self._delivered_batches += 1
+        self._m_delivered.add(1)
         return value
 
     # -- checkpoint/resume (reference gap: SURVEY.md section 5) ---------------
@@ -1480,6 +1530,10 @@ def make_jax_loader(dataset_url: str,
     loader_params = set(inspect.signature(JaxDataLoader.__init__).parameters) - {
         "self", "reader", "batch_size", "mesh", "shardings"}
     loader_kwargs = {k: kwargs.pop(k) for k in list(kwargs) if k in loader_params}
+    if "telemetry" in loader_kwargs:
+        # one recorder observes the whole pipeline: the reader gets it too
+        # (the loader would otherwise inherit the reader's default recorder)
+        kwargs["telemetry"] = loader_kwargs["telemetry"]
     if "schema_fields" not in kwargs:
         # don't read+decode columns the loader would only throw away
         wanted = list(loader_kwargs.get("fields") or [])

@@ -25,6 +25,7 @@ Design differences (TPU-first):
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -33,11 +34,25 @@ from abc import ABC, abstractmethod
 from typing import Any, Callable, Iterable, Optional
 
 from petastorm_tpu.errors import PetastormTpuError, ReaderClosedError
+from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
 
 _POLL_S = 0.05
 DEFAULT_RESULTS_QUEUE_SIZE = 50  # reference: reader.py:61
+
+
+def _env_seconds(name: str, default: float) -> float:
+    """Float env var with a logged fallback (shared with reader.py)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring non-numeric %s=%r (using %.0f)",
+                       name, raw, default)
+        return default
 
 
 class WorkerError(PetastormTpuError):
@@ -88,10 +103,17 @@ class ExecutorBase(ABC):
     """start -> (put*/get*) -> stop -> join lifecycle, mirroring the reference pool
     protocol (start/ventilate/get_results/stop/join)."""
 
-    def __init__(self):
+    def __init__(self, telemetry=None):
         self._stopped = False
         self._ventilated = 0
         self._consumed = 0
+        #: petastorm_tpu.telemetry recorder (no-op unless enabled); executors
+        #: record queue-full wait time - the signal that tells the pipeline
+        #: report whether backpressure points upstream or downstream
+        self._telemetry = _resolve_telemetry(telemetry)
+        self._m_input_full = self._telemetry.counter("queue.input_full_wait_s")
+        self._m_results_full = self._telemetry.counter(
+            "queue.results_full_wait_s")
 
     @abstractmethod
     def start(self, worker_factory: WorkerFactory) -> None:
@@ -134,27 +156,65 @@ class SerialExecutor(ExecutorBase):
     dummy_pool.py:20-91) - for tests, profiling, and debugging.
 
     The input queue is bounded so a Ventilator with ``num_epochs=None`` cannot
-    enqueue unboundedly ahead of the consumer."""
+    enqueue unboundedly ahead of the consumer.
 
-    def __init__(self, in_queue_size: int = 32):
-        super().__init__()
+    Stall detection: work happens synchronously inside ``get``, so the
+    reader-side stall loop (which only runs between ``get`` calls) can never
+    observe a work item wedged inside user code.  ONE long-lived daemon
+    watchdog thread (started lazily on the first ``get``) therefore polls a
+    heartbeat slot: if ``fn(item)`` runs longer than
+    ``PETASTORM_TPU_STALL_WARN_S`` a WARNING names the item (once per item).
+    ``PETASTORM_TPU_STALL_ABORT_S`` remains inoperative here - synchronous
+    user code cannot be safely interrupted from another thread; use the
+    thread or process pool when abort matters (docs/operations.md).
+    """
+
+    def __init__(self, in_queue_size: int = 32, telemetry=None):
+        super().__init__(telemetry=telemetry)
         self._items: "queue.Queue[Any]" = queue.Queue(maxsize=in_queue_size)
         self._fn: Optional[Callable] = None
+        self._stall_warn_s = _env_seconds("PETASTORM_TPU_STALL_WARN_S", 120.0)
+        # heartbeat slot for the watchdog (single writer: the get() caller;
+        # same write-order contract as the thread pool's worker_state)
+        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_item: Any = None     # None = no item in flight
+        self._watch_since = 0.0
+        self._watch_gen = 0              # one warning per item, not per poll
 
     def start(self, worker_factory: WorkerFactory) -> None:
         self._fn = worker_factory()
 
     def put(self, item: Any, cancel_event=None) -> None:
+        t0 = time.perf_counter() if self._telemetry.enabled else None
         while not self._stopped:
             try:
                 self._items.put(item, timeout=_POLL_S)
                 self._ventilated += 1
+                if t0 is not None:
+                    self._m_input_full.add(time.perf_counter() - t0)
                 return
             except queue.Full:
                 if cancel_event is not None and cancel_event.is_set():
                     raise VentilationCancelled()
                 continue
         raise ReaderClosedError("Executor is stopped")
+
+    def _watch_loop(self) -> None:
+        warned_gen = -1
+        poll_s = min(max(self._stall_warn_s / 4.0, 0.05), 5.0)
+        while not self._stopped:
+            time.sleep(poll_s)
+            item = self._watch_item
+            if item is None:
+                continue
+            gen, elapsed = self._watch_gen, time.monotonic() - self._watch_since
+            if elapsed > self._stall_warn_s and gen != warned_gen:
+                warned_gen = gen
+                logger.warning(
+                    "Serial executor work item %s has run for %.0fs inside its"
+                    " worker function (PETASTORM_TPU_STALL_WARN_S=%.0f);"
+                    " pipeline state: %s", getattr(item, "ordinal", "?"),
+                    elapsed, self._stall_warn_s, self.diagnostics)
 
     def get(self, timeout: Optional[float] = None) -> Any:
         if self._fn is None:
@@ -164,7 +224,21 @@ class SerialExecutor(ExecutorBase):
         except queue.Empty:
             raise queue.Empty("No ventilated items to process")
         self._consumed += 1
-        return self._fn(item)
+        if self._stall_warn_s > 0:
+            if self._watch_thread is None:
+                self._watch_thread = threading.Thread(
+                    target=self._watch_loop, daemon=True,
+                    name="petastorm-tpu-serial-watchdog")
+                self._watch_thread.start()
+            # timestamp and generation BEFORE the item (the watchdog guards
+            # on item, so a non-None read sees current since/gen)
+            self._watch_since = time.monotonic()
+            self._watch_gen += 1
+            self._watch_item = item
+        try:
+            return self._fn(item)
+        finally:
+            self._watch_item = None
 
     def stop(self) -> None:
         self._stopped = True
@@ -188,8 +262,9 @@ class ThreadedExecutor(ExecutorBase):
     def __init__(self, workers_count: int = 3,
                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
                  in_queue_size: Optional[int] = None,
-                 profiling_enabled: bool = False):
-        super().__init__()
+                 profiling_enabled: bool = False,
+                 telemetry=None):
+        super().__init__(telemetry=telemetry)
         self._workers_count = workers_count
         # Queue choice is correctness-driven (hang post-mortem, RESULTS.md):
         # CPython's SimpleQueue.get(timeout) WEDGES under multiple
@@ -286,18 +361,28 @@ class ThreadedExecutor(ExecutorBase):
     def _put_result_stop_aware(self, value: Any) -> None:
         # reference _stop_aware_put (thread_pool.py:200-214): bound via the
         # slot semaphore, never block indefinitely across a stop
+        t0 = time.perf_counter() if self._telemetry.enabled else None
         while not self._stop_event.is_set():
             if self._out_slots.acquire(timeout=_POLL_S):
                 self._out_queue.put(value)
+                if t0 is not None:
+                    # time this worker spent blocked on a full results queue:
+                    # sustained values mean the CONSUMER is the bottleneck
+                    self._m_results_full.add(time.perf_counter() - t0)
                 return
 
     def put(self, item: Any, cancel_event=None) -> None:
         if self._stopped:
             raise ReaderClosedError("Executor is stopped")
+        t0 = time.perf_counter() if self._telemetry.enabled else None
         while not self._stop_event.is_set():
             if self._in_slots.acquire(timeout=_POLL_S):
                 self._in_queue.put(item)
                 self._ventilated += 1
+                if t0 is not None:
+                    # time the ventilator spent blocked on a full input queue:
+                    # the worker plane is saturated (healthy backpressure)
+                    self._m_input_full.add(time.perf_counter() - t0)
                 return
             if cancel_event is not None and cancel_event.is_set():
                 # caller withdrew the put while the queue was full (quiesce
@@ -314,6 +399,9 @@ class ThreadedExecutor(ExecutorBase):
             self.stop()
             raise WorkerError(f"Worker failed:\n{result.formatted}")
         self._consumed += 1
+        if self._telemetry.enabled:
+            self._telemetry.gauge("pool.results_queue_depth").set(
+                self._out_queue.qsize())
         return result
 
     def stop(self) -> None:
@@ -393,6 +481,13 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
     contract as ThreadedExecutor's ``workers_busy``, crossing the process
     boundary via shared memory.  Wall clock (time.time), not monotonic:
     monotonic clocks are not comparable across processes on all platforms.
+    Reads of the PAIR can tear: each 8-byte slot is individually atomic and
+    the write order (timestamp before ordinal) prevents the harmful pairing
+    of a NEW item with an OLD idle-since time, but a diagnostics read landing
+    between the two stores may still pair the new timestamp with the
+    previous ordinal (or an idle marker) for one sample — diagnostics
+    consumers must treat a single odd ``workers_busy`` entry as noise, not
+    evidence.
     """
     try:
         fn = worker_factory()
@@ -447,8 +542,13 @@ class _ProcessExecutor(ExecutorBase):
                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
                  in_queue_size: Optional[int] = None,
                  use_shm: Optional[bool] = None,
-                 shm_size_bytes: int = DEFAULT_SHM_BYTES):
-        super().__init__()
+                 shm_size_bytes: int = DEFAULT_SHM_BYTES,
+                 telemetry=None):
+        # telemetry: the PARENT process records ventilation/queue waits;
+        # worker-side stage metrics recorded in the spawned processes stay
+        # there (PETASTORM_TPU_TELEMETRY is inherited, so each child records
+        # independently) - thread pool gives one merged report
+        super().__init__(telemetry=telemetry)
         import multiprocessing as mp
 
         self._ctx = mp.get_context("spawn")
@@ -491,10 +591,13 @@ class _ProcessExecutor(ExecutorBase):
     def put(self, item: Any, cancel_event=None) -> None:
         if self._stopped:
             raise ReaderClosedError("Executor is stopped")
+        t0 = time.perf_counter() if self._telemetry.enabled else None
         while True:
             try:
                 self._in_queue.put(item, timeout=_POLL_S)
                 self._ventilated += 1
+                if t0 is not None:
+                    self._m_input_full.add(time.perf_counter() - t0)
                 return
             except queue.Full:
                 if self._stopped:
@@ -573,14 +676,17 @@ class _ProcessExecutor(ExecutorBase):
 
 
 def make_executor(kind: str = "thread", workers_count: int = 3,
-                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE) -> ExecutorBase:
+                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
+                  telemetry=None) -> ExecutorBase:
     """'thread' | 'process' | 'serial' (reference: reader_pool_type, reader.py:139-150)."""
     if kind == "thread":
-        return ThreadedExecutor(workers_count, results_queue_size)
+        return ThreadedExecutor(workers_count, results_queue_size,
+                                telemetry=telemetry)
     if kind == "process":
-        return _ProcessExecutor(workers_count, results_queue_size)
+        return _ProcessExecutor(workers_count, results_queue_size,
+                                telemetry=telemetry)
     if kind in ("serial", "dummy"):
-        return SerialExecutor()
+        return SerialExecutor(telemetry=telemetry)
     raise PetastormTpuError(f"Unknown executor kind {kind!r}")
 
 
@@ -593,7 +699,7 @@ class Ventilator:
     """
 
     def __init__(self, executor: ExecutorBase, plan, num_epochs: Optional[int] = 1,
-                 start_item: int = 0):
+                 start_item: int = 0, telemetry=None):
         if num_epochs is not None and num_epochs < 1:
             raise PetastormTpuError("num_epochs must be >= 1 or None (infinite)")
         if start_item < 0:
@@ -602,6 +708,7 @@ class Ventilator:
         self._plan = plan
         self._num_epochs = num_epochs
         self._start_item = start_item
+        self._telemetry = _resolve_telemetry(telemetry)
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.items_per_epoch = len(plan.epoch_items(0))
@@ -635,12 +742,33 @@ class Ventilator:
         while not self._stop_event.is_set():
             if self._num_epochs is not None and epoch >= self._num_epochs:
                 return
+            tele = self._telemetry
+            # same counter object the executor's put updates (same registry
+            # name), and put runs in THIS thread - so the delta across one
+            # put is exactly that put's queue-full wait
+            m_blocked = tele.counter("queue.input_full_wait_s")
             for item in self._plan.epoch_items(epoch)[offset:]:
                 if self._stop_event.is_set():
                     return
                 try:
-                    self._executor.put(VentilatedItem(ordinal, item),
-                                       cancel_event=self._stop_event)
+                    if tele.enabled:
+                        # ventilate busy time must EXCLUDE time blocked on a
+                        # full input queue (tracked by the executor as
+                        # queue.input_full_wait_s), or a consumer-bound
+                        # pipeline would crown 'ventilate' the dominant stage
+                        # for doing nothing but waiting
+                        t0 = time.perf_counter_ns()
+                        blocked0 = m_blocked.value
+                        self._executor.put(VentilatedItem(ordinal, item),
+                                           cancel_event=self._stop_event)
+                        dur_ns = time.perf_counter_ns() - t0
+                        blocked_ns = int((m_blocked.value - blocked0) * 1e9)
+                        tele.record_stage("ventilate", t0,
+                                          max(dur_ns - blocked_ns, 0),
+                                          {"ordinal": ordinal})
+                    else:
+                        self._executor.put(VentilatedItem(ordinal, item),
+                                           cancel_event=self._stop_event)
                 except (ReaderClosedError, VentilationCancelled):
                     return
                 ordinal += 1

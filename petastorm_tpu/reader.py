@@ -28,6 +28,7 @@ Design differences (TPU-first):
 
 from __future__ import annotations
 
+import inspect
 import logging
 import os
 import queue
@@ -45,8 +46,10 @@ from petastorm_tpu.etl.indexing import get_row_group_indexes
 from petastorm_tpu.etl.metadata import open_dataset
 from petastorm_tpu.fs import FilesystemFactory
 from petastorm_tpu.plan import ElasticResumePlan, ReadPlan, elastic_resume_plan
-from petastorm_tpu.pool import Ventilator, WorkerError, make_executor
+from petastorm_tpu.pool import (Ventilator, WorkerError, _env_seconds,
+                                make_executor)
 from petastorm_tpu.schema import Schema
+from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 from petastorm_tpu.transform import TransformSpec, transform_schema
 from petastorm_tpu.worker import RowGroupDecoderWorker
 
@@ -55,18 +58,6 @@ logger = logging.getLogger(__name__)
 _GET_TIMEOUT_S = 0.5
 _DEFAULT_RESULTS_QUEUE_BATCHES = 10  # batches are whole rowgroups; keep RAM bounded
 # stall detection (see Reader._next_batch)
-
-
-def _env_seconds(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        logger.warning("Ignoring non-numeric %s=%r (using %.0f)",
-                       name, raw, default)
-        return default
 
 
 # defaults; re-read from the environment at every Reader construction so
@@ -99,7 +90,8 @@ def make_reader(dataset_url: str,
                 verify_checksums: bool = False,
                 decode_placement: Optional[Dict[str, str]] = None,
                 ngram=None,
-                io_retries="auto") -> "Reader":
+                io_retries="auto",
+                telemetry=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
     Reference: ``make_reader`` (reader.py:59-176).  Yields one namedtuple row per
@@ -119,6 +111,13 @@ def make_reader(dataset_url: str,
     ``'auto'`` = bounded retry-with-backoff on remote filesystems (GCS/S3/
     HDFS/fsspec), off for local paths; an int sets the attempt budget; a
     ``RetryPolicy`` customizes backoff; ``None`` disables.
+
+    ``telemetry``: pipeline observability (petastorm_tpu.telemetry).  The
+    default is a zero-cost no-op recorder; pass a ``telemetry.Telemetry``
+    (or ``True``) to record stage spans, queue waits and counters across the
+    whole pipeline, or set ``PETASTORM_TPU_TELEMETRY=1`` to enable the
+    process-wide recorder without touching code.  The resolved recorder is
+    exposed as ``Reader.telemetry`` (``reader.telemetry.pipeline_report()``).
     """
     return _make_reader_impl(dataset_url, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -130,7 +129,7 @@ def make_reader(dataset_url: str,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
-                             io_retries=io_retries)
+                             io_retries=io_retries, telemetry=telemetry)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -182,12 +181,14 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
                       ngram=None,
-                      io_retries="auto") -> "Reader":
+                      io_retries="auto",
+                      telemetry=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
 
     Reference: ``make_batch_reader`` (reader.py:179-290).  Yields one namedtuple of
-    column arrays per decoded rowgroup.  ``io_retries``: see ``make_reader``.
+    column arrays per decoded rowgroup.  ``io_retries``/``telemetry``: see
+    ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -199,7 +200,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
-                             io_retries=io_retries)
+                             io_retries=io_retries, telemetry=telemetry)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -212,7 +213,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       resume_from: Optional[dict] = None, ngram=None,
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
-                      io_retries="auto") -> "Reader":
+                      io_retries="auto", telemetry=None) -> "Reader":
+    telemetry = _resolve_telemetry(telemetry)
     if ngram is not None and batched_output:
         raise PetastormTpuError(
             "NGram is not supported by make_batch_reader (reference parity,"
@@ -315,7 +317,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                         shuffle_row_drop_partitions=shuffle_row_drop_partitions,
                         shard_mode=shard_mode)
 
-    cache = make_cache(cache_type, cache_location, cache_size_limit)
+    cache = make_cache(cache_type, cache_location, cache_size_limit,
+                       telemetry=telemetry)
     # cache+predicate is disallowed (reference py_dict_reader_worker.py:145-150);
     # cache+row-drop is fine here because cache keys include the row slice
     if cache_type not in (None, "null", "none") and worker_predicate is not None:
@@ -338,7 +341,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    raw_fields=device_fields,
                                    mixed_raw_fields=mixed_fields,
                                    retry_policy=resolve_retry_policy(
-                                       io_retries, info.filesystem))
+                                       io_retries, info.filesystem),
+                                   telemetry=telemetry)
 
     if workers_count == "auto":
         # size to the usable cores (cgroup/affinity-aware), one left for the
@@ -348,7 +352,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         except AttributeError:
             cores = os.cpu_count() or 1
         workers_count = max(1, min(10, cores - 1))
-    executor = make_executor(reader_pool_type, workers_count, results_queue_size)
+    executor = make_executor(reader_pool_type, workers_count,
+                             results_queue_size, telemetry=telemetry)
     start_item = 0
     if resume_from is not None and "elastic" not in resume_from:
         if "elastic_rebased" in resume_from:
@@ -368,7 +373,7 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             start_item = int(resume_from.get("position", 0))
     reader = Reader(info=info, schema=output_schema, plan=plan, executor=executor,
                     worker=worker, num_epochs=num_epochs, batched_output=batched_output,
-                    start_item=start_item, ngram=ngram)
+                    start_item=start_item, ngram=ngram, telemetry=telemetry)
     #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
     reader.device_decode_fields = device_fields
     #: subset using the mixed-geometry object wire format ('device-mixed')
@@ -469,7 +474,15 @@ class Reader:
 
     def __init__(self, info, schema: Schema, plan: ReadPlan, executor, worker,
                  num_epochs: Optional[int], batched_output: bool,
-                 start_item: int = 0, ngram=None):
+                 start_item: int = 0, ngram=None, telemetry=None):
+        #: petastorm_tpu.telemetry recorder shared by the whole pipeline
+        #: (no-op unless enabled); ``reader.telemetry.pipeline_report()``
+        #: renders the stage-utilization bottleneck summary
+        self.telemetry = _resolve_telemetry(telemetry)
+        self._m_results_empty = self.telemetry.counter(
+            "queue.results_empty_wait_s")
+        self._m_rows_emitted = self.telemetry.counter("reader.rows_emitted")
+        self._m_batches = self.telemetry.counter("reader.batches_consumed")
         self.dataset_info = info
         self.schema = schema
         self.batched_output = batched_output
@@ -515,7 +528,8 @@ class Reader:
 
         self._executor.start(worker)
         self._ventilator = Ventilator(executor, plan, num_epochs,
-                                      start_item=start_item)
+                                      start_item=start_item,
+                                      telemetry=self.telemetry)
         self._expected_items = self._ventilator.total_items
         self._ventilator.start()
 
@@ -602,15 +616,21 @@ class Reader:
         """
         last_progress = time.monotonic()
         warned_at = 0.0
+        tele = self.telemetry
         while True:
             if self._stopped:
                 raise ReaderClosedError("Reader was stopped mid-iteration")
             if self._all_items_consumed():
                 self.last_row_consumed = True
                 raise StopIteration
+            # time blocked inside executor.get = the consumer starving on an
+            # empty results queue (the "worker plane is the bottleneck" signal)
+            t0 = time.perf_counter() if tele.enabled else None
             try:
                 batch = self._executor.get(timeout=_GET_TIMEOUT_S)
             except queue.Empty:
+                if t0 is not None:
+                    self._m_results_empty.add(time.perf_counter() - t0)
                 stalled = time.monotonic() - last_progress
                 if self._stall_abort_s > 0 and stalled > self._stall_abort_s:
                     self._stall_aborted = True
@@ -630,6 +650,10 @@ class Reader:
                         "Reader has produced no batch for %.0fs; pipeline"
                         " state: %s", stalled, self.diagnostics)
                 continue
+            if t0 is not None:
+                self._m_results_empty.add(time.perf_counter() - t0)
+                self._m_batches.add(1)
+                self._m_rows_emitted.add(batch.num_rows)
             last_progress = time.monotonic()
             self._consumed_items += 1
             if batch.ordinal is not None:
@@ -669,7 +693,9 @@ class Reader:
         self._current = None
         self._current_pos = 0
         self.last_row_consumed = False
-        self._ventilator = Ventilator(self._executor, self._plan, self._num_epochs)
+        self._ventilator = Ventilator(self._executor, self._plan,
+                                      self._num_epochs,
+                                      telemetry=self.telemetry)
         self._expected_items = self._ventilator.total_items
         self._ventilator.start()
 
@@ -731,14 +757,17 @@ class Reader:
         After a stall abort the wait is bounded: the executor abandons any
         worker still wedged inside user code (daemon threads) instead of
         trading the iteration hang the abort just broke for a close hang.
+        Bounded-join support is detected from the executor's signature, not
+        by catching TypeError around the call - a real TypeError raised
+        INSIDE a bounded join must propagate, not silently degrade into an
+        unbounded re-join.
         """
         self._ventilator.join()
         if self._stall_aborted:
-            try:
+            join_params = inspect.signature(self._executor.join).parameters
+            if "timeout" in join_params:
                 self._executor.join(timeout=5.0)
                 return
-            except TypeError:  # executor flavor without bounded join
-                pass
         self._executor.join()
 
     def __enter__(self):

@@ -1,0 +1,119 @@
+"""Human-readable bottleneck summary from a telemetry snapshot.
+
+``render_pipeline_report`` is a pure function of ``Telemetry.snapshot()``
+output, so a parent process can render a report from a child's JSON snapshot
+(the benchmark CLI's ``--isolated`` mode) and tests can assert on stable
+dict inputs rather than live registries.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+#: canonical pipeline order (SURVEY.md section 7).  Stages outside this list
+#: (component-private sub-stages) render after the known ones.
+STAGE_ORDER = ("ventilate", "decode", "transform", "host-assemble",
+               "host-prep", "device-transfer")
+
+#: queue-wait counters -> how the report explains them.  Queue-FULL waits
+#: point the finger downstream (the stage after the queue is the bottleneck);
+#: queue-EMPTY waits point upstream.
+_QUEUE_WAITS = (
+    ("queue.input_full_wait_s",
+     "ventilator blocked on full input queue (workers saturated - healthy"
+     " backpressure)"),
+    ("queue.results_full_wait_s",
+     "workers blocked on full results queue (consumer is the bottleneck)"),
+    ("queue.results_empty_wait_s",
+     "consumer starved on empty results queue (worker plane is the"
+     " bottleneck)"),
+)
+
+
+def _stage_rows(snapshot: Dict) -> List[Dict]:
+    counters = snapshot.get("counters", {})
+    histograms = snapshot.get("histograms", {})
+    names = {n.split(".", 2)[1] for n in counters
+             if n.startswith("stage.") and n.endswith(".busy_s")}
+    ordered = [s for s in STAGE_ORDER if s in names]
+    ordered += sorted(names - set(STAGE_ORDER))
+    rows = []
+    for stage in ordered:
+        busy = counters.get(f"stage.{stage}.busy_s", 0.0)
+        count = int(counters.get(f"stage.{stage}.count", 0))
+        hist = histograms.get(f"stage.{stage}.latency_s")
+        p50 = p99 = None
+        if hist and hist.get("count"):
+            p50 = _hist_quantile(hist, 0.5)
+            p99 = _hist_quantile(hist, 0.99)
+        rows.append({"stage": stage, "busy_s": busy, "count": count,
+                     "mean_ms": (busy / count * 1e3) if count else 0.0,
+                     "p50_s": p50, "p99_s": p99})
+    return rows
+
+
+def _hist_quantile(hist: Dict, q: float) -> float:
+    total = hist["count"]
+    rank = q * total
+    seen = 0
+    buckets = hist["buckets"]
+    for i, c in enumerate(hist["counts"]):
+        seen += c
+        if seen >= rank:
+            return buckets[min(i, len(buckets) - 1)]
+    return buckets[-1]
+
+
+def dominant_stage(snapshot: Dict) -> str:
+    """Name of the stage with the most cumulative busy time ('' if none)."""
+    rows = _stage_rows(snapshot)
+    if not rows:
+        return ""
+    return max(rows, key=lambda r: r["busy_s"])["stage"]
+
+
+def render_pipeline_report(snapshot: Dict) -> str:
+    """Render the stage-utilization / queue-time bottleneck summary."""
+    wall = float(snapshot.get("uptime_s", 0.0)) or 1e-9
+    counters = snapshot.get("counters", {})
+    lines = ["== petastorm-tpu pipeline report ==",
+             f"observed wall clock: {wall:.2f} s"]
+    rows = _stage_rows(snapshot)
+    if rows:
+        lines.append(f"{'stage':<16} {'busy_s':>8} {'util%':>7} {'count':>7}"
+                     f" {'mean_ms':>9} {'p50_ms':>8} {'p99_ms':>8}")
+        for r in rows:
+            p50 = f"{r['p50_s'] * 1e3:>8.1f}" if r["p50_s"] is not None else f"{'-':>8}"
+            p99 = f"{r['p99_s'] * 1e3:>8.1f}" if r["p99_s"] is not None else f"{'-':>8}"
+            lines.append(
+                f"{r['stage']:<16} {r['busy_s']:>8.3f}"
+                f" {100.0 * r['busy_s'] / wall:>6.1f}% {r['count']:>7d}"
+                f" {r['mean_ms']:>9.2f} {p50} {p99}")
+        best = max(rows, key=lambda r: r["busy_s"])
+        lines.append(f"dominant stage: {best['stage']}"
+                     f" ({best['busy_s']:.3f} s busy,"
+                     f" {100.0 * best['busy_s'] / wall:.1f}% of wall;"
+                     " util% can exceed 100 - stages run on parallel workers)")
+    else:
+        lines.append("no stage samples recorded (telemetry enabled but no"
+                     " instrumented work ran)")
+    queue_lines = []
+    for name, meaning in _QUEUE_WAITS:
+        v = counters.get(name)
+        if v:
+            queue_lines.append(f"  {v:>8.3f} s  {meaning}")
+    if queue_lines:
+        lines.append("queue time:")
+        lines.extend(queue_lines)
+    interesting = {n: v for n, v in counters.items()
+                   if not n.startswith(("stage.", "queue."))}
+    if interesting:
+        lines.append("counters:")
+        for n, v in sorted(interesting.items()):
+            lines.append(f"  {n} = {v:g}")
+    gauges = snapshot.get("gauges", {})
+    if gauges:
+        lines.append("gauges (last value):")
+        for n, v in sorted(gauges.items()):
+            lines.append(f"  {n} = {v:g}")
+    return "\n".join(lines)

@@ -1,0 +1,84 @@
+"""Span tracing with thread/worker attribution; Chrome trace_event export.
+
+Spans are complete events ("ph": "X" in the Chrome trace format): one append
+per finished span carrying (name, category, thread, start, duration, args).
+The buffer is bounded - a run that records more spans than ``max_events``
+drops the excess and counts them, so an unbounded soak cannot grow host
+memory without bound.
+
+``chrome_trace()`` renders the JSON object format
+(https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+loadable directly in Perfetto / chrome://tracing: every event has ``ph``,
+``ts``/``dur`` (microseconds), ``pid``/``tid``, ``name``, ``cat``, ``args``,
+plus ``thread_name`` metadata events so worker threads show up by name.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class TraceBuffer:
+    """Bounded in-memory span store (one tuple per finished span)."""
+
+    def __init__(self, max_events: int = 200_000):
+        self._lock = threading.Lock()
+        #: (name, cat, tid, start_ns, dur_ns, args-or-None)
+        self._events: List[tuple] = []
+        self._max_events = max_events
+        self._dropped = 0
+        self._thread_names: Dict[int, str] = {}
+        #: perf_counter_ns at buffer creation - trace timestamps are relative
+        #: to this origin so they stay small and runs align at ts=0
+        self._origin_ns = time.perf_counter_ns()
+
+    def add(self, name: str, cat: str, start_ns: int, dur_ns: int,
+            args: Optional[Dict] = None) -> None:
+        """Append one finished span (attributed to the CALLING thread, so
+        call from the thread that did the work)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self._dropped += 1
+                return
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            self._events.append((name, cat, tid, start_ns, dur_ns, args))
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    @property
+    def dropped(self) -> int:
+        """Spans discarded because the buffer hit ``max_events``."""
+        return self._dropped
+
+    def chrome_trace(self) -> Dict:
+        """The buffered spans as a Chrome ``trace_event`` JSON object."""
+        pid = os.getpid()
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._thread_names)
+        origin = self._origin_ns
+        out = [{"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+                "args": {"name": tname}} for tid, tname in names.items()]
+        out.append({"ph": "M", "pid": pid, "name": "process_name",
+                    "args": {"name": "petastorm-tpu"}})
+        for name, cat, tid, start_ns, dur_ns, args in events:
+            ev = {"ph": "X", "pid": pid, "tid": tid, "name": name, "cat": cat,
+                  "ts": (start_ns - origin) / 1e3,   # microseconds
+                  "dur": dur_ns / 1e3}
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write ``chrome_trace()`` JSON to ``path``; returns the path."""
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path

@@ -1,0 +1,139 @@
+"""``petastorm-tpu-diagnose``: one-command pipeline bottleneck diagnosis.
+
+Runs a short telemetered read over a dataset (or a generated synthetic one)
+and prints the ``pipeline_report()`` bottleneck summary - which stage
+(ventilate / decode / transform) dominates, and whether queue time points at
+the worker plane or the consumer.  Optionally exports the run's span
+timeline as Chrome ``trace_event`` JSON for Perfetto.
+
+Examples::
+
+    petastorm-tpu-diagnose file:///data/imagenet --pool thread --workers 4
+    petastorm-tpu-diagnose --synthetic --trace-out /tmp/trace.json
+    python -m petastorm_tpu.tools.diagnose --synthetic --json
+
+Deliberately jax-free (reader + pool plane only): it runs anywhere the host
+pipeline runs, TPU attached or not.  For the device feed path use
+``petastorm-tpu-throughput --method jax --telemetry``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+from typing import List, Optional
+
+from petastorm_tpu.telemetry import Telemetry, dominant_stage
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-diagnose",
+        description="Run a short telemetered read and print the pipeline"
+                    " bottleneck report")
+    parser.add_argument("dataset_url", nargs="?", default=None,
+                        help="dataset to read (omit with --synthetic)")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="generate a small synthetic dataset in a temp"
+                             " dir (default when no dataset_url is given)")
+    parser.add_argument("--rows", type=int, default=200,
+                        help="synthetic dataset size (--synthetic)")
+    parser.add_argument("--row-group-size", type=int, default=20,
+                        help="synthetic rowgroup size (--synthetic)")
+    parser.add_argument("--method", default="batch", choices=("batch", "row"),
+                        help="batch=make_batch_reader (columnar),"
+                             " row=make_reader")
+    parser.add_argument("-p", "--pool-type", default="thread",
+                        choices=("thread", "process", "serial"))
+    parser.add_argument("-w", "--workers-count", type=int, default=3)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--max-batches", type=int, default=0,
+                        help="stop after N rowgroup batches (0 = read all)")
+    parser.add_argument("--trace-out", metavar="PATH", default=None,
+                        help="write the run's Chrome trace_event JSON here"
+                             " (open in Perfetto / chrome://tracing)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw telemetry snapshot as JSON"
+                             " instead of the human-readable report")
+    return parser
+
+
+def run_diagnosis(dataset_url: str, method: str = "batch",
+                  pool_type: str = "thread", workers_count: int = 3,
+                  num_epochs: int = 1, max_batches: int = 0,
+                  telemetry: Optional[Telemetry] = None) -> dict:
+    """Read ``dataset_url`` with telemetry enabled; returns a result dict
+    with ``rows``, ``batches``, ``snapshot``, ``report`` and
+    ``dominant_stage`` (also the programmatic entry the tests use)."""
+    from petastorm_tpu.reader import make_batch_reader, make_reader
+
+    tele = telemetry or Telemetry()
+    factory = make_batch_reader if method == "batch" else make_reader
+    rows = 0
+    batches = 0
+    with factory(dataset_url, reader_pool_type=pool_type,
+                 workers_count=workers_count, num_epochs=num_epochs,
+                 shuffle_row_groups=False, telemetry=tele) as reader:
+        if method == "batch":
+            for batch in reader.iter_batches():
+                rows += batch.num_rows
+                batches += 1
+                if max_batches and batches >= max_batches:
+                    break
+        else:
+            for _ in reader:
+                rows += 1
+    snapshot = tele.snapshot()
+    return {"rows": rows, "batches": batches, "snapshot": snapshot,
+            "report": tele.pipeline_report(),
+            "dominant_stage": dominant_stage(snapshot),
+            "telemetry": tele}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.dataset_url is None and not args.synthetic:
+        args.synthetic = True
+    tmpdir = None
+    url = args.dataset_url
+    try:
+        if url is None:
+            from petastorm_tpu.test_util.synthetic import create_test_dataset
+
+            tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_diagnose_")
+            create_test_dataset(tmpdir, num_rows=args.rows,
+                                row_group_size_rows=args.row_group_size)
+            url = tmpdir
+        result = run_diagnosis(url, method=args.method,
+                               pool_type=args.pool_type,
+                               workers_count=args.workers_count,
+                               num_epochs=args.num_epochs,
+                               max_batches=args.max_batches)
+        if args.trace_out:
+            result["telemetry"].export_chrome_trace(args.trace_out)
+        if args.json:
+            print(json.dumps({"rows": result["rows"],
+                              "batches": result["batches"],
+                              "dominant_stage": result["dominant_stage"],
+                              "snapshot": result["snapshot"]}))
+        else:
+            what = "synthetic dataset" if tmpdir else url
+            print(f"read {result['rows']} rows"
+                  + (f" in {result['batches']} rowgroup batches"
+                     if args.method == "batch" else "")
+                  + f" from {what}")
+            print(result["report"])
+            if args.trace_out:
+                print(f"chrome trace written to {args.trace_out}"
+                      " (load in Perfetto / chrome://tracing)")
+        return 0
+    finally:
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

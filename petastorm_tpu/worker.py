@@ -30,6 +30,8 @@ from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.fs import FilesystemFactory
 from petastorm_tpu.plan import WorkItem
 from petastorm_tpu.schema import Schema
+from petastorm_tpu.telemetry import NULL_CONTEXT as _NULL_CONTEXT
+from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 from petastorm_tpu.transform import TransformSpec
 
 logger = logging.getLogger(__name__)
@@ -57,7 +59,8 @@ class RowGroupDecoderWorker:
                  verify_checksums: bool = False,
                  raw_fields: Sequence[str] = (),
                  mixed_raw_fields: Sequence[str] = (),
-                 retry_policy=None):
+                 retry_policy=None,
+                 telemetry=None):
         self._fs_factory = fs_factory
         self._schema = schema
         self._read_fields = list(read_fields)
@@ -77,10 +80,26 @@ class RowGroupDecoderWorker:
         #: subset shipping the mixed-geometry object wire format
         #: (decode_placement='device-mixed')
         self._mixed_raw_fields = frozenset(mixed_raw_fields)
+        #: telemetry recorder; None = not yet resolved (resolution happens in
+        #: __call__, in the worker thread/process, so a spawned worker
+        #: re-resolves from its own inherited env)
+        self._telemetry = (_resolve_telemetry(telemetry)
+                           if telemetry is not None else None)
 
     # -- factory protocol -----------------------------------------------------
 
+    def __getstate__(self):
+        # a live Telemetry holds locks and a trace buffer - not picklable,
+        # and not meaningful across a process boundary anyway: the spawned
+        # worker re-resolves from PETASTORM_TPU_TELEMETRY (inherited env)
+        state = dict(self.__dict__)
+        state["_telemetry"] = None
+        return state
+
     def __call__(self):
+        if self._telemetry is None:
+            self._telemetry = _resolve_telemetry(None)
+        tele = self._telemetry
         fs = self._fs_factory()
         # path -> (ParquetFile, column-name set); the column set is cached
         # because schema_arrow reconstruction is measurable on the per-item
@@ -135,6 +154,9 @@ class RowGroupDecoderWorker:
                 what=f"rowgroup {item.row_group.path}"
                      f"#{item.row_group.row_group}",
                 on_retry=drop_handle)
+            if tele.enabled:
+                tele.counter("worker.rowgroups_decoded").add(1)
+                tele.counter("worker.rows_decoded").add(batch.num_rows)
             # ordinal rides the batch so the consumer can track the exact
             # contiguous consumed prefix (resume correctness under pools
             # that complete items out of ventilation order).  Shallow copy:
@@ -167,25 +189,37 @@ class RowGroupDecoderWorker:
             load_item = WorkItem(item.row_group)
         else:
             load_item = item
+        tele = self._telemetry
+        traced = tele is not None and tele.enabled
+        decode_stage = (tele.stage("decode", path=item.row_group.path,
+                                   rowgroup=item.row_group.row_group)
+                        if traced else _NULL_CONTEXT)
         if self._predicate is None:
             # key covers the rows ACTUALLY loaded (incl. ngram lookahead), so
             # readers with different ngram lengths never share an entry
             span = row_range if row_range is not None else load_item.row_slice()
             key = self._cache_key(load_item, span)
-            batch = self._cache.get(key, lambda: self._load(
-                parquet_file, load_item, self._read_fields, row_range=row_range))
+            with decode_stage:
+                batch = self._cache.get(key, lambda: self._load(
+                    parquet_file, load_item, self._read_fields,
+                    row_range=row_range))
         else:
             # predicates invalidate rowgroup-level caching (reference
             # py_dict_reader_worker.py:145-150); split-read instead
-            batch = self._load_with_predicate(parquet_file, load_item, row_range)
+            with decode_stage:
+                batch = self._load_with_predicate(parquet_file, load_item,
+                                                  row_range)
         if batch.num_rows == 0:
             # fully-masked rowgroup: transforms/ngram must not see 0-row columns
             # (a transform may np.stack/reduce over rows)
             return batch
-        batch = self._apply_transform(batch)
-        if self._ngram is not None:
-            batch = self._ngram.form_windows(self._ngram_schema, batch,
-                                             anchor_range=anchor)
+        if self._transform is None and self._ngram is None:
+            return batch
+        with tele.stage("transform") if traced else _NULL_CONTEXT:
+            batch = self._apply_transform(batch)
+            if self._ngram is not None:
+                batch = self._ngram.form_windows(self._ngram_schema, batch,
+                                                 anchor_range=anchor)
         return batch
 
     def _cache_key(self, item: WorkItem, span: tuple) -> str:
