@@ -55,6 +55,40 @@ assert any(e["name"] == "decode" for e in spans), "no decode spans"
 print(f"telemetry smoke OK ({len(spans)} spans)")
 PY
 
+echo "== chaos smoke (fault-tolerant ingest under injected failures) =="
+# a short read with one injected decode failure and one hard worker kill
+# under on_error='skip' must COMPLETE (minus exactly the poisoned rowgroup)
+# with the damage counted in telemetry - the degraded-not-dead contract
+JAX_PLATFORMS=cpu python - <<'PY'
+import tempfile
+import numpy as np
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.chaos import ChaosSpec
+
+tmp = tempfile.mkdtemp(prefix="petastorm_tpu_chaos_smoke_")
+schema = Schema("ChaosSmoke", [Field("x", np.int64)])
+write_dataset(tmp, schema, [{"x": i} for i in range(60)],
+              row_group_size_rows=10)
+tele = Telemetry()
+chaos = ChaosSpec(decode_fail_ordinals=(2,), kill_ordinals=(4,))
+with make_batch_reader(tmp, reader_pool_type="thread", workers_count=2,
+                       shuffle_row_groups=False, chaos=chaos,
+                       on_error="skip", telemetry=tele) as reader:
+    rows = sorted(x for b in reader.iter_batches() for x in b.columns["x"])
+    diag = reader.diagnostics
+assert rows == sorted(set(range(60)) - set(range(20, 30))), len(rows)
+assert diag["skipped_rowgroups"] == 1, diag
+assert diag["requeued_items"] == 1, diag
+counters = tele.snapshot()["counters"]
+assert counters["errors.skipped_rowgroups"] == 1
+assert counters["errors.requeued_items"] == 1
+print("chaos smoke OK (1 rowgroup quarantined, 1 kill requeued,"
+      f" {len(rows)} healthy rows delivered)")
+PY
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

@@ -83,6 +83,8 @@ API = [
                                  "enable", "enabled_from_env",
                                  "render_pipeline_report", "dominant_stage"]),
     ("petastorm_tpu.tools.diagnose", ["run_diagnosis"]),
+    ("petastorm_tpu.test_util.chaos", ["ChaosSpec", "ChaosWorker",
+                                       "SimulatedWorkerCrash"]),
 ]
 
 

@@ -57,6 +57,19 @@ def build_parser() -> argparse.ArgumentParser:
                         help="print one JSON line instead of human-readable text")
     parser.add_argument("--isolated", action="store_true",
                         help="re-run in a fresh interpreter for clean RSS")
+    parser.add_argument("--chaos", metavar="SPEC", default=None,
+                        help="measure throughput UNDER injected faults:"
+                             " comma-separated ChaosSpec fields, e.g."
+                             " 'decode_fail_rate=0.01,kill_ordinals=3;7,"
+                             "fail_first_reads=5,seed=1' (ordinal lists use"
+                             " ';'). Pair with --on-error skip so the run"
+                             " survives the injected data errors"
+                             " (petastorm_tpu.test_util.chaos)")
+    parser.add_argument("--on-error", default="raise",
+                        choices=("raise", "skip"),
+                        help="reader failure policy: 'skip' quarantines"
+                             " failing rowgroups and keeps reading (counts"
+                             " ride telemetry as errors.*)")
     return parser
 
 
@@ -67,6 +80,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.telemetry or args.trace_out:
         from petastorm_tpu.telemetry import Telemetry
         telemetry = Telemetry()
+
+    chaos = None
+    if args.chaos:
+        from petastorm_tpu.test_util.chaos import ChaosSpec
+        chaos = ChaosSpec.parse(args.chaos)
 
     if args.isolated:
         from petastorm_tpu.benchmark.throughput import run_isolated
@@ -84,7 +102,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             shuffle_row_groups=not args.no_shuffle,
             simulated_step_s=args.simulated_step_ms / 1000.0,
             device_decode_fields=args.decode_device,
-            prefetch=args.prefetch, telemetry=telemetry)
+            prefetch=args.prefetch, telemetry=telemetry,
+            chaos=chaos, on_error=args.on_error)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(
@@ -92,7 +111,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
             pool_type=args.pool_type, workers_count=args.workers_count,
             read_method=args.method, shuffle_row_groups=not args.no_shuffle,
-            telemetry=telemetry)
+            telemetry=telemetry, chaos=chaos, on_error=args.on_error)
 
     if telemetry is not None and args.trace_out and not args.isolated:
         telemetry.export_chrome_trace(args.trace_out)

@@ -87,13 +87,16 @@ def reader_throughput(dataset_url: str,
                       shuffle_row_groups: bool = True,
                       transform_spec=None,
                       storage_options: Optional[dict] = None,
-                      telemetry=None) -> BenchmarkResult:
+                      telemetry=None, chaos=None,
+                      on_error="raise") -> BenchmarkResult:
     """Measure raw reader throughput in samples/sec.
 
     ``read_method='row'`` counts one sample per ``next()`` (make_reader);
     ``'batch'`` iterates make_batch_reader and counts rows per columnar batch.
     ``telemetry``: optional petastorm_tpu.telemetry recorder; when enabled its
     snapshot rides back on ``BenchmarkResult.metrics``.
+    ``chaos``/``on_error``: measure throughput under injected faults
+    (test_util.chaos) - degradation becomes a number, not an anecdote.
     Reference: ``reader_throughput`` (benchmark/throughput.py:113-174).
     """
     from petastorm_tpu.reader import make_batch_reader, make_reader
@@ -108,7 +111,8 @@ def reader_throughput(dataset_url: str,
                  reader_pool_type=pool_type, workers_count=workers_count,
                  shuffle_row_groups=shuffle_row_groups, num_epochs=None,
                  transform_spec=transform_spec,
-                 storage_options=storage_options, telemetry=tele) as reader:
+                 storage_options=storage_options, telemetry=tele,
+                 chaos=chaos, on_error=on_error) as reader:
         it = iter(reader)
 
         def consume(cycles: int) -> int:
@@ -141,7 +145,8 @@ def jax_loader_throughput(dataset_url: str,
                           simulated_step_s: float = 0.0,
                           device_decode_fields: Sequence[str] = (),
                           prefetch: int = 2,
-                          telemetry=None) -> BenchmarkResult:
+                          telemetry=None, chaos=None,
+                          on_error="raise") -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
@@ -169,7 +174,7 @@ def jax_loader_throughput(dataset_url: str,
         num_epochs=None, storage_options=storage_options,
         decode_placement=({f: "device" for f in device_decode_fields}
                           if device_decode_fields else None),
-        telemetry=tele)
+        telemetry=tele, chaos=chaos, on_error=on_error)
     try:
         loader = JaxDataLoader(reader, batch_size=batch_size, prefetch=prefetch)
     except Exception:

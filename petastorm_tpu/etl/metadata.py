@@ -172,7 +172,7 @@ def _check_legacy_row_group_counts(kv_metadata: Dict[bytes, bytes], root: str,
 
 def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
                     kv_metadata: Dict[bytes, bytes],
-                    retry_policy=None) -> List[RowGroupRef]:
+                    retry_policy=None, telemetry=None) -> List[RowGroupRef]:
     """Enumerate rowgroups for path-sorted ``files``.
 
     Strategy 1 (fast): cached per-file counts from KV metadata - no footer reads
@@ -203,7 +203,8 @@ def load_row_groups(fs: pafs.FileSystem, root: str, files: List[str],
         with ThreadPoolExecutor(max_workers=_FOOTER_READ_THREADS) as pool:
             results = list(pool.map(
                 lambda p: retry_call(lambda: _footer_row_groups(fs, p),
-                                     retry_policy, what=f"footer of {p}"),
+                                     retry_policy, what=f"footer of {p}",
+                                     telemetry=telemetry),
                 files))
         per_file = dict(zip(files, results))
         _check_legacy_row_group_counts(kv_metadata, root, per_file)
@@ -221,7 +222,7 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
                  storage_options: Optional[dict] = None,
                  filesystem: Optional[pafs.FileSystem] = None,
                  require_stored_schema: bool = False,
-                 io_retries="auto") -> DatasetInfo:
+                 io_retries="auto", telemetry=None) -> DatasetInfo:
     """Resolve URL(s) -> DatasetInfo with schema, files, rowgroups.
 
     ``url_or_urls`` may be a dataset directory URL or an explicit list of parquet
@@ -229,6 +230,7 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
 
     ``io_retries``: transient-failure policy for the listing/KV/footer reads
     (petastorm_tpu.retry) - ``'auto'`` retries on remote filesystems only.
+    ``telemetry``: optional recorder; retries are counted as ``io.retries``.
     """
     fs, path_or_paths = get_filesystem_and_path_or_paths(
         url_or_urls, storage_options, filesystem)
@@ -236,7 +238,8 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
 
     def _list(selector):
         return retry_call(lambda: fs.get_file_info(selector), retry_policy,
-                          what=f"listing {getattr(selector, 'base_dir', selector)}")
+                          what=f"listing {getattr(selector, 'base_dir', selector)}",
+                          telemetry=telemetry)
 
     if isinstance(path_or_paths, str):
         root = path_or_paths
@@ -277,7 +280,7 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
         raise MetadataError(f"No parquet data files found under {url_or_urls!r}")
 
     kv = retry_call(lambda: _read_kv_metadata(fs, root), retry_policy,
-                    what=f"metadata of {root}")
+                    what=f"metadata of {root}", telemetry=telemetry)
     stored_schema = None
     if SCHEMA_METADATA_KEY in kv:
         stored_schema = Schema.from_json(kv[SCHEMA_METADATA_KEY])
@@ -288,7 +291,8 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
                 return pq.ParquetFile(f).schema_arrow.metadata or {}
 
         file_kv = retry_call(_file_kv, retry_policy,
-                             what=f"schema footer of {files[0]}")
+                             what=f"schema footer of {files[0]}",
+                             telemetry=telemetry)
         if SCHEMA_METADATA_KEY in file_kv:
             stored_schema = Schema.from_json(file_kv[SCHEMA_METADATA_KEY])
             kv = {**file_kv, **kv}
@@ -321,8 +325,9 @@ def open_dataset(url_or_urls: Union[str, Sequence[str]],
     dset = retry_call(
         lambda: pads.dataset(files, filesystem=fs, format="parquet",
                              partitioning=pads.HivePartitioning.discover()),
-        retry_policy, what=f"dataset schema of {root}")
-    row_groups = load_row_groups(fs, root, files, kv, retry_policy=retry_policy)
+        retry_policy, what=f"dataset schema of {root}", telemetry=telemetry)
+    row_groups = load_row_groups(fs, root, files, kv, retry_policy=retry_policy,
+                                 telemetry=telemetry)
     return DatasetInfo(url_or_urls, fs, path_or_paths, files, dset.schema, kv,
                        row_groups, stored_schema, root_path=root)
 

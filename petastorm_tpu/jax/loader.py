@@ -1186,6 +1186,13 @@ class JaxDataLoader:
         reader_diag = getattr(self._reader, "diagnostics", None)
         if isinstance(reader_diag, dict):
             out["reader"] = reader_diag
+            if reader_diag.get("skipped_rowgroups"):
+                # fault ledger surfaced at the loader level too: a feed that
+                # is degraded-but-running under an on_error skip policy must
+                # be visible without digging into the nested reader dict
+                out["skipped_rowgroups"] = reader_diag["skipped_rowgroups"]
+                out["quarantined_rowgroups"] = reader_diag.get(
+                    "quarantined_rowgroups", [])
         return out
 
     def __iter__(self):

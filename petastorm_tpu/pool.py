@@ -33,13 +33,16 @@ import traceback
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Iterable, Optional
 
-from petastorm_tpu.errors import PetastormTpuError, ReaderClosedError
+from petastorm_tpu.errors import (DEFAULT_REQUEUE_ATTEMPTS,
+                                  PetastormTpuError, ReaderClosedError,
+                                  classify_error)
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
 
 _POLL_S = 0.05
 DEFAULT_RESULTS_QUEUE_SIZE = 50  # reference: reader.py:61
+_MISSING = object()
 
 
 def _env_seconds(name: str, default: float) -> float:
@@ -56,7 +59,25 @@ def _env_seconds(name: str, default: float) -> float:
 
 
 class WorkerError(PetastormTpuError):
-    """A worker failed; message includes the remote traceback."""
+    """A worker failed; message includes the remote traceback (when the
+    worker lived long enough to produce one).
+
+    Carries the failure-classification metadata the reader's ``on_error``
+    policy dispatches on: ``kind`` (``'data'`` = property of the work item,
+    skip-eligible; ``'infra'`` = property of the worker, requeue-eligible),
+    and - when the failure is attributable to a single work item -
+    ``ordinal``, ``item`` and ``exc_type``.  Unattributable failures
+    (all workers died, stall abort) keep the defaults and are never
+    skippable.
+    """
+
+    def __init__(self, message: str, kind: str = "infra", ordinal=None,
+                 item=None, exc_type: Optional[str] = None):
+        super().__init__(message)
+        self.kind = kind
+        self.ordinal = ordinal
+        self.item = item
+        self.exc_type = exc_type
 
 
 class VentilationCancelled(Exception):
@@ -66,10 +87,42 @@ class VentilationCancelled(Exception):
 
 
 class _Failure:
-    __slots__ = ("formatted",)
+    """A worker exception crossing back to the consumer (picklable)."""
 
-    def __init__(self, exc: BaseException):
+    __slots__ = ("formatted", "kind", "exc_type", "ordinal", "item")
+
+    def __init__(self, exc: BaseException, ordinal=None, item=None):
         self.formatted = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        self.kind = classify_error(exc)
+        self.exc_type = type(exc).__name__
+        self.ordinal = ordinal
+        self.item = item
+
+
+class _Ok:
+    """Success envelope tagging a result with its work-item ordinal, so the
+    consumer side can settle the in-flight ledger (requeue dedup: a result
+    for an ordinal no longer in flight is a duplicate and is dropped)."""
+
+    __slots__ = ("ordinal", "value")
+
+    def __init__(self, ordinal, value):
+        self.ordinal = ordinal
+        self.value = value
+
+    def __getstate__(self):
+        return (self.ordinal, self.value)
+
+    def __setstate__(self, state):
+        self.ordinal, self.value = state
+
+
+def _worker_error(exc: BaseException, kind: str, ordinal, item) -> WorkerError:
+    """One classified WorkerError from a live exception (single place that
+    encodes the message/metadata shape, shared with the _Failure path)."""
+    failure = _Failure(exc, ordinal=ordinal, item=item)
+    return WorkerError(f"Worker failed:\n{failure.formatted}", kind=kind,
+                       ordinal=ordinal, item=item, exc_type=failure.exc_type)
 
 
 #: worker factory: () -> process_fn(item) -> result.  Must be picklable for
@@ -84,29 +137,59 @@ class VentilatedItem:
     consumer reconstruct the exact contiguous consumed prefix (the only
     resume cursor that can guarantee no item is ever lost).  Picklable for
     the process pool.
+
+    ``attempt`` counts infra-failure requeues of this ordinal (0 = first
+    delivery); it rides the item itself so deterministic fault injection
+    (test_util.chaos) can key on it across process boundaries.
     """
 
-    __slots__ = ("ordinal", "item")
+    __slots__ = ("ordinal", "item", "attempt")
 
-    def __init__(self, ordinal: int, item: Any):
+    def __init__(self, ordinal: int, item: Any, attempt: int = 0):
         self.ordinal = ordinal
         self.item = item
+        self.attempt = attempt
 
     def __getstate__(self):
-        return (self.ordinal, self.item)
+        return (self.ordinal, self.item, self.attempt)
 
     def __setstate__(self, state):
-        self.ordinal, self.item = state
+        self.ordinal, self.item = state[0], state[1]
+        self.attempt = state[2] if len(state) > 2 else 0
 
 
 class ExecutorBase(ABC):
     """start -> (put*/get*) -> stop -> join lifecycle, mirroring the reference pool
-    protocol (start/ventilate/get_results/stop/join)."""
+    protocol (start/ventilate/get_results/stop/join).
 
-    def __init__(self, telemetry=None):
+    Failure handling (docs/operations.md "Failure handling"): work items
+    carrying a ventilation ordinal are tracked in an in-flight ledger from
+    ``put`` until their result (or attributed failure) is delivered at
+    ``get``.  When a worker dies mid-item (process crash/OOM, or a simulated
+    crash in tests), the ledger + worker heartbeat identify the lost item and
+    it is requeued onto surviving workers up to ``max_requeue_attempts``
+    times; the ledger also dedups the rare double delivery (worker died
+    after queueing its result but before clearing its heartbeat).
+    ``stop_on_failure=False`` (the reader's ``on_error`` skip policies) keeps
+    the pool running when a failure is delivered, so the consumer can skip
+    the item and keep iterating.
+    """
+
+    def __init__(self, telemetry=None, stop_on_failure: bool = True,
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
         self._stopped = False
         self._ventilated = 0
         self._consumed = 0
+        self._stop_on_failure = stop_on_failure
+        self._max_requeue = max_requeue_attempts
+        #: ordinal -> latest in-flight VentilatedItem (items without an
+        #: ordinal are not tracked: they cannot be requeued or deduped)
+        self._inflight: dict = {}
+        self._inflight_lock = threading.Lock()
+        self._requeued_items = 0
+        #: requeued items waiting for an input-queue slot (consumer-thread
+        #: state: parked by _reinject, drained by _flush_pending_requeues)
+        self._pending_requeue: list = []
         #: petastorm_tpu.telemetry recorder (no-op unless enabled); executors
         #: record queue-full wait time - the signal that tells the pipeline
         #: report whether backpressure points upstream or downstream
@@ -114,6 +197,111 @@ class ExecutorBase(ABC):
         self._m_input_full = self._telemetry.counter("queue.input_full_wait_s")
         self._m_results_full = self._telemetry.counter(
             "queue.results_full_wait_s")
+        self._m_requeued = self._telemetry.counter("errors.requeued_items")
+
+    # -- in-flight ledger (requeue + duplicate suppression) -------------------
+
+    def _track_put(self, item: Any) -> None:
+        ordinal = getattr(item, "ordinal", None)
+        if ordinal is not None:
+            with self._inflight_lock:
+                self._inflight[ordinal] = item
+
+    def _settle(self, ordinal) -> bool:
+        """Remove ``ordinal`` from the in-flight ledger; False = the ordinal
+        was already settled (this delivery is a requeue duplicate)."""
+        if ordinal is None:
+            return True
+        with self._inflight_lock:
+            return self._inflight.pop(ordinal, _MISSING) is not _MISSING
+
+    def _try_requeue(self, ordinal, why: str) -> bool:
+        """Re-ventilate the in-flight item for ``ordinal`` with its attempt
+        count bumped; False when the ordinal is untracked (already
+        delivered, or never had an ordinal) or its attempt budget is spent."""
+        if ordinal is None:
+            return False
+        with self._inflight_lock:
+            item = self._inflight.get(ordinal)
+        if item is None:
+            return False
+        attempt = getattr(item, "attempt", 0)
+        if attempt >= self._max_requeue:
+            return False
+        retry = VentilatedItem(ordinal, getattr(item, "item", item),
+                               attempt + 1)
+        with self._inflight_lock:
+            self._inflight[ordinal] = retry
+        self._requeued_items += 1
+        self._m_requeued.add(1)
+        logger.warning("Requeueing work item %s after %s (attempt %d/%d)",
+                       ordinal, why, attempt + 1, self._max_requeue)
+        self._reinject(retry)
+        return True
+
+    def _deliver_failure(self, failure: "_Failure") -> bool:
+        """Handle a delivered worker failure.
+
+        Infra-kind failures with an attributable item (e.g. an in-worker
+        MemoryError) get the same treatment as a worker death: the item is
+        healthy, the worker wasn't - requeue it, budget permitting, and
+        return True so the caller keeps polling.  Everything else settles
+        the ledger and raises a classified WorkerError.
+        """
+        if failure.kind == "infra" and self._try_requeue(
+                failure.ordinal,
+                f"in-worker infra failure ({failure.exc_type})"):
+            return True
+        if failure.ordinal is not None and not self._settle(failure.ordinal):
+            # late failure for an ordinal that was already settled (a
+            # requeued item's sibling delivery won the race): drop it like
+            # a duplicate _Ok - the item already reached the consumer, so
+            # aborting (raise mode) or double-counting a skip would both
+            # corrupt the epoch accounting
+            logger.warning("Dropping duplicate failure for already-delivered"
+                           " work item %s (%s)", failure.ordinal,
+                           failure.exc_type)
+            return True
+        if self._stop_on_failure:
+            self.stop()
+        raise WorkerError(f"Worker failed:\n{failure.formatted}",
+                          kind=failure.kind, ordinal=failure.ordinal,
+                          item=failure.item, exc_type=failure.exc_type)
+
+    def _requeue_lost(self, ordinal, why: str) -> None:
+        """A worker died holding ``ordinal``: re-ventilate it onto surviving
+        workers, or surface a WorkerError once the attempt budget is spent."""
+        if ordinal is None or self._try_requeue(ordinal, why):
+            return
+        with self._inflight_lock:
+            item = self._inflight.pop(ordinal, None)
+        if item is None:
+            # the result was delivered before the worker died: nothing lost
+            return
+        if self._stop_on_failure:
+            self.stop()
+        raise WorkerError(
+            f"Work item {ordinal} lost to {why}; requeue budget exhausted"
+            f" ({getattr(item, 'attempt', 0)} requeue(s) of max"
+            f" {self._max_requeue}) - possible crash/OOM", kind="infra",
+            ordinal=ordinal, item=item)
+
+    def _reinject(self, item: Any) -> None:
+        """Re-enqueue a requeued item without ever blocking the consumer
+        thread: parked when the input queue is full, drained on later
+        ``_flush_pending_requeues`` calls."""
+        if not self._try_enqueue(item):
+            self._pending_requeue.append(item)
+
+    def _flush_pending_requeues(self) -> None:
+        while (self._pending_requeue
+               and self._try_enqueue(self._pending_requeue[0])):
+            self._pending_requeue.pop(0)
+
+    def _try_enqueue(self, item: Any) -> bool:
+        """Non-blocking input-queue insert (pool-specific); False = full."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support requeueing")
 
     @abstractmethod
     def start(self, worker_factory: WorkerFactory) -> None:
@@ -141,6 +329,7 @@ class ExecutorBase(ABC):
     @property
     def diagnostics(self) -> dict:
         return {"ventilated": self._ventilated, "consumed": self._consumed,
+                "requeued_items": self._requeued_items,
                 "stopped": self._stopped}
 
     def __enter__(self):
@@ -169,8 +358,11 @@ class SerialExecutor(ExecutorBase):
     thread or process pool when abort matters (docs/operations.md).
     """
 
-    def __init__(self, in_queue_size: int = 32, telemetry=None):
-        super().__init__(telemetry=telemetry)
+    def __init__(self, in_queue_size: int = 32, telemetry=None,
+                 stop_on_failure: bool = True,
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
+        super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
+                         max_requeue_attempts=max_requeue_attempts)
         self._items: "queue.Queue[Any]" = queue.Queue(maxsize=in_queue_size)
         self._fn: Optional[Callable] = None
         self._stall_warn_s = _env_seconds("PETASTORM_TPU_STALL_WARN_S", 120.0)
@@ -223,7 +415,6 @@ class SerialExecutor(ExecutorBase):
             item = self._items.get(timeout=timeout or _POLL_S)
         except queue.Empty:
             raise queue.Empty("No ventilated items to process")
-        self._consumed += 1
         if self._stall_warn_s > 0:
             if self._watch_thread is None:
                 self._watch_thread = threading.Thread(
@@ -235,10 +426,60 @@ class SerialExecutor(ExecutorBase):
             self._watch_since = time.monotonic()
             self._watch_gen += 1
             self._watch_item = item
-        try:
-            return self._fn(item)
-        finally:
-            self._watch_item = None
+        current = item
+        attempt = getattr(item, "attempt", 0)
+        while True:
+            try:
+                try:
+                    result = self._fn(current)
+                finally:
+                    self._watch_item = None
+                # consumed = delivered, matching the thread/process pools:
+                # a skipped/failed item must not inflate the count
+                self._consumed += 1
+                return result
+            except BaseException as exc:  # noqa: BLE001 - classified below
+                simulated = getattr(exc, "petastorm_tpu_simulated_crash",
+                                    False)
+                if not simulated and not isinstance(exc, Exception):
+                    # KeyboardInterrupt / SystemExit / GeneratorExit are the
+                    # CONSUMER's control flow (work runs inline here), never
+                    # a work-item failure: propagate untouched in every mode
+                    raise
+                kind = "infra" if simulated else classify_error(exc)
+                ordinal = getattr(current, "ordinal", None)
+                if kind == "infra":
+                    if attempt < self._max_requeue:
+                        # serial "requeue": there is no surviving worker to
+                        # move the item to, so retry it inline with the
+                        # attempt count bumped (fault injection keys on it;
+                        # the local counter bounds retries even for
+                        # ordinal-less items)
+                        attempt += 1
+                        self._requeued_items += 1
+                        self._m_requeued.add(1)
+                        logger.warning(
+                            "Serial worker infra failure on item %s (%s);"
+                            " retrying inline (attempt %d/%d)", ordinal,
+                            type(exc).__name__, attempt, self._max_requeue)
+                        if ordinal is not None:
+                            current = VentilatedItem(
+                                ordinal, getattr(current, "item", current),
+                                attempt)
+                        self._watch_since = time.monotonic()
+                        self._watch_gen += 1
+                        self._watch_item = current
+                        continue
+                    # budget spent: a classified WorkerError in BOTH modes,
+                    # matching the thread/process pools (and a raw
+                    # SimulatedWorkerCrash BaseException must never escape
+                    # to callers that handle `except Exception`)
+                    raise _worker_error(exc, kind, ordinal, current) from exc
+                if not self._stop_on_failure:
+                    # skip-policy mode: deliver a classified WorkerError the
+                    # reader can quarantine, without killing the executor
+                    raise _worker_error(exc, kind, ordinal, current) from exc
+                raise  # raise mode: propagate the original exception as-is
 
     def stop(self) -> None:
         self._stopped = True
@@ -263,8 +504,11 @@ class ThreadedExecutor(ExecutorBase):
                  results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
                  in_queue_size: Optional[int] = None,
                  profiling_enabled: bool = False,
-                 telemetry=None):
-        super().__init__(telemetry=telemetry)
+                 telemetry=None,
+                 stop_on_failure: bool = True,
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
+        super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
+                         max_requeue_attempts=max_requeue_attempts)
         self._workers_count = workers_count
         # Queue choice is correctness-driven (hang post-mortem, RESULTS.md):
         # CPython's SimpleQueue.get(timeout) WEDGES under multiple
@@ -301,6 +545,9 @@ class ThreadedExecutor(ExecutorBase):
         # read by diagnostics to attribute a pipeline stall to the exact
         # worker and work item (RESULTS.md hang watch item).
         self._worker_state: list = []
+        # fault servicing (consumer-thread-only state): worker indexes whose
+        # death has been handled
+        self._reaped: set = set()
 
     def start(self, worker_factory: WorkerFactory) -> None:
         if self._threads:
@@ -332,7 +579,8 @@ class ThreadedExecutor(ExecutorBase):
             # the two writes must never pair the new item with the old
             # idle-since time (it would report the whole idle gap as "stuck")
             state[1] = time.monotonic()
-            state[0] = getattr(item, "ordinal", "?")
+            ordinal = getattr(item, "ordinal", None)
+            state[0] = ordinal if ordinal is not None else "?"
             try:
                 if profile is not None:
                     try:
@@ -350,7 +598,14 @@ class ThreadedExecutor(ExecutorBase):
                 else:
                     result = fn(item)
             except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
-                result = _Failure(exc)
+                if getattr(exc, "petastorm_tpu_simulated_crash", False):
+                    # chaos harness: die like a hard-killed worker - no
+                    # result, heartbeat left set so get() can attribute the
+                    # lost item and requeue it onto surviving workers
+                    return
+                result = _Failure(exc, ordinal=ordinal, item=item)
+            else:
+                result = _Ok(ordinal, result)
             self._put_result_stop_aware(result)
             state[0] = None
             state[1] = time.monotonic()
@@ -377,6 +632,7 @@ class ThreadedExecutor(ExecutorBase):
         t0 = time.perf_counter() if self._telemetry.enabled else None
         while not self._stop_event.is_set():
             if self._in_slots.acquire(timeout=_POLL_S):
+                self._track_put(item)
                 self._in_queue.put(item)
                 self._ventilated += 1
                 if t0 is not None:
@@ -390,19 +646,68 @@ class ThreadedExecutor(ExecutorBase):
                 raise VentilationCancelled()
         raise ReaderClosedError("Executor stopped while putting")
 
+    def _try_enqueue(self, item: Any) -> bool:
+        # consumer-thread context (called from get); never block on a full
+        # input queue here - the caller parks the item and retries later
+        if self._in_slots.acquire(blocking=False):
+            self._in_queue.put(item)
+            return True
+        return False
+
+    def _service_faults(self) -> None:
+        """Reap dead worker threads (requeueing their in-flight items) and
+        flush parked requeues.  Runs on the consumer thread between polls."""
+        self._flush_pending_requeues()
+        if self._stop_event.is_set():
+            return
+        for i, t in enumerate(self._threads):
+            if t.is_alive() or i in self._reaped:
+                continue
+            self._reaped.add(i)
+            ordinal = self._worker_state[i][0]
+            logger.warning("Worker thread %d died while on item %s", i,
+                           ordinal)
+            # clear the dead worker's busy slot BEFORE the (possibly
+            # raising) requeue: diagnostics must not report a phantom
+            # stuck worker forever (the owner is dead, so this write
+            # cannot race it)
+            self._worker_state[i][1] = time.monotonic()
+            self._worker_state[i][0] = None
+            self._requeue_lost(ordinal if isinstance(ordinal, int) else None,
+                               f"worker thread {i} death")
+        if (self._reaped and self._threads
+                and not any(t.is_alive() for t in self._threads)
+                and self._out_queue.empty()):
+            if self._stop_on_failure:
+                self.stop()
+            raise WorkerError("All worker threads died; no result will"
+                              " arrive", kind="infra")
+
     def get(self, timeout: Optional[float] = None) -> Any:
-        result = self._out_queue.get(timeout=timeout)
-        # releases are bounded by successful gets, which are bounded by
-        # acquired puts: a ValueError here would be a real accounting bug
-        self._out_slots.release()
-        if isinstance(result, _Failure):
-            self.stop()
-            raise WorkerError(f"Worker failed:\n{result.formatted}")
-        self._consumed += 1
-        if self._telemetry.enabled:
-            self._telemetry.gauge("pool.results_queue_depth").set(
-                self._out_queue.qsize())
-        return result
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                result = self._out_queue.get(timeout=_POLL_S)
+            except queue.Empty:
+                self._service_faults()
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                continue
+            # releases are bounded by successful gets, which are bounded by
+            # acquired puts: a ValueError here would be a real accounting bug
+            self._out_slots.release()
+            if isinstance(result, _Failure):
+                if self._deliver_failure(result):
+                    continue  # infra failure absorbed by a requeue
+            if not self._settle(result.ordinal):
+                # requeue duplicate (original result surfaced after its
+                # worker died): drop it - the first delivery already counted
+                continue
+            self._consumed += 1
+            if self._telemetry.enabled:
+                self._telemetry.gauge("pool.results_queue_depth").set(
+                    self._out_queue.qsize())
+            return result.value
 
     def stop(self) -> None:
         self._stopped = True
@@ -481,13 +786,17 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
     contract as ThreadedExecutor's ``workers_busy``, crossing the process
     boundary via shared memory.  Wall clock (time.time), not monotonic:
     monotonic clocks are not comparable across processes on all platforms.
-    Reads of the PAIR can tear: each 8-byte slot is individually atomic and
-    the write order (timestamp before ordinal) prevents the harmful pairing
-    of a NEW item with an OLD idle-since time, but a diagnostics read landing
-    between the two stores may still pair the new timestamp with the
-    previous ordinal (or an idle marker) for one sample — diagnostics
-    consumers must treat a single odd ``workers_busy`` entry as noise, not
-    evidence.
+    Reads of the PAIR can tear: each 8-byte slot is individually atomic but
+    the pair is not.  The write order here (timestamp BEFORE ordinal) plus
+    the double-read validation on the reading side
+    (``_ProcessExecutor._read_heartbeat``: ordinal, timestamp, ordinal
+    again, retry when the ordinal moved) guarantees a sample never pairs a
+    new ordinal with a stale timestamp — a torn pair can no longer report a
+    bogus stall (PR 1 caveat, since fixed).
+
+    The heartbeat doubles as the crash ledger: a worker that dies mid-item
+    (OOM kill, segfault) leaves its ordinal in the slot, which is how the
+    parent knows exactly which work item to requeue onto surviving workers.
     """
     try:
         fn = worker_factory()
@@ -504,19 +813,24 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
             continue
         if item is _ProcessExecutor._STOP_SENTINEL_VALUE:
             break
+        ordinal = getattr(item, "ordinal", None)
         if heartbeats is not None:
             try:
-                ordinal = float(item.ordinal)
-            except (AttributeError, TypeError, ValueError):
-                ordinal = -2.0  # busy, ordinal unknown
+                hb_ordinal = float(ordinal)
+            except (TypeError, ValueError):
+                hb_ordinal = -2.0  # busy, ordinal unknown
             # timestamp before ordinal (same reasoning as the thread pool:
             # a concurrent read must never pair a new item with an old time)
             heartbeats[base + 1] = time.time()
-            heartbeats[base] = ordinal
+            heartbeats[base] = hb_ordinal
         try:
-            result = fn(item)
+            result = _Ok(ordinal, fn(item))
         except BaseException as exc:  # noqa: BLE001
-            result = _Failure(exc)
+            if getattr(exc, "petastorm_tpu_simulated_crash", False):
+                # chaos harness: die exactly like an OOM kill - no result,
+                # no traceback, heartbeat left naming the in-flight item
+                os._exit(17)
+            result = _Failure(exc, ordinal=ordinal, item=item)
         out_queue.put(result)
         if heartbeats is not None:
             heartbeats[base] = -1.0
@@ -543,12 +857,15 @@ class _ProcessExecutor(ExecutorBase):
                  in_queue_size: Optional[int] = None,
                  use_shm: Optional[bool] = None,
                  shm_size_bytes: int = DEFAULT_SHM_BYTES,
-                 telemetry=None):
+                 telemetry=None,
+                 stop_on_failure: bool = True,
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
         # telemetry: the PARENT process records ventilation/queue waits;
         # worker-side stage metrics recorded in the spawned processes stay
         # there (PETASTORM_TPU_TELEMETRY is inherited, so each child records
         # independently) - thread pool gives one merged report
-        super().__init__(telemetry=telemetry)
+        super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
+                         max_requeue_attempts=max_requeue_attempts)
         import multiprocessing as mp
 
         self._ctx = mp.get_context("spawn")
@@ -557,6 +874,7 @@ class _ProcessExecutor(ExecutorBase):
         self._out_queue = self._ctx.Queue(results_queue_size)
         self._stop_event = self._ctx.Event()
         self._procs = []
+        self._reaped: set = set()
         self._arena = None
         self._heartbeats = None
         self._shm_size_bytes = shm_size_bytes
@@ -592,42 +910,132 @@ class _ProcessExecutor(ExecutorBase):
         if self._stopped:
             raise ReaderClosedError("Executor is stopped")
         t0 = time.perf_counter() if self._telemetry.enabled else None
-        while True:
-            try:
-                self._in_queue.put(item, timeout=_POLL_S)
-                self._ventilated += 1
-                if t0 is not None:
-                    self._m_input_full.add(time.perf_counter() - t0)
-                return
-            except queue.Full:
-                if self._stopped:
-                    raise ReaderClosedError("Executor stopped while putting")
-                if cancel_event is not None and cancel_event.is_set():
-                    raise VentilationCancelled()
+        # ledger entry BEFORE the enqueue: a fast worker's result can reach
+        # the consumer's _settle before this thread runs again, and an
+        # unregistered ordinal would make that legitimate delivery look like
+        # a requeue duplicate (silently dropped -> lost rows)
+        self._track_put(item)
+        try:
+            while True:
+                try:
+                    self._in_queue.put(item, timeout=_POLL_S)
+                    self._ventilated += 1
+                    if t0 is not None:
+                        self._m_input_full.add(time.perf_counter() - t0)
+                    return
+                except queue.Full:
+                    if self._stopped:
+                        raise ReaderClosedError("Executor stopped while putting")
+                    if cancel_event is not None and cancel_event.is_set():
+                        raise VentilationCancelled()
+        except BaseException:
+            # the item never made it into the queue: retract the ledger
+            # entry so it cannot be mistaken for lost in-flight work
+            self._settle(getattr(item, "ordinal", None))
+            raise
+
+    def _read_heartbeat(self, index: int):
+        """Torn-read-safe sample of worker ``index``'s heartbeat pair.
+
+        The worker writes timestamp-then-ordinal; each 8-byte slot is atomic
+        but the pair is not.  Reading ordinal, timestamp, ordinal-again and
+        retrying while the ordinal moved guarantees the returned timestamp
+        belongs to (or postdates) the returned ordinal - a torn pair can
+        never pair a NEW ordinal with a STALE timestamp and report a bogus
+        stall.  Returns (ordinal float, since float): -1.0 = idle, -2.0 =
+        busy on an ordinal-less item.
+        """
+        hb = self._heartbeats
+        base = 2 * index
+        ordinal = hb[base]
+        since = hb[base + 1]
+        for _ in range(3):
+            again = hb[base]
+            if again == ordinal:
+                break
+            ordinal = again
+            since = hb[base + 1]
+        return ordinal, since
+
+    def _try_enqueue(self, item: Any) -> bool:
+        try:
+            self._in_queue.put_nowait(item)
+            return True
+        except queue.Full:
+            return False
+
+    def _service_faults(self) -> None:
+        """Reap dead worker processes, requeueing the item each one held
+        (named by its crash-ledger heartbeat), and flush parked requeues."""
+        self._flush_pending_requeues()
+        if self._stopped or self._stop_event.is_set():
+            return
+        for i, p in enumerate(self._procs):
+            if p.is_alive() or i in self._reaped:
+                continue
+            self._reaped.add(i)
+            ordinal = None
+            if self._heartbeats is not None:
+                hb_ordinal, _since = self._read_heartbeat(i)
+                if hb_ordinal >= 0:
+                    ordinal = int(hb_ordinal)
+                elif hb_ordinal == -2.0:
+                    logger.warning(
+                        "Worker process %d died holding an ordinal-less work"
+                        " item; it cannot be requeued", i)
+            logger.warning(
+                "Worker process %d (pid %s) died with exit code %s while on"
+                " item %s (possible crash/OOM)", i, p.pid, p.exitcode,
+                ordinal if ordinal is not None else "<none>")
+            if self._heartbeats is not None:
+                # clear the crash ledger BEFORE the (possibly raising)
+                # requeue so diagnostics never report a phantom stuck
+                # worker (the owner is dead; no write race)
+                self._heartbeats[2 * i + 1] = time.time()
+                self._heartbeats[2 * i] = -1.0
+            self._requeue_lost(
+                ordinal, f"worker process {i} death (exit code {p.exitcode})")
+        # Residual window, deliberately NOT reconciled: a SIGKILL landing in
+        # the few instructions between a worker's in_queue.get and its
+        # heartbeat stamp loses the item without naming it (the ledger holds
+        # it, nobody delivers it).  Detecting that state from here would
+        # need mp.Queue emptiness, which is advisory (the feeder thread
+        # buffers) - a reconciliation attempt built on it demonstrably
+        # misfired on healthy pipelines.  The stall watchdog
+        # (PETASTORM_TPU_STALL_WARN_S / _ABORT_S) is the designated backstop
+        # for exactly this class of unattributable loss.
 
     def get(self, timeout: Optional[float] = None) -> Any:
-        import time
-
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             try:
                 result = self._out_queue.get(timeout=_POLL_S)
-                break
             except queue.Empty:
+                self._service_faults()
                 if deadline is not None and time.monotonic() > deadline:
                     raise
                 if self._procs and not any(p.is_alive() for p in self._procs):
+                    if self._stop_on_failure:
+                        self.stop()
                     raise WorkerError("All worker processes died (possible crash/OOM);"
-                                      " no result will arrive")
-        if isinstance(result, _Failure):
-            self.stop()
-            raise WorkerError(f"Worker failed:\n{result.formatted}")
-        if self._arena is not None:
-            from petastorm_tpu.native.transport import decode_batch
+                                      " no result will arrive", kind="infra")
+                continue
+            if isinstance(result, _Failure):
+                if self._deliver_failure(result):
+                    continue  # infra failure absorbed by a requeue
+            ordinal, value = ((result.ordinal, result.value)
+                              if isinstance(result, _Ok) else (None, result))
+            settled = self._settle(ordinal)
+            if self._arena is not None:
+                from petastorm_tpu.native.transport import decode_batch
 
-            result = decode_batch(self._arena, result)
-        self._consumed += 1
-        return result
+                # decode duplicates too: the encoded descriptor pins arena
+                # slots that only the decoded view's lifetime releases
+                value = decode_batch(self._arena, value)
+            if not settled:
+                continue  # requeue duplicate: first delivery already counted
+            self._consumed += 1
+            return value
 
     def stop(self) -> None:
         self._stopped = True
@@ -661,14 +1069,15 @@ class _ProcessExecutor(ExecutorBase):
             now = time.time()
             busy = []
             for i in range(self._workers_count):
-                ordinal = self._heartbeats[2 * i]
+                # double-read-validated pair: a torn read can no longer pair
+                # a new ordinal with a stale timestamp (bogus stall)
+                ordinal, since = self._read_heartbeat(i)
                 if ordinal != -1.0:  # -1 = idle; -2 = busy, ordinal unknown
                     # clamp: the worker may stamp a newer wall-clock time
                     # between our `now` snapshot and this read (and
                     # time.time() can step backwards under NTP)
                     busy.append((i, int(ordinal) if ordinal >= 0 else "?",
-                                 round(max(0.0, now
-                                           - self._heartbeats[2 * i + 1]), 3)))
+                                 round(max(0.0, now - since), 3)))
             diag["workers_busy"] = busy
         if self._arena is not None:
             diag["shm_free_bytes"] = self._arena.free_bytes()
@@ -677,16 +1086,30 @@ class _ProcessExecutor(ExecutorBase):
 
 def make_executor(kind: str = "thread", workers_count: int = 3,
                   results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
-                  telemetry=None) -> ExecutorBase:
-    """'thread' | 'process' | 'serial' (reference: reader_pool_type, reader.py:139-150)."""
+                  telemetry=None, stop_on_failure: bool = True,
+                  max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
+                  ) -> ExecutorBase:
+    """'thread' | 'process' | 'serial' (reference: reader_pool_type, reader.py:139-150).
+
+    ``stop_on_failure=False`` keeps the pool alive when a worker failure is
+    delivered at ``get`` (the reader's ``on_error`` skip policies);
+    ``max_requeue_attempts`` bounds the transparent re-ventilation of items
+    lost to worker crashes.
+    """
     if kind == "thread":
         return ThreadedExecutor(workers_count, results_queue_size,
-                                telemetry=telemetry)
+                                telemetry=telemetry,
+                                stop_on_failure=stop_on_failure,
+                                max_requeue_attempts=max_requeue_attempts)
     if kind == "process":
         return _ProcessExecutor(workers_count, results_queue_size,
-                                telemetry=telemetry)
+                                telemetry=telemetry,
+                                stop_on_failure=stop_on_failure,
+                                max_requeue_attempts=max_requeue_attempts)
     if kind in ("serial", "dummy"):
-        return SerialExecutor(telemetry=telemetry)
+        return SerialExecutor(telemetry=telemetry,
+                              stop_on_failure=stop_on_failure,
+                              max_requeue_attempts=max_requeue_attempts)
     raise PetastormTpuError(f"Unknown executor kind {kind!r}")
 
 

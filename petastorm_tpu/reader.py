@@ -39,15 +39,17 @@ import numpy as np
 
 from petastorm_tpu.batch import ColumnBatch
 from petastorm_tpu.cache import make_cache
-from petastorm_tpu.errors import (EpochNotFinishedError, MetadataError,
-                                  NoDataAvailableError, PetastormTpuError,
-                                  ReaderClosedError)
+from petastorm_tpu.errors import (EpochNotFinishedError,
+                                  ErrorBudgetExceededError, ErrorPolicy,
+                                  MetadataError, NoDataAvailableError,
+                                  PetastormTpuError, ReaderClosedError,
+                                  resolve_error_policy)
 from petastorm_tpu.etl.indexing import get_row_group_indexes
 from petastorm_tpu.etl.metadata import open_dataset
 from petastorm_tpu.fs import FilesystemFactory
 from petastorm_tpu.plan import ElasticResumePlan, ReadPlan, elastic_resume_plan
-from petastorm_tpu.pool import (Ventilator, WorkerError, _env_seconds,
-                                make_executor)
+from petastorm_tpu.pool import (DEFAULT_REQUEUE_ATTEMPTS, Ventilator,
+                                WorkerError, _env_seconds, make_executor)
 from petastorm_tpu.schema import Schema
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 from petastorm_tpu.transform import TransformSpec, transform_schema
@@ -91,7 +93,9 @@ def make_reader(dataset_url: str,
                 decode_placement: Optional[Dict[str, str]] = None,
                 ngram=None,
                 io_retries="auto",
-                telemetry=None) -> "Reader":
+                telemetry=None,
+                on_error="raise",
+                chaos=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
     Reference: ``make_reader`` (reader.py:59-176).  Yields one namedtuple row per
@@ -118,6 +122,21 @@ def make_reader(dataset_url: str,
     whole pipeline, or set ``PETASTORM_TPU_TELEMETRY=1`` to enable the
     process-wide recorder without touching code.  The resolved recorder is
     exposed as ``Reader.telemetry`` (``reader.telemetry.pipeline_report()``).
+
+    ``on_error``: worker-failure policy (docs/operations.md "Failure
+    handling").  ``'raise'`` (default) fails the read on the first worker
+    failure - today's behavior.  ``'skip'`` quarantines rowgroups that fail
+    with *data* errors (corrupt file, codec/transform exception) and keeps
+    reading; an ``errors.ErrorPolicy`` adds budgets
+    (``max_skipped_rowgroups`` / ``max_skipped_fraction``, exceeded ->
+    ``ErrorBudgetExceededError``).  Independently of this knob,
+    *infrastructure* failures (worker process crash/OOM) transparently
+    requeue the lost work items onto surviving workers.  Skipped rowgroups
+    are listed in ``Reader.diagnostics['quarantined_rowgroups']`` and
+    counted in telemetry (``errors.skipped_rowgroups``).
+
+    ``chaos``: deterministic fault injection for tests/benchmarks
+    (``petastorm_tpu.test_util.chaos.ChaosSpec``); never set in production.
     """
     return _make_reader_impl(dataset_url, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -129,7 +148,8 @@ def make_reader(dataset_url: str,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
-                             io_retries=io_retries, telemetry=telemetry)
+                             io_retries=io_retries, telemetry=telemetry,
+                             on_error=on_error, chaos=chaos)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -182,13 +202,15 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       decode_placement: Optional[Dict[str, str]] = None,
                       ngram=None,
                       io_retries="auto",
-                      telemetry=None) -> "Reader":
+                      telemetry=None,
+                      on_error="raise",
+                      chaos=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
 
     Reference: ``make_batch_reader`` (reader.py:179-290).  Yields one namedtuple of
-    column arrays per decoded rowgroup.  ``io_retries``/``telemetry``: see
-    ``make_reader``.
+    column arrays per decoded rowgroup.  ``io_retries``/``telemetry``/
+    ``on_error``/``chaos``: see ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -200,7 +222,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
-                             io_retries=io_retries, telemetry=telemetry)
+                             io_retries=io_retries, telemetry=telemetry,
+                             on_error=on_error, chaos=chaos)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -213,8 +236,21 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       resume_from: Optional[dict] = None, ngram=None,
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
-                      io_retries="auto", telemetry=None) -> "Reader":
+                      io_retries="auto", telemetry=None,
+                      on_error="raise", chaos=None) -> "Reader":
     telemetry = _resolve_telemetry(telemetry)
+    error_policy = resolve_error_policy(on_error)
+    if chaos is not None and chaos.affects_filesystem():
+        # transient-IO chaos lives in the filesystem layer so it exercises
+        # the REAL retry paths (worker rowgroup reads and metadata opens);
+        # the wrapped fs is a non-local PyFileSystem, so io_retries='auto'
+        # arms exactly as it would against GCS/S3
+        from petastorm_tpu.fs import get_filesystem_and_path
+
+        base_fs, _ = get_filesystem_and_path(
+            dataset_url if isinstance(dataset_url, str) else dataset_url[0],
+            storage_options, filesystem)
+        filesystem = chaos.wrap_filesystem(base_fs)
     if ngram is not None and batched_output:
         raise PetastormTpuError(
             "NGram is not supported by make_batch_reader (reference parity,"
@@ -241,7 +277,7 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         info = open_dataset(dataset_url, storage_options=storage_options,
                             filesystem=filesystem,
                             require_stored_schema=require_stored_schema,
-                            io_retries=io_retries)
+                            io_retries=io_retries, telemetry=telemetry)
     except MetadataError as exc:
         if require_stored_schema:
             raise MetadataError(
@@ -343,6 +379,10 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    retry_policy=resolve_retry_policy(
                                        io_retries, info.filesystem),
                                    telemetry=telemetry)
+    if chaos is not None and chaos.affects_worker():
+        from petastorm_tpu.test_util.chaos import ChaosWorker
+
+        worker = ChaosWorker(worker, chaos)
 
     if workers_count == "auto":
         # size to the usable cores (cgroup/affinity-aware), one left for the
@@ -352,8 +392,15 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         except AttributeError:
             cores = os.cpu_count() or 1
         workers_count = max(1, min(10, cores - 1))
-    executor = make_executor(reader_pool_type, workers_count,
-                             results_queue_size, telemetry=telemetry)
+    executor = make_executor(
+        reader_pool_type, workers_count, results_queue_size,
+        telemetry=telemetry,
+        # skip policies need the pool to survive delivered failures so the
+        # consumer can quarantine the item and keep iterating
+        stop_on_failure=error_policy is None,
+        max_requeue_attempts=(error_policy.max_requeue_attempts
+                              if error_policy is not None
+                              else DEFAULT_REQUEUE_ATTEMPTS))
     start_item = 0
     if resume_from is not None and "elastic" not in resume_from:
         if "elastic_rebased" in resume_from:
@@ -373,7 +420,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             start_item = int(resume_from.get("position", 0))
     reader = Reader(info=info, schema=output_schema, plan=plan, executor=executor,
                     worker=worker, num_epochs=num_epochs, batched_output=batched_output,
-                    start_item=start_item, ngram=ngram, telemetry=telemetry)
+                    start_item=start_item, ngram=ngram, telemetry=telemetry,
+                    error_policy=error_policy)
     #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
     reader.device_decode_fields = device_fields
     #: subset using the mixed-geometry object wire format ('device-mixed')
@@ -474,7 +522,8 @@ class Reader:
 
     def __init__(self, info, schema: Schema, plan: ReadPlan, executor, worker,
                  num_epochs: Optional[int], batched_output: bool,
-                 start_item: int = 0, ngram=None, telemetry=None):
+                 start_item: int = 0, ngram=None, telemetry=None,
+                 error_policy: Optional[ErrorPolicy] = None):
         #: petastorm_tpu.telemetry recorder shared by the whole pipeline
         #: (no-op unless enabled); ``reader.telemetry.pipeline_report()``
         #: renders the stage-utilization bottleneck summary
@@ -483,6 +532,11 @@ class Reader:
             "queue.results_empty_wait_s")
         self._m_rows_emitted = self.telemetry.counter("reader.rows_emitted")
         self._m_batches = self.telemetry.counter("reader.batches_consumed")
+        self._m_skipped = self.telemetry.counter("errors.skipped_rowgroups")
+        #: resolved ``on_error`` policy (None = raise mode)
+        self._error_policy = error_policy
+        #: quarantine ledger: one entry per skipped work item
+        self._quarantine: list = []
         self.dataset_info = info
         self.schema = schema
         self.batched_output = batched_output
@@ -628,6 +682,14 @@ class Reader:
             t0 = time.perf_counter() if tele.enabled else None
             try:
                 batch = self._executor.get(timeout=_GET_TIMEOUT_S)
+            except WorkerError as exc:
+                if t0 is not None:
+                    self._m_results_empty.add(time.perf_counter() - t0)
+                # on_error skip policies quarantine attributable failures
+                # and keep iterating; anything else propagates
+                self._skip_or_raise(exc)
+                last_progress = time.monotonic()
+                continue
             except queue.Empty:
                 if t0 is not None:
                     self._m_results_empty.add(time.perf_counter() - t0)
@@ -655,13 +717,7 @@ class Reader:
                 self._m_batches.add(1)
                 self._m_rows_emitted.add(batch.num_rows)
             last_progress = time.monotonic()
-            self._consumed_items += 1
-            if batch.ordinal is not None:
-                self._ordinals_seen = True
-                self._consumed_ordinals.add(batch.ordinal)
-                while self._prefix in self._consumed_ordinals:
-                    self._consumed_ordinals.discard(self._prefix)
-                    self._prefix += 1
+            self._account_consumed(batch.ordinal)
             if batch.num_rows > 0:
                 if self.batched_output and self._all_items_consumed():
                     # batch path: flag as the final value is returned; the row
@@ -670,6 +726,84 @@ class Reader:
                 return batch
             # empty batch (predicate filtered everything): keep pulling
 
+    def _account_consumed(self, ordinal) -> None:
+        """Count one work item as consumed and advance the exact contiguous
+        consumed prefix - the resume-cursor invariant (state_dict position
+        exactness under out-of-order pools).  The single implementation
+        serves both delivered batches and policy-skipped items."""
+        self._consumed_items += 1
+        if ordinal is not None:
+            self._ordinals_seen = True
+            self._consumed_ordinals.add(ordinal)
+            while self._prefix in self._consumed_ordinals:
+                self._consumed_ordinals.discard(self._prefix)
+                self._prefix += 1
+
+    # -- failure handling (docs/operations.md "Failure handling") -------------
+
+    def _skip_or_raise(self, exc: WorkerError) -> None:
+        """Quarantine an attributable worker failure under a skip policy.
+
+        Unattributable failures (all workers died, stall abort - no work
+        item to blame) and failures under the default ``on_error='raise'``
+        propagate unchanged.  A skipped item still counts toward epoch
+        accounting: the epoch ends at the same counted event, just with the
+        quarantined rowgroup's rows missing - exactly once, never duplicated.
+        """
+        policy = self._error_policy
+        if policy is None or exc.item is None:
+            if policy is not None:
+                # terminal under a skip policy (all workers died, or another
+                # unattributable failure): the pool was constructed with
+                # stop_on_failure=False, so stop the pipeline here - a
+                # caller that catches this must not inherit a live
+                # ventilator + polling workers (same contract as the
+                # stall-abort path)
+                self.stop()
+            raise exc
+        work = getattr(exc.item, "item", exc.item)
+        rg = getattr(work, "row_group", None)
+        message = str(exc)
+        entry = {"ordinal": exc.ordinal,
+                 "path": getattr(rg, "path", None),
+                 "row_group": getattr(rg, "row_group", None),
+                 "kind": exc.kind,
+                 "exc_type": exc.exc_type,
+                 # last traceback line = the remote exception message
+                 "error": message.splitlines()[-1] if message else ""}
+        self._quarantine.append(entry)
+        self._m_skipped.add(1)
+        logger.warning(
+            "Skipping work item %s (rowgroup %s#%s) after %s error: %s",
+            exc.ordinal, entry["path"], entry["row_group"], exc.kind,
+            entry["error"])
+        self._account_consumed(exc.ordinal)
+        skipped = len(self._quarantine)
+        over = None
+        if (policy.max_skipped_rowgroups is not None
+                and skipped > policy.max_skipped_rowgroups):
+            over = (f"{skipped} skipped work items exceed"
+                    f" max_skipped_rowgroups={policy.max_skipped_rowgroups}")
+        if over is None and policy.max_skipped_fraction is not None:
+            # finite readers: fraction of the total expected items.  Infinite
+            # readers (num_epochs=None) have no total: use items consumed so
+            # far, floored at one epoch - a constant per-epoch corruption
+            # rate then yields a constant fraction instead of a cumulative
+            # count that would eventually trip any budget
+            denom = self._expected_items
+            if denom is None:
+                denom = max(self._ventilator.items_per_epoch,
+                            self._consumed_items)
+            if denom and skipped / denom > policy.max_skipped_fraction:
+                over = (f"{skipped}/{denom} skipped work items exceed"
+                        f" max_skipped_fraction="
+                        f"{policy.max_skipped_fraction}")
+        if over is not None:
+            self.stop()
+            raise ErrorBudgetExceededError(
+                f"Error budget exceeded: {over}. Quarantined rowgroups: "
+                + ", ".join(f"{e['path']}#{e['row_group']}"
+                            for e in self._quarantine)) from exc
 
     # -- epoch control --------------------------------------------------------
 
@@ -779,11 +913,25 @@ class Reader:
 
     @property
     def diagnostics(self) -> dict:
-        """Observability snapshot: items consumed/expected, epoch position, pool queue depths, worker profile samples (when enabled)."""
+        """Observability snapshot: items consumed/expected, epoch position,
+        pool queue depths, and the fault ledger (skipped/quarantined
+        rowgroups, requeued items)."""
         return {**self._executor.diagnostics,
                 "items_per_epoch": self._ventilator.items_per_epoch,
                 "consumed_items": self._consumed_items,
-                "expected_items": self._expected_items}
+                "expected_items": self._expected_items,
+                "skipped_rowgroups": len(self._quarantine),
+                # bounded tail: diagnostics is interpolated into stall
+                # WARNINGs, and a long degraded run must not turn every log
+                # line into the full ledger (quarantined_rowgroups property
+                # has it all; the count above is always exact)
+                "quarantined_rowgroups": list(self._quarantine[-20:])}
+
+    @property
+    def quarantined_rowgroups(self) -> list:
+        """Skipped-work-item ledger under an ``on_error`` skip policy: one
+        dict per skip (ordinal, path, row_group, kind, exc_type, error)."""
+        return list(self._quarantine)
 
     @property
     def declared_geometries(self) -> dict:

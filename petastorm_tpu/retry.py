@@ -57,11 +57,19 @@ def is_transient(exc: BaseException) -> bool:
 
 def retry_call(fn: Callable, policy: Optional[RetryPolicy], *, what: str = "io",
                on_retry: Optional[Callable[[BaseException], None]] = None,
-               sleep: Callable[[float], None] = time.sleep):
+               sleep: Callable[[float], None] = time.sleep,
+               telemetry=None):
     """Run ``fn``, retrying transient failures per ``policy`` (None = no retry).
 
     ``on_retry(exc)`` runs before each re-attempt - the hook where callers
     drop possibly-poisoned cached handles/connections.
+
+    Every re-attempt is recorded in telemetry (the passed recorder, or the
+    process default when ``PETASTORM_TPU_TELEMETRY=1``): an ``io.retries``
+    counter plus a per-category ``io.retries.<category>`` counter keyed by
+    the first token of ``what`` ("rowgroup", "dataset", ...), and a trace
+    instant carrying the full ``what`` - so recurring weather shows up in
+    ``petastorm-tpu-diagnose`` reports, not only in log warnings.
     """
     if policy is None:
         return fn()
@@ -77,6 +85,7 @@ def retry_call(fn: Callable, policy: Optional[RetryPolicy], *, what: str = "io",
             logger.warning("Transient IO failure in %s (attempt %d/%d): %s;"
                            " retrying in %.2fs", what, attempt,
                            policy.max_attempts, exc, delay)
+            _record_retry(telemetry, what, exc)
             if on_retry is not None:
                 try:
                     on_retry(exc)
@@ -84,6 +93,22 @@ def retry_call(fn: Callable, policy: Optional[RetryPolicy], *, what: str = "io",
                     logger.debug("on_retry hook failed", exc_info=True)
             sleep(delay)
             backoff *= policy.backoff_multiplier
+
+
+def _record_retry(telemetry, what: str, exc: BaseException) -> None:
+    """Count one retry (resolved lazily: only the retry path pays for it)."""
+    from petastorm_tpu.telemetry import resolve as _resolve_telemetry
+
+    tele = _resolve_telemetry(telemetry)
+    if not tele.enabled:
+        return
+    tele.counter("io.retries").add(1)
+    category = what.split(" ", 1)[0] if what else "io"
+    tele.counter(f"io.retries.{category}").add(1)
+    trace = getattr(tele, "trace", None)
+    if trace is not None:
+        trace.add("io-retry", "fault", time.perf_counter_ns(), 0,
+                  {"what": what, "error": str(exc)})
 
 
 def resolve_retry_policy(io_retries: Union[None, bool, int, str, RetryPolicy],

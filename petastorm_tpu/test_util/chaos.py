@@ -1,0 +1,231 @@
+"""Deterministic chaos injection for the ingest pipeline.
+
+Degradation under faults must be measurable, not anecdotal: this module
+injects the four production failure modes - poisoned data (decode failures),
+slow items, transient IO errors, and hard worker kills (OOM/segfault) -
+deterministically by seed and work-item ordinal, so a chaos run is exactly
+reproducible and its assertions are exact ("these rowgroups were skipped",
+"this many retries fired"), not statistical.
+
+Usable from three places:
+
+* tests: ``make_reader(url, chaos=ChaosSpec(...), on_error='skip')``
+* the benchmark CLI: ``petastorm-tpu-throughput <url> --chaos
+  'decode_fail_rate=0.01,kill_ordinals=5'`` measures throughput *under*
+  faults
+* directly: ``ChaosWorker`` wraps any pool worker factory
+
+Injection points are chosen to exercise the REAL recovery paths:
+
+* decode failures raise :class:`~petastorm_tpu.errors.CodecError` from
+  inside the worker function - the pool classifies them as *data* errors
+  and the reader's ``on_error`` policy skips + quarantines them;
+* hard kills terminate the worker *process* with ``os._exit`` (spawned
+  pools - indistinguishable from an OOM kill) or simulate a crash in
+  thread/serial pools via :class:`SimulatedWorkerCrash`; either way the
+  pool's crash ledger requeues the lost item onto surviving workers;
+* transient IO failures are injected in the *filesystem* layer
+  (test_util.latency_fs), beneath the worker's ``retry_call`` - so
+  ``io_retries`` absorbs them exactly as it absorbs real object-store
+  weather, and ``io.retries`` telemetry counts them.
+
+Kills are gated on ``attempt == 0`` by default: a requeued item
+(``VentilatedItem.attempt > 0``) does not re-trigger the kill, so "one
+killed worker" means one - the requeue lands on a surviving worker and the
+epoch completes.  ``kill_on_retry=True`` removes the gate for cascade-death
+scenarios (testing the "all workers died" path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import zlib
+from typing import Tuple
+
+from petastorm_tpu.errors import CodecError, PetastormTpuError
+
+
+class SimulatedWorkerCrash(BaseException):
+    """Simulates a hard worker death in pools that cannot lose a real
+    process (thread/serial).  BaseException so ordinary ``except Exception``
+    user code cannot swallow it; the pool worker loop recognizes the marker
+    attribute and dies without delivering a result, exactly like a crashed
+    process (heartbeat left set -> item requeued from the crash ledger)."""
+
+    petastorm_tpu_simulated_crash = True
+
+
+def _in_process_pool_worker() -> bool:
+    """True inside one of THIS library's spawned pool worker processes.
+
+    Keyed on the worker process name the pool assigns
+    (``petastorm-tpu-worker-N``), not on merely having a multiprocessing
+    parent - a thread/serial-pool reader running inside someone else's mp
+    child (a torch DataLoader worker, an mp-based test harness) must get
+    the simulated crash, never an ``os._exit`` of the host process.
+    """
+    import multiprocessing as mp
+
+    return (mp.parent_process() is not None
+            and mp.current_process().name.startswith("petastorm-tpu-worker"))
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """Declarative, seeded fault-injection plan.
+
+    Rates are deterministic per (seed, fault-kind, ordinal) - the same spec
+    over the same plan injects the same faults every run, in every worker,
+    on both sides of a process boundary.  Explicit ``*_ordinals`` tuples
+    pick exact items for precise tests.
+    """
+
+    seed: int = 0
+    #: decode failures (CodecError -> data error -> skip/quarantine path)
+    decode_fail_rate: float = 0.0
+    decode_fail_ordinals: Tuple[int, ...] = ()
+    #: slow items (sleep slow_s before processing)
+    slow_rate: float = 0.0
+    slow_ordinals: Tuple[int, ...] = ()
+    slow_s: float = 0.05
+    #: hard worker kills (process: os._exit; thread/serial: SimulatedWorkerCrash)
+    kill_rate: float = 0.0
+    kill_ordinals: Tuple[int, ...] = ()
+    kill_on_retry: bool = False
+    #: transient IO failures + latency, injected via test_util.latency_fs
+    fail_first_reads: int = 0
+    fail_first_opens: int = 0
+    io_latency_s: float = 0.0
+
+    def __post_init__(self):
+        for name in ("decode_fail_rate", "slow_rate", "kill_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise PetastormTpuError(f"ChaosSpec.{name} must be in [0, 1]")
+        # tolerate bare ints / lists in the ordinal fields (CLI parsing,
+        # hand-written tests)
+        for name in ("decode_fail_ordinals", "slow_ordinals", "kill_ordinals"):
+            v = getattr(self, name)
+            if isinstance(v, int):
+                object.__setattr__(self, name, (v,))
+            elif not isinstance(v, tuple):
+                object.__setattr__(self, name, tuple(v))
+
+    # -- parsing (benchmark CLI --chaos) --------------------------------------
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosSpec":
+        """Parse ``'key=value,key=value'`` (ordinal lists use ``;``):
+        ``'decode_fail_rate=0.01,kill_ordinals=3;7,seed=2'``."""
+        kwargs = {}
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        for part in filter(None, (p.strip() for p in text.split(","))):
+            if "=" not in part:
+                raise PetastormTpuError(
+                    f"--chaos entries must be key=value, got {part!r}")
+            key, _, raw = part.partition("=")
+            key = key.strip()
+            if key not in fields:
+                raise PetastormTpuError(
+                    f"Unknown chaos key {key!r}; valid: {sorted(fields)}")
+            if key.endswith("_ordinals"):
+                kwargs[key] = tuple(int(v) for v in raw.split(";") if v)
+            elif key == "kill_on_retry":
+                kwargs[key] = raw.strip().lower() in ("1", "true", "yes", "on")
+            elif key in ("seed", "fail_first_reads", "fail_first_opens"):
+                kwargs[key] = int(raw)
+            else:
+                kwargs[key] = float(raw)
+        return cls(**kwargs)
+
+    # -- what this spec touches -----------------------------------------------
+
+    def affects_worker(self) -> bool:
+        """True when the spec injects worker-side faults (decode failures,
+        slow items, kills) - make_reader wraps the worker factory then."""
+        return bool(self.decode_fail_rate or self.decode_fail_ordinals
+                    or self.slow_rate or self.slow_ordinals
+                    or self.kill_rate or self.kill_ordinals)
+
+    def affects_filesystem(self) -> bool:
+        """True when the spec injects filesystem faults (transient IO
+        failures, latency) - make_reader wraps the filesystem then."""
+        return bool(self.fail_first_reads or self.fail_first_opens
+                    or self.io_latency_s)
+
+    def wrap_filesystem(self, base):
+        """The transient-IO injection layer over ``base`` (a latency_fs
+        wrapper: non-local, picklable, counted)."""
+        from petastorm_tpu.test_util.latency_fs import latent_filesystem
+
+        fs, _stats = latent_filesystem(base, latency_s=self.io_latency_s,
+                                       fail_first_reads=self.fail_first_reads,
+                                       fail_first_opens=self.fail_first_opens)
+        return fs
+
+    # -- per-item decisions (deterministic) -----------------------------------
+
+    def _roll(self, kind: str, ordinal: int, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        h = zlib.crc32(f"{self.seed}:{kind}:{ordinal}".encode())
+        return h / 0xFFFFFFFF < rate
+
+    def should_fail_decode(self, ordinal: int) -> bool:
+        """Deterministic per-ordinal decision: inject a decode failure?"""
+        return (ordinal in self.decode_fail_ordinals
+                or self._roll("decode", ordinal, self.decode_fail_rate))
+
+    def should_slow(self, ordinal: int) -> bool:
+        """Deterministic per-ordinal decision: sleep ``slow_s`` first?"""
+        return (ordinal in self.slow_ordinals
+                or self._roll("slow", ordinal, self.slow_rate))
+
+    def should_kill(self, ordinal: int, attempt: int = 0) -> bool:
+        """Deterministic decision: hard-kill the worker handling this item?
+
+        Gated on ``attempt == 0`` unless ``kill_on_retry``: the requeued
+        item must land on a surviving worker, or "one kill" cascades."""
+        if attempt > 0 and not self.kill_on_retry:
+            return False
+        return (ordinal in self.kill_ordinals
+                or self._roll("kill", ordinal, self.kill_rate))
+
+
+class ChaosWorker:
+    """Pool worker-factory wrapper injecting the spec's worker-side faults.
+
+    Picklable (pool.WorkerFactory protocol) so the process pool spawns it;
+    decisions are pure functions of (spec, ordinal, attempt), so every
+    worker - thread or spawned process - injects identically.
+    """
+
+    def __init__(self, inner, spec: ChaosSpec):
+        self._inner = inner
+        self.spec = spec
+
+    def __call__(self):
+        fn = self._inner()
+        spec = self.spec
+
+        def chaotic(item):
+            ordinal = getattr(item, "ordinal", None)
+            if ordinal is not None:
+                attempt = getattr(item, "attempt", 0)
+                if spec.should_kill(ordinal, attempt):
+                    if _in_process_pool_worker():
+                        # the real thing: die like the OOM killer struck -
+                        # no result, no traceback, no cleanup
+                        os._exit(137)
+                    raise SimulatedWorkerCrash(
+                        f"chaos: hard-killed worker on item {ordinal}")
+                if spec.should_slow(ordinal):
+                    time.sleep(spec.slow_s)
+                if spec.should_fail_decode(ordinal):
+                    raise CodecError(
+                        f"chaos: injected decode failure on item {ordinal}")
+            return fn(item)
+
+        return chaotic

@@ -11,8 +11,16 @@ pyarrow filesystem in a :class:`pyarrow.fs.FileSystemHandler` that
   (``worker.py`` opens parquet with ``pre_buffer=True`` off local disk:
   a rowgroup's column chunks must arrive in FEW ranged reads, not one
   read per column),
-* optionally fails the first N reads with ``OSError`` (after sleeping),
-  so ``io_retries`` can be proven to compose with slow-then-failing calls.
+* optionally fails the first N reads and/or the first N file OPENS with
+  ``OSError`` (after sleeping), so ``io_retries`` can be proven to compose
+  with slow-then-failing calls on both the rowgroup-read path and the
+  metadata-open path (``retry.resolve_retry_policy`` consumers).
+
+The wrapper is picklable (over a picklable base filesystem): a spawned
+process-pool worker reconstructs its own copy, so fault injection reaches
+the real worker-process read path too.  Counters and failure countdowns are
+per-process after the spawn boundary - assert on the parent's copy for
+thread/serial pools, or treat child-side injections as best-effort.
 
 Being a ``PyFileSystem`` (not ``LocalFileSystem``), readers treat it as
 REMOTE: ``pre_buffer`` turns on and ``io_retries='auto'`` arms - the exact
@@ -44,6 +52,17 @@ class LatencyStats:
         self.meta_calls = 0
         self.failures_injected = 0
         self.slept_s = 0.0
+
+    def __getstate__(self):
+        # picklable across the process-pool spawn boundary; the lock is
+        # process-local and recreated on the other side
+        state = dict(self.__dict__)
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
 
     def add(self, **deltas) -> None:
         with self._lock:
@@ -135,12 +154,16 @@ class LatentFilesystemHandler(pafs.FileSystemHandler):
 
     def __init__(self, base: pafs.FileSystem, latency_s: float = 0.02,
                  stats: Optional[LatencyStats] = None,
-                 fail_first_reads: int = 0):
+                 fail_first_reads: int = 0,
+                 fail_first_opens: int = 0):
         self._base = base
         self._latency = latency_s
         self.stats = stats or LatencyStats()
         #: shared countdown: the first N read() calls across ALL files fail
         self._fail_reads = [int(fail_first_reads)]
+        #: shared countdown: the first N file opens (input file OR stream)
+        #: fail - exercises the metadata-open retry path, not just reads
+        self._fail_opens = [int(fail_first_opens)]
 
     def _meta(self):
         if self._latency > 0:
@@ -198,6 +221,9 @@ class LatentFilesystemHandler(pafs.FileSystemHandler):
 
     def open_input_stream(self, path):
         self._meta()
+        if self.stats.try_inject_failure(self._fail_opens):
+            raise OSError(
+                f"injected transient open failure (latency_fs): {path}")
         self.stats.add(opens=1)
         return pa.PythonFile(
             _LatentFile(self._base.open_input_stream(path), self._latency,
@@ -205,6 +231,9 @@ class LatentFilesystemHandler(pafs.FileSystemHandler):
 
     def open_input_file(self, path):
         self._meta()
+        if self.stats.try_inject_failure(self._fail_opens):
+            raise OSError(
+                f"injected transient open failure (latency_fs): {path}")
         self.stats.add(opens=1)
         return pa.PythonFile(
             _LatentFile(self._base.open_input_file(path), self._latency,
@@ -222,13 +251,16 @@ class LatentFilesystemHandler(pafs.FileSystemHandler):
 def latent_filesystem(base: Optional[pafs.FileSystem] = None,
                       latency_s: float = 0.02,
                       fail_first_reads: int = 0,
+                      fail_first_opens: int = 0,
                       ) -> Tuple[pafs.FileSystem, LatencyStats]:
     """A ready-to-use latent filesystem over ``base`` (default: local).
 
     Returns ``(fs, stats)``; pass ``fs`` to ``make_reader(...,
-    filesystem=fs)`` (thread/serial pools - the wrapper is in-process).
+    filesystem=fs)``.  With the process pool each spawned worker holds its
+    own unpickled copy (separate counters/countdowns).
     """
     handler = LatentFilesystemHandler(base or pafs.LocalFileSystem(),
                                       latency_s=latency_s,
-                                      fail_first_reads=fail_first_reads)
+                                      fail_first_reads=fail_first_reads,
+                                      fail_first_opens=fail_first_opens)
     return pafs.PyFileSystem(handler), handler.stats

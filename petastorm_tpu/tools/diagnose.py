@@ -58,16 +58,28 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--json", action="store_true",
                         help="print the raw telemetry snapshot as JSON"
                              " instead of the human-readable report")
+    parser.add_argument("--chaos", metavar="SPEC", default=None,
+                        help="diagnose under injected faults (same spec"
+                             " syntax as petastorm-tpu-throughput --chaos,"
+                             " e.g. 'decode_fail_rate=0.05,"
+                             "fail_first_reads=3')")
+    parser.add_argument("--on-error", default="raise",
+                        choices=("raise", "skip"),
+                        help="reader failure policy; 'skip' quarantines"
+                             " failing rowgroups (listed in the report)")
     return parser
 
 
 def run_diagnosis(dataset_url: str, method: str = "batch",
                   pool_type: str = "thread", workers_count: int = 3,
                   num_epochs: int = 1, max_batches: int = 0,
-                  telemetry: Optional[Telemetry] = None) -> dict:
+                  telemetry: Optional[Telemetry] = None,
+                  chaos=None, on_error: str = "raise") -> dict:
     """Read ``dataset_url`` with telemetry enabled; returns a result dict
-    with ``rows``, ``batches``, ``snapshot``, ``report`` and
-    ``dominant_stage`` (also the programmatic entry the tests use)."""
+    with ``rows``, ``batches``, ``snapshot``, ``report``,
+    ``dominant_stage`` and the reader's fault ledger
+    (``quarantined_rowgroups``) - also the programmatic entry the tests
+    use."""
     from petastorm_tpu.reader import make_batch_reader, make_reader
 
     tele = telemetry or Telemetry()
@@ -76,7 +88,8 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
     batches = 0
     with factory(dataset_url, reader_pool_type=pool_type,
                  workers_count=workers_count, num_epochs=num_epochs,
-                 shuffle_row_groups=False, telemetry=tele) as reader:
+                 shuffle_row_groups=False, telemetry=tele,
+                 chaos=chaos, on_error=on_error) as reader:
         if method == "batch":
             for batch in reader.iter_batches():
                 rows += batch.num_rows
@@ -86,10 +99,12 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
         else:
             for _ in reader:
                 rows += 1
+        quarantined = reader.quarantined_rowgroups
     snapshot = tele.snapshot()
     return {"rows": rows, "batches": batches, "snapshot": snapshot,
             "report": tele.pipeline_report(),
             "dominant_stage": dominant_stage(snapshot),
+            "quarantined_rowgroups": quarantined,
             "telemetry": tele}
 
 
@@ -107,17 +122,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             create_test_dataset(tmpdir, num_rows=args.rows,
                                 row_group_size_rows=args.row_group_size)
             url = tmpdir
+        chaos = None
+        if args.chaos:
+            from petastorm_tpu.test_util.chaos import ChaosSpec
+
+            chaos = ChaosSpec.parse(args.chaos)
         result = run_diagnosis(url, method=args.method,
                                pool_type=args.pool_type,
                                workers_count=args.workers_count,
                                num_epochs=args.num_epochs,
-                               max_batches=args.max_batches)
+                               max_batches=args.max_batches,
+                               chaos=chaos, on_error=args.on_error)
         if args.trace_out:
             result["telemetry"].export_chrome_trace(args.trace_out)
         if args.json:
             print(json.dumps({"rows": result["rows"],
                               "batches": result["batches"],
                               "dominant_stage": result["dominant_stage"],
+                              "quarantined_rowgroups":
+                                  result["quarantined_rowgroups"],
                               "snapshot": result["snapshot"]}))
         else:
             what = "synthetic dataset" if tmpdir else url
@@ -126,6 +149,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                      if args.method == "batch" else "")
                   + f" from {what}")
             print(result["report"])
+            for entry in result["quarantined_rowgroups"]:
+                print(f"quarantined: {entry['path']}#{entry['row_group']}"
+                      f" (work item {entry['ordinal']}, {entry['kind']}"
+                      f" error: {entry['error']})")
             if args.trace_out:
                 print(f"chrome trace written to {args.trace_out}"
                       " (load in Perfetto / chrome://tracing)")

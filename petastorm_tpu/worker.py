@@ -153,7 +153,8 @@ class RowGroupDecoderWorker:
                 self._retry_policy,
                 what=f"rowgroup {item.row_group.path}"
                      f"#{item.row_group.row_group}",
-                on_retry=drop_handle)
+                on_retry=drop_handle,
+                telemetry=tele)
             if tele.enabled:
                 tele.counter("worker.rowgroups_decoded").add(1)
                 tele.counter("worker.rows_decoded").add(batch.num_rows)
