@@ -89,6 +89,45 @@ print("chaos smoke OK (1 rowgroup quarantined, 1 kill requeued,"
       f" {len(rows)} healthy rows delivered)")
 PY
 
+echo "== hang-chaos smoke (liveness: hung workers killed + replaced, bounded time) =="
+# two PERMANENTLY hung process workers + item_deadline_s: the run must
+# COMPLETE with the exact row multiset and >= 2 hung-worker kills, inside a
+# hard timeout - the wedged-pipeline-recovers contract.  Runs from a real
+# file (not stdin): the process pool's spawn re-imports __main__.
+HANG_SMOKE="$(mktemp /tmp/petastorm_tpu_hang_smoke_XXXXXX.py)"
+cat > "$HANG_SMOKE" <<'PY'
+import tempfile
+import numpy as np
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.chaos import ChaosSpec
+
+if __name__ == "__main__":
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_hang_smoke_")
+    schema = Schema("HangSmoke", [Field("x", np.int64)])
+    write_dataset(tmp, schema, [{"x": i} for i in range(60)],
+                  row_group_size_rows=10)
+    tele = Telemetry()
+    chaos = ChaosSpec(hang_ordinals=(1, 4), hang_s=600)
+    with make_batch_reader(tmp, reader_pool_type="process", workers_count=2,
+                           shuffle_row_groups=False, chaos=chaos,
+                           item_deadline_s=2.0, telemetry=tele) as reader:
+        rows = sorted(x for b in reader.iter_batches() for x in b.columns["x"])
+        diag = reader.diagnostics
+    assert rows == list(range(60)), len(rows)
+    assert diag["hung_workers_killed"] >= 2, diag
+    counters = tele.snapshot()["counters"]
+    assert counters["liveness.hung_workers_killed"] >= 2
+    print("hang-chaos smoke OK"
+          f" ({diag['hung_workers_killed']} hung workers killed+replaced,"
+          f" {diag['requeued_items']} items requeued,"
+          f" {len(rows)} rows delivered exactly once)")
+PY
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 python "$HANG_SMOKE"
+rm -f "$HANG_SMOKE"
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

@@ -58,7 +58,10 @@ API = [
                              "NullCache", "CacheBase"]),
     ("petastorm_tpu.fs", ["get_filesystem_and_path", "FilesystemFactory",
                           "normalize_dir_url"]),
-    ("petastorm_tpu.retry", ["RetryPolicy", "retry_call", "resolve_retry_policy"]),
+    ("petastorm_tpu.retry", ["RetryPolicy", "retry_call", "resolve_retry_policy",
+                             "CircuitBreaker", "make_circuit_breaker"]),
+    ("petastorm_tpu.pool", ["make_executor", "WorkerError",
+                            "PipelineStallError"]),
     ("petastorm_tpu.errors", None),
     ("petastorm_tpu.ops.normalize", ["normalize_images"]),
     ("petastorm_tpu.ops.augment", ["random_crop", "random_flip",
@@ -82,7 +85,8 @@ API = [
                                  "Histogram", "TraceBuffer", "resolve",
                                  "enable", "enabled_from_env",
                                  "render_pipeline_report", "dominant_stage"]),
-    ("petastorm_tpu.tools.diagnose", ["run_diagnosis"]),
+    ("petastorm_tpu.tools.diagnose", ["run_diagnosis",
+                                      "render_liveness_verdict"]),
     ("petastorm_tpu.test_util.chaos", ["ChaosSpec", "ChaosWorker",
                                        "SimulatedWorkerCrash"]),
 ]

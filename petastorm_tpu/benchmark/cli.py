@@ -70,6 +70,22 @@ def build_parser() -> argparse.ArgumentParser:
                         help="reader failure policy: 'skip' quarantines"
                              " failing rowgroups and keeps reading (counts"
                              " ride telemetry as errors.*)")
+    parser.add_argument("--item-deadline", type=float, default=None,
+                        metavar="S",
+                        help="liveness: kill+respawn (process pool) or"
+                             " abandon (thread pool) a worker hung on one"
+                             " work item for S seconds and requeue the item;"
+                             " pair with --chaos 'hang_ordinals=...' to"
+                             " measure throughput under hang recovery"
+                             " (counts ride telemetry as liveness.*)")
+    from petastorm_tpu.pool import parse_hedge_after
+
+    parser.add_argument("--hedge-after", default=None, metavar="S|auto",
+                        type=parse_hedge_after,
+                        help="liveness: speculatively re-issue a work item"
+                             " running longer than S seconds to an idle"
+                             " worker, first result wins ('auto' = 4x the"
+                             " telemetry decode p99; needs --telemetry)")
     return parser
 
 
@@ -103,7 +119,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             simulated_step_s=args.simulated_step_ms / 1000.0,
             device_decode_fields=args.decode_device,
             prefetch=args.prefetch, telemetry=telemetry,
-            chaos=chaos, on_error=args.on_error)
+            chaos=chaos, on_error=args.on_error,
+            item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(
@@ -111,7 +128,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             warmup_cycles=args.warmup_cycles, measure_cycles=args.measure_cycles,
             pool_type=args.pool_type, workers_count=args.workers_count,
             read_method=args.method, shuffle_row_groups=not args.no_shuffle,
-            telemetry=telemetry, chaos=chaos, on_error=args.on_error)
+            telemetry=telemetry, chaos=chaos, on_error=args.on_error,
+            item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after)
 
     if telemetry is not None and args.trace_out and not args.isolated:
         telemetry.export_chrome_trace(args.trace_out)

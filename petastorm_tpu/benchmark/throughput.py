@@ -88,7 +88,9 @@ def reader_throughput(dataset_url: str,
                       transform_spec=None,
                       storage_options: Optional[dict] = None,
                       telemetry=None, chaos=None,
-                      on_error="raise") -> BenchmarkResult:
+                      on_error="raise",
+                      item_deadline_s: Optional[float] = None,
+                      hedge_after_s=None) -> BenchmarkResult:
     """Measure raw reader throughput in samples/sec.
 
     ``read_method='row'`` counts one sample per ``next()`` (make_reader);
@@ -112,7 +114,9 @@ def reader_throughput(dataset_url: str,
                  shuffle_row_groups=shuffle_row_groups, num_epochs=None,
                  transform_spec=transform_spec,
                  storage_options=storage_options, telemetry=tele,
-                 chaos=chaos, on_error=on_error) as reader:
+                 chaos=chaos, on_error=on_error,
+                 item_deadline_s=item_deadline_s,
+                 hedge_after_s=hedge_after_s) as reader:
         it = iter(reader)
 
         def consume(cycles: int) -> int:
@@ -146,7 +150,9 @@ def jax_loader_throughput(dataset_url: str,
                           device_decode_fields: Sequence[str] = (),
                           prefetch: int = 2,
                           telemetry=None, chaos=None,
-                          on_error="raise") -> BenchmarkResult:
+                          on_error="raise",
+                          item_deadline_s: Optional[float] = None,
+                          hedge_after_s=None) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
@@ -174,7 +180,8 @@ def jax_loader_throughput(dataset_url: str,
         num_epochs=None, storage_options=storage_options,
         decode_placement=({f: "device" for f in device_decode_fields}
                           if device_decode_fields else None),
-        telemetry=tele, chaos=chaos, on_error=on_error)
+        telemetry=tele, chaos=chaos, on_error=on_error,
+        item_deadline_s=item_deadline_s, hedge_after_s=hedge_after_s)
     try:
         loader = JaxDataLoader(reader, batch_size=batch_size, prefetch=prefetch)
     except Exception:

@@ -70,6 +70,25 @@ class ErrorBudgetExceededError(PetastormTpuError):
     """
 
 
+class CircuitOpenError(OSError, PetastormTpuError):
+    """The storage circuit breaker is open: consecutive transient-IO
+    failures crossed :class:`~petastorm_tpu.retry.RetryPolicy.circuit_threshold`
+    and further IO is failed FAST instead of each worker independently
+    burning its full retry-with-backoff budget against a store that is
+    plainly down (a retry storm compounds an outage: N workers x
+    max_attempts x backoff of traffic against a struggling backend).
+
+    Subclasses ``OSError`` so the existing failure taxonomy holds: the
+    exhausted-retry classification (``classify_error`` -> ``'data'``)
+    applies, meaning an ``on_error`` skip policy quarantines the affected
+    rowgroups and a budgeted policy trips
+    :class:`ErrorBudgetExceededError` during a sustained outage - while
+    ``is_transient`` explicitly refuses to retry it (the breaker exists to
+    STOP retries).  After ``circuit_cooldown_s`` one probe call is let
+    through (half-open); success closes the circuit again.
+    """
+
+
 @dataclasses.dataclass(frozen=True)
 class ErrorPolicy:
     """Skip-and-account failure policy for ``make_reader(on_error=...)``.

@@ -294,6 +294,10 @@ class JaxDataLoader:
         self._finished = False
         self._failure: Optional[BaseException] = None
         self._delivered_batches = 0
+        #: producer threads that failed to quiesce within the stop() join
+        #: budget ([{thread, stage}]); surfaced in diagnostics so a silent
+        #: shutdown wedge is visible post-mortem, not swallowed
+        self._unquiesced: list = []
         #: cumulative seconds the consumer spent blocked waiting for a batch
         #: (the live device-idle signal; see also the throughput CLI's
         #: --simulated-step-ms for an offline measurement)
@@ -1169,7 +1173,10 @@ class JaxDataLoader:
                "host_queue_depth": self._host_q.qsize(),
                "delivered_batches": self._delivered_batches,
                "consumer_wait_s": self._consumer_wait_s,
-               "finished": self._finished}
+               "finished": self._finished,
+               # producer threads that missed the stop() join budget (each
+               # {thread, stage}); non-empty = the shutdown was not clean
+               "unquiesced_threads": list(self._unquiesced)}
         if self._stack > 1:
             out["stack_batches"] = self._stack
         if self._mixed_geometries:
@@ -1500,10 +1507,28 @@ class JaxDataLoader:
         self._stop_trace()
 
     def join(self) -> None:
-        """Wait for the producer threads and the reader to exit (after stop())."""
+        """Wait for the producer threads and the reader to exit (after stop()).
+
+        Each producer thread gets a bounded join; one that fails to quiesce
+        (wedged in a transform_fn, a device transfer that never completes)
+        is NOT silently ignored: a WARNING names the thread and its pipeline
+        stage, and the failure is recorded in
+        ``diagnostics['unquiesced_threads']``.  The threads are daemonic, so
+        an abandoned one cannot block process exit.
+        """
         if self._started:
-            self._thread.join(timeout=10)
-            self._transfer_thread.join(timeout=10)
+            for t, stage in ((self._thread, "host-assemble"),
+                             (self._transfer_thread, "device-transfer")):
+                t.join(timeout=10)
+                if t.is_alive():
+                    entry = {"thread": t.name, "stage": stage}
+                    if entry not in self._unquiesced:
+                        self._unquiesced.append(entry)
+                    logger.warning(
+                        "Loader producer thread %s (stage %s) failed to"
+                        " quiesce within 10s of stop(); abandoning the daemon"
+                        " thread. queue depths: host=%d out=%d", t.name,
+                        stage, self._host_q.qsize(), self._out.qsize())
         self._reader.join()
 
     def __enter__(self):

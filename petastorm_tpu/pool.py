@@ -80,6 +80,23 @@ class WorkerError(PetastormTpuError):
         self.exc_type = exc_type
 
 
+class PipelineStallError(WorkerError):
+    """The reader produced no result for ``stall_abort_s`` seconds and
+    aborted (``make_reader(stall_abort_s=...)`` /
+    ``PETASTORM_TPU_STALL_ABORT_S``).
+
+    Subclasses :class:`WorkerError` (kind ``'infra'``, unattributable - no
+    single work item to blame) so existing handlers keep working; carries
+    the full pipeline ``diagnostics`` snapshot taken at abort time, so the
+    wedged state (stuck workers, queue depths, in-flight items) survives
+    into the traceback instead of living only in scrolled-away warnings.
+    """
+
+    def __init__(self, message: str, diagnostics: Optional[dict] = None):
+        super().__init__(message, kind="infra")
+        self.diagnostics = diagnostics or {}
+
+
 class VentilationCancelled(Exception):
     """An ``executor.put`` blocked on a full queue was withdrawn by its
     cancel_event (Ventilator.pause_and_join with a saturated pipeline); the
@@ -102,19 +119,25 @@ class _Failure:
 class _Ok:
     """Success envelope tagging a result with its work-item ordinal, so the
     consumer side can settle the in-flight ledger (requeue dedup: a result
-    for an ordinal no longer in flight is a duplicate and is dropped)."""
+    for an ordinal no longer in flight is a duplicate and is dropped).
 
-    __slots__ = ("ordinal", "value")
+    ``attempt`` is the delivering item's attempt number: it lets the
+    consumer attribute a hedged ordinal's first delivery to the hedge copy
+    vs the original (``liveness.hedge_wins``)."""
 
-    def __init__(self, ordinal, value):
+    __slots__ = ("ordinal", "value", "attempt")
+
+    def __init__(self, ordinal, value, attempt: int = 0):
         self.ordinal = ordinal
         self.value = value
+        self.attempt = attempt
 
     def __getstate__(self):
-        return (self.ordinal, self.value)
+        return (self.ordinal, self.value, self.attempt)
 
     def __setstate__(self, state):
-        self.ordinal, self.value = state
+        self.ordinal, self.value = state[0], state[1]
+        self.attempt = state[2] if len(state) > 2 else 0
 
 
 def _worker_error(exc: BaseException, kind: str, ordinal, item) -> WorkerError:
@@ -176,12 +199,29 @@ class ExecutorBase(ABC):
     """
 
     def __init__(self, telemetry=None, stop_on_failure: bool = True,
-                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
+                 item_deadline_s: Optional[float] = None,
+                 hedge_after_s=None):
         self._stopped = False
         self._ventilated = 0
         self._consumed = 0
         self._stop_on_failure = stop_on_failure
         self._max_requeue = max_requeue_attempts
+        if item_deadline_s is not None and item_deadline_s <= 0:
+            raise PetastormTpuError("item_deadline_s must be > 0 or None")
+        if not (hedge_after_s is None or hedge_after_s == "auto"
+                or (isinstance(hedge_after_s, (int, float))
+                    and hedge_after_s > 0)):
+            raise PetastormTpuError(
+                "hedge_after_s must be a positive number, 'auto', or None;"
+                f" got {hedge_after_s!r}")
+        #: liveness knobs (docs/operations.md "Liveness & stragglers"):
+        #: an in-flight item older than item_deadline_s gets its worker
+        #: killed (process pool) or its slot abandoned (thread pool) and is
+        #: requeued; one older than hedge_after_s is speculatively re-issued
+        #: to an idle worker, first result wins
+        self._item_deadline_s = item_deadline_s
+        self._hedge_after = hedge_after_s
         #: ordinal -> latest in-flight VentilatedItem (items without an
         #: ordinal are not tracked: they cannot be requeued or deduped)
         self._inflight: dict = {}
@@ -190,6 +230,13 @@ class ExecutorBase(ABC):
         #: requeued items waiting for an input-queue slot (consumer-thread
         #: state: parked by _reinject, drained by _flush_pending_requeues)
         self._pending_requeue: list = []
+        #: liveness ledger (consumer-thread state, like _pending_requeue):
+        #: ordinal -> attempt number of its hedge copy, until first delivery
+        self._hedged_attempt: dict = {}
+        self._hung_workers_killed = 0
+        self._hung_workers_abandoned = 0
+        self._hedged_items = 0
+        self._hedge_wins = 0
         #: petastorm_tpu.telemetry recorder (no-op unless enabled); executors
         #: record queue-full wait time - the signal that tells the pipeline
         #: report whether backpressure points upstream or downstream
@@ -198,6 +245,12 @@ class ExecutorBase(ABC):
         self._m_results_full = self._telemetry.counter(
             "queue.results_full_wait_s")
         self._m_requeued = self._telemetry.counter("errors.requeued_items")
+        self._m_hung_killed = self._telemetry.counter(
+            "liveness.hung_workers_killed")
+        self._m_hung_abandoned = self._telemetry.counter(
+            "liveness.hung_workers_abandoned")
+        self._m_hedged = self._telemetry.counter("liveness.hedged_items")
+        self._m_hedge_wins = self._telemetry.counter("liveness.hedge_wins")
 
     # -- in-flight ledger (requeue + duplicate suppression) -------------------
 
@@ -232,6 +285,10 @@ class ExecutorBase(ABC):
                                attempt + 1)
         with self._inflight_lock:
             self._inflight[ordinal] = retry
+        # a crash-requeue supersedes any outstanding hedge of this ordinal:
+        # the requeued copy's attempt number would otherwise satisfy the
+        # 'attempt >= hedged_at' win test and overcount hedge_wins
+        self._hedged_attempt.pop(ordinal, None)
         self._requeued_items += 1
         self._m_requeued.add(1)
         logger.warning("Requeueing work item %s after %s (attempt %d/%d)",
@@ -252,6 +309,7 @@ class ExecutorBase(ABC):
                 failure.ordinal,
                 f"in-worker infra failure ({failure.exc_type})"):
             return True
+        self._hedged_attempt.pop(failure.ordinal, None)
         if failure.ordinal is not None and not self._settle(failure.ordinal):
             # late failure for an ordinal that was already settled (a
             # requeued item's sibling delivery won the race): drop it like
@@ -268,9 +326,19 @@ class ExecutorBase(ABC):
                           kind=failure.kind, ordinal=failure.ordinal,
                           item=failure.item, exc_type=failure.exc_type)
 
-    def _requeue_lost(self, ordinal, why: str) -> None:
-        """A worker died holding ``ordinal``: re-ventilate it onto surviving
-        workers, or surface a WorkerError once the attempt budget is spent."""
+    def _requeue_lost(self, ordinal, why: str,
+                      exhausted_kind: str = "infra") -> None:
+        """A worker died (or hung past its deadline) holding ``ordinal``:
+        re-ventilate it onto surviving workers, or surface a WorkerError once
+        the attempt budget is spent.
+
+        ``exhausted_kind``: classification of the budget-exhausted error.
+        Crash/OOM paths keep ``'infra'``; the item-deadline path passes
+        ``'data'`` - an item that hung EVERY worker that touched it is a
+        property of the item (a pathological decode, a poisoned slow row),
+        and under an ``on_error`` skip policy it should quarantine like any
+        other data error instead of killing the epoch.
+        """
         if ordinal is None or self._try_requeue(ordinal, why):
             return
         with self._inflight_lock:
@@ -278,13 +346,78 @@ class ExecutorBase(ABC):
         if item is None:
             # the result was delivered before the worker died: nothing lost
             return
+        self._hedged_attempt.pop(ordinal, None)
         if self._stop_on_failure:
             self.stop()
         raise WorkerError(
             f"Work item {ordinal} lost to {why}; requeue budget exhausted"
             f" ({getattr(item, 'attempt', 0)} requeue(s) of max"
-            f" {self._max_requeue}) - possible crash/OOM", kind="infra",
-            ordinal=ordinal, item=item)
+            f" {self._max_requeue})"
+            + (" - repeatedly hung item, quarantine-eligible"
+               if exhausted_kind == "data" else " - possible crash/OOM"),
+            kind=exhausted_kind, ordinal=ordinal, item=item)
+
+    # -- liveness: straggler hedging (docs/operations.md) ---------------------
+
+    def _hedge_threshold(self) -> Optional[float]:
+        """Resolved hedge age threshold in seconds, or None (hedging off /
+        'auto' lacks data).  ``'auto'`` derives the threshold from the
+        observed decode latency tail: 4x the telemetry p99, floored at 0.5s,
+        once at least 20 decodes have been recorded - so hedging arms itself
+        against what 'slow' actually means on this dataset.  'auto' needs
+        telemetry enabled in THIS process (thread/serial pools; process-pool
+        workers record decode stages in their own processes)."""
+        h = self._hedge_after
+        if h is None:
+            return None
+        if h == "auto":
+            if not self._telemetry.enabled:
+                return None
+            hist = self._telemetry.histogram("stage.decode.latency_s")
+            if getattr(hist, "count", 0) < 20:
+                return None
+            return max(4.0 * hist.quantile(0.99), 0.5)
+        return float(h)
+
+    def _hedge(self, ordinal, why: str) -> bool:
+        """Speculatively re-issue the in-flight item for ``ordinal`` (attempt
+        bumped, non-blocking enqueue); the per-ordinal ledger guarantees
+        whichever copy finishes second is dropped as a duplicate.  Bounded by
+        the same attempt budget as requeues; False = not hedged (already
+        hedged, budget spent, input queue full, or ordinal already
+        delivered).  Consumer-thread context."""
+        if ordinal is None or ordinal in self._hedged_attempt:
+            return False
+        with self._inflight_lock:
+            item = self._inflight.get(ordinal)
+        if item is None:
+            return False
+        attempt = getattr(item, "attempt", 0)
+        if attempt >= self._max_requeue:
+            return False
+        copy = VentilatedItem(ordinal, getattr(item, "item", item),
+                              attempt + 1)
+        if not self._try_enqueue(copy):
+            return False  # no room; re-evaluated on the next poll
+        with self._inflight_lock:
+            self._inflight[ordinal] = copy
+        self._hedged_attempt[ordinal] = attempt + 1
+        self._hedged_items += 1
+        self._m_hedged.add(1)
+        logger.info("Hedging work item %s after %s (speculative attempt"
+                    " %d/%d; first result wins)", ordinal, why, attempt + 1,
+                    self._max_requeue)
+        return True
+
+    def _note_delivery(self, ordinal, attempt: int) -> None:
+        """First delivery for ``ordinal`` settled: when it was hedged, decide
+        whether the hedge copy won (its attempt number delivered first)."""
+        if not self._hedged_attempt:
+            return
+        hedged_at = self._hedged_attempt.pop(ordinal, None)
+        if hedged_at is not None and attempt >= hedged_at:
+            self._hedge_wins += 1
+            self._m_hedge_wins.add(1)
 
     def _reinject(self, item: Any) -> None:
         """Re-enqueue a requeued item without ever blocking the consumer
@@ -330,6 +463,10 @@ class ExecutorBase(ABC):
     def diagnostics(self) -> dict:
         return {"ventilated": self._ventilated, "consumed": self._consumed,
                 "requeued_items": self._requeued_items,
+                "hung_workers_killed": self._hung_workers_killed,
+                "hung_workers_abandoned": self._hung_workers_abandoned,
+                "hedged_items": self._hedged_items,
+                "hedge_wins": self._hedge_wins,
                 "stopped": self._stopped}
 
     def __enter__(self):
@@ -360,12 +497,30 @@ class SerialExecutor(ExecutorBase):
 
     def __init__(self, in_queue_size: int = 32, telemetry=None,
                  stop_on_failure: bool = True,
-                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
+                 item_deadline_s: Optional[float] = None,
+                 hedge_after_s=None,
+                 stall_warn_s: Optional[float] = None):
         super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
-                         max_requeue_attempts=max_requeue_attempts)
+                         max_requeue_attempts=max_requeue_attempts,
+                         item_deadline_s=item_deadline_s,
+                         hedge_after_s=hedge_after_s)
+        if item_deadline_s is not None or hedge_after_s is not None:
+            # same limitation as stall-abort: work runs synchronously inside
+            # the consumer's get(), so there is no other worker to kill,
+            # abandon, or hedge onto (docs/operations.md)
+            logger.warning(
+                "item_deadline_s/hedge_after_s are inoperative on the serial"
+                " executor (work runs inline on the consumer thread); use the"
+                " thread or process pool for liveness recovery")
         self._items: "queue.Queue[Any]" = queue.Queue(maxsize=in_queue_size)
         self._fn: Optional[Callable] = None
-        self._stall_warn_s = _env_seconds("PETASTORM_TPU_STALL_WARN_S", 120.0)
+        # per-item watchdog threshold: explicit kwarg (the reader's
+        # stall_warn_s - the serial pool is the one flavor whose mid-item
+        # stalls the reader-side loop cannot observe) wins over the env var
+        self._stall_warn_s = (float(stall_warn_s) if stall_warn_s is not None
+                              else _env_seconds("PETASTORM_TPU_STALL_WARN_S",
+                                                120.0))
         # heartbeat slot for the watchdog (single writer: the get() caller;
         # same write-order contract as the thread pool's worker_state)
         self._watch_thread: Optional[threading.Thread] = None
@@ -506,9 +661,13 @@ class ThreadedExecutor(ExecutorBase):
                  profiling_enabled: bool = False,
                  telemetry=None,
                  stop_on_failure: bool = True,
-                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
+                 item_deadline_s: Optional[float] = None,
+                 hedge_after_s=None):
         super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
-                         max_requeue_attempts=max_requeue_attempts)
+                         max_requeue_attempts=max_requeue_attempts,
+                         item_deadline_s=item_deadline_s,
+                         hedge_after_s=hedge_after_s)
         self._workers_count = workers_count
         # Queue choice is correctness-driven (hang post-mortem, RESULTS.md):
         # CPython's SimpleQueue.get(timeout) WEDGES under multiple
@@ -548,6 +707,13 @@ class ThreadedExecutor(ExecutorBase):
         # fault servicing (consumer-thread-only state): worker indexes whose
         # death has been handled
         self._reaped: set = set()
+        # liveness (consumer-thread-only): index -> ordinal it was abandoned
+        # on.  A thread cannot be SIGKILLed, so a worker hung past
+        # item_deadline_s is ABANDONED: its slot stops counting as a live
+        # worker, its item is requeued onto a sibling, and its eventual late
+        # result (if the hang ever resolves) is dropped by the ledger.  The
+        # entry clears itself if the thread recovers and takes a new item.
+        self._abandoned: dict = {}
 
     def start(self, worker_factory: WorkerFactory) -> None:
         if self._threads:
@@ -605,7 +771,7 @@ class ThreadedExecutor(ExecutorBase):
                     return
                 result = _Failure(exc, ordinal=ordinal, item=item)
             else:
-                result = _Ok(ordinal, result)
+                result = _Ok(ordinal, result, getattr(item, "attempt", 0))
             self._put_result_stop_aware(result)
             state[0] = None
             state[1] = time.monotonic()
@@ -656,7 +822,10 @@ class ThreadedExecutor(ExecutorBase):
 
     def _service_faults(self) -> None:
         """Reap dead worker threads (requeueing their in-flight items) and
-        flush parked requeues.  Runs on the consumer thread between polls."""
+        flush parked requeues.  Runs on the consumer thread between polls -
+        deliberately: every liveness mutation (requeue parking, abandonment,
+        hedging) stays consumer-thread-only state, so no new locks and no
+        races with a separate watchdog thread."""
         self._flush_pending_requeues()
         if self._stop_event.is_set():
             return
@@ -675,13 +844,74 @@ class ThreadedExecutor(ExecutorBase):
             self._worker_state[i][0] = None
             self._requeue_lost(ordinal if isinstance(ordinal, int) else None,
                                f"worker thread {i} death")
-        if (self._reaped and self._threads
-                and not any(t.is_alive() for t in self._threads)
+        self._check_liveness()
+        if ((self._reaped or self._abandoned) and self._threads
+                and all(not t.is_alive() or i in self._abandoned
+                        for i, t in enumerate(self._threads))
                 and self._out_queue.empty()):
+            # abandoned-as-hung slots count as gone: with every worker dead
+            # or written off, queued/requeued items have no one to run them
+            # - raising here is the difference between a classified error
+            # and the exact indefinite wedge item_deadline_s exists to end
             if self._stop_on_failure:
                 self.stop()
-            raise WorkerError("All worker threads died; no result will"
-                              " arrive", kind="infra")
+            raise WorkerError("All worker threads died or were abandoned as"
+                              " hung; no result will arrive", kind="infra")
+
+    def _check_liveness(self) -> None:
+        """Item-deadline + hedging sweep over the worker heartbeats
+        (consumer-thread context; polled while the consumer waits, which is
+        exactly when a hung or straggling item matters).
+
+        Deadline: a slot busy on the same item past ``item_deadline_s`` is
+        abandoned (threads cannot be killed; the daemonic thread is excluded
+        from liveness accounting and from close-time joins) and its item is
+        requeued through the attempt budget - exhaustion surfaces a
+        ``'data'``-kind WorkerError so a repeatedly-hanging item quarantines
+        under a skip policy.  Hedging: a slot busy past the hedge threshold
+        gets its item speculatively re-issued when an idle worker exists;
+        the in-flight ledger keeps delivery exactly-once either way.
+        """
+        deadline = self._item_deadline_s
+        hedge_s = (self._hedge_threshold()
+                   if self._hedge_after is not None else None)
+        if deadline is None and hedge_s is None:
+            return
+        now = time.monotonic()
+        idle = any(s[0] is None for i, s in enumerate(self._worker_state)
+                   if i not in self._abandoned and self._threads[i].is_alive())
+        for i, s in enumerate(self._worker_state):
+            ordinal = s[0]
+            if ordinal is None:
+                self._abandoned.pop(i, None)  # recovered and went idle
+                continue
+            if self._abandoned.get(i) == ordinal:
+                continue  # already handled this hang
+            if i in self._abandoned:
+                del self._abandoned[i]  # recovered onto a new item
+            if not self._threads[i].is_alive():
+                continue  # the reap path owns dead workers
+            elapsed = max(0.0, now - s[1])
+            if deadline is not None and elapsed > deadline:
+                self._abandoned[i] = ordinal
+                self._hung_workers_abandoned += 1
+                self._m_hung_abandoned.add(1)
+                logger.warning(
+                    "Worker thread %d hung on item %s for %.1fs >"
+                    " item_deadline_s=%.1f; abandoning the slot and"
+                    " requeueing the item onto a sibling worker", i, ordinal,
+                    elapsed, deadline)
+                self._requeue_lost(
+                    ordinal if isinstance(ordinal, int) else None,
+                    f"hung worker thread {i} (exceeded item deadline"
+                    f" {deadline:.1f}s)", exhausted_kind="data")
+                continue
+            if (hedge_s is not None and elapsed > hedge_s and idle
+                    and self._hedge(
+                        ordinal if isinstance(ordinal, int) else None,
+                        f"straggling {elapsed:.1f}s on worker thread {i}"
+                        f" (hedge threshold {hedge_s:.1f}s)")):
+                idle = False  # one speculative copy per sweep
 
     def get(self, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -703,6 +933,7 @@ class ThreadedExecutor(ExecutorBase):
                 # requeue duplicate (original result surfaced after its
                 # worker died): drop it - the first delivery already counted
                 continue
+            self._note_delivery(result.ordinal, getattr(result, "attempt", 0))
             self._consumed += 1
             if self._telemetry.enabled:
                 self._telemetry.gauge("pool.results_queue_depth").set(
@@ -720,8 +951,16 @@ class ThreadedExecutor(ExecutorBase):
         cannot block process exit, and a warning names what was abandoned."""
         if not self._stopped:
             raise PetastormTpuError("call stop() before join()")
+        if timeout is None and (self._item_deadline_s is not None
+                                or self._hedge_after is not None):
+            # liveness-enabled pools already accept abandoning wedged daemon
+            # workers mid-epoch; an unbounded close-time join would trade the
+            # hang the deadline/hedge just recovered from for a close hang
+            timeout = 5.0
         deadline = None if timeout is None else time.monotonic() + timeout
-        for t in self._threads:
+        for i, t in enumerate(self._threads):
+            if i in self._abandoned:
+                continue  # known-hung: daemonic, never joins - skip the wait
             t.join(None if deadline is None
                    else max(0.0, deadline - time.monotonic()))
         alive = [t.name for t in self._threads if t.is_alive()]
@@ -774,29 +1013,42 @@ class ThreadedExecutor(ExecutorBase):
                 # [(worker index, item ordinal, seconds on it)] for workers
                 # currently inside fn(item) - a stalled pipeline names the
                 # exact worker and work item instead of wedging silently
-                "workers_busy": busy}
+                "workers_busy": busy,
+                # liveness: slots written off as hung (still daemon-alive,
+                # excluded from worker accounting and close-time joins)
+                "workers_abandoned": sorted(self._abandoned)}
 
 
 def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
                          index=0, heartbeats=None):
     """Worker-process entrypoint (module-level: must be picklable for spawn).
 
-    ``heartbeats``: optional lock-free shared double array, 2 slots per
-    worker: [ordinal (-1 = idle), wall-clock since] — same stall-attribution
-    contract as ThreadedExecutor's ``workers_busy``, crossing the process
-    boundary via shared memory.  Wall clock (time.time), not monotonic:
-    monotonic clocks are not comparable across processes on all platforms.
-    Reads of the PAIR can tear: each 8-byte slot is individually atomic but
-    the pair is not.  The write order here (timestamp BEFORE ordinal) plus
-    the double-read validation on the reading side
-    (``_ProcessExecutor._read_heartbeat``: ordinal, timestamp, ordinal
-    again, retry when the ordinal moved) guarantees a sample never pairs a
-    new ordinal with a stale timestamp — a torn pair can no longer report a
-    bogus stall (PR 1 caveat, since fixed).
+    ``heartbeats``: optional lock-free shared double array, 3 slots per
+    worker: [ordinal (-1 = idle), wall-clock since, delivering flag] — same
+    stall-attribution contract as ThreadedExecutor's ``workers_busy``,
+    crossing the process boundary via shared memory.  Wall clock
+    (time.time), not monotonic: monotonic clocks are not comparable across
+    processes on all platforms.  Reads of the (ordinal, since) PAIR can
+    tear: each 8-byte slot is individually atomic but the pair is not.  The
+    write order here (timestamp BEFORE ordinal) plus the double-read
+    validation on the reading side (``_ProcessExecutor._read_heartbeat``:
+    ordinal, timestamp, ordinal again, retry when the ordinal moved)
+    guarantees a sample never pairs a new ordinal with a stale timestamp —
+    a torn pair can no longer report a bogus stall (PR 1 caveat, since
+    fixed).
 
     The heartbeat doubles as the crash ledger: a worker that dies mid-item
     (OOM kill, segfault) leaves its ordinal in the slot, which is how the
     parent knows exactly which work item to requeue onto surviving workers.
+
+    The ``delivering`` slot (-1.0 = no) flips to the ordinal between
+    finishing the work function and completing the result enqueue.  The
+    liveness kill sweep (``_check_liveness``) refuses to SIGKILL a
+    delivering worker: a kill landing while the queue's feeder holds the
+    shared write lock would orphan the lock and deadlock every other
+    worker's ``out_queue.put`` forever.  The ordinal slot deliberately
+    stays set until AFTER the put, preserving crash attribution for a death
+    mid-delivery (the ledger requeues it; a double delivery dedups).
     """
     try:
         fn = worker_factory()
@@ -805,7 +1057,7 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
         return
     if hasattr(fn, "stop_event"):  # shm encoder: abort full-arena waits on stop
         fn.stop_event = stop_event
-    base = 2 * index
+    base = 3 * index
     while not stop_event.is_set():
         try:
             item = in_queue.get(timeout=_POLL_S)
@@ -814,27 +1066,30 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
         if item is _ProcessExecutor._STOP_SENTINEL_VALUE:
             break
         ordinal = getattr(item, "ordinal", None)
+        try:
+            hb_ordinal = float(ordinal)
+        except (TypeError, ValueError):
+            hb_ordinal = -2.0  # busy, ordinal unknown
         if heartbeats is not None:
-            try:
-                hb_ordinal = float(ordinal)
-            except (TypeError, ValueError):
-                hb_ordinal = -2.0  # busy, ordinal unknown
             # timestamp before ordinal (same reasoning as the thread pool:
             # a concurrent read must never pair a new item with an old time)
             heartbeats[base + 1] = time.time()
             heartbeats[base] = hb_ordinal
         try:
-            result = _Ok(ordinal, fn(item))
+            result = _Ok(ordinal, fn(item), getattr(item, "attempt", 0))
         except BaseException as exc:  # noqa: BLE001
             if getattr(exc, "petastorm_tpu_simulated_crash", False):
                 # chaos harness: die exactly like an OOM kill - no result,
                 # no traceback, heartbeat left naming the in-flight item
                 os._exit(17)
             result = _Failure(exc, ordinal=ordinal, item=item)
+        if heartbeats is not None:
+            heartbeats[base + 2] = hb_ordinal  # delivering: do not SIGKILL
         out_queue.put(result)
         if heartbeats is not None:
             heartbeats[base] = -1.0
             heartbeats[base + 1] = time.time()
+            heartbeats[base + 2] = -1.0
 
 
 class _ProcessExecutor(ExecutorBase):
@@ -859,13 +1114,17 @@ class _ProcessExecutor(ExecutorBase):
                  shm_size_bytes: int = DEFAULT_SHM_BYTES,
                  telemetry=None,
                  stop_on_failure: bool = True,
-                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS):
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
+                 item_deadline_s: Optional[float] = None,
+                 hedge_after_s=None):
         # telemetry: the PARENT process records ventilation/queue waits;
         # worker-side stage metrics recorded in the spawned processes stay
         # there (PETASTORM_TPU_TELEMETRY is inherited, so each child records
         # independently) - thread pool gives one merged report
         super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
-                         max_requeue_attempts=max_requeue_attempts)
+                         max_requeue_attempts=max_requeue_attempts,
+                         item_deadline_s=item_deadline_s,
+                         hedge_after_s=hedge_after_s)
         import multiprocessing as mp
 
         self._ctx = mp.get_context("spawn")
@@ -874,6 +1133,7 @@ class _ProcessExecutor(ExecutorBase):
         self._out_queue = self._ctx.Queue(results_queue_size)
         self._stop_event = self._ctx.Event()
         self._procs = []
+        self._worker_factory = None
         self._reaped: set = set()
         self._arena = None
         self._heartbeats = None
@@ -893,18 +1153,28 @@ class _ProcessExecutor(ExecutorBase):
 
             self._arena = SharedArena.create(self._shm_size_bytes)
             worker_factory = ShmResultEncoder(worker_factory, self._arena.name)
-        # lock-free heartbeat slots (single-writer per pair; see
+        # kept for hung-worker kill-and-replace respawns (_check_liveness)
+        self._worker_factory = worker_factory
+        # lock-free heartbeat slots (single-writer per triple; see
         # _process_worker_main) - powers workers_busy across processes
-        self._heartbeats = self._ctx.RawArray("d", 2 * self._workers_count)
+        self._heartbeats = self._ctx.RawArray("d", 3 * self._workers_count)
         for i in range(self._workers_count):
-            self._heartbeats[2 * i] = -1.0
-            p = self._ctx.Process(
-                target=_process_worker_main,
-                args=(worker_factory, self._in_queue, self._out_queue,
-                      self._stop_event, i, self._heartbeats),
-                name=f"petastorm-tpu-worker-{i}", daemon=True)
-            p.start()
-            self._procs.append(p)
+            self._heartbeats[3 * i] = -1.0
+            self._heartbeats[3 * i + 2] = -1.0
+            self._procs.append(self._spawn_worker(i))
+
+    def _spawn_worker(self, index: int):
+        """Spawn (or respawn) the worker process for slot ``index``; the
+        heartbeat pair at that index is reused (single writer at a time: a
+        replacement is only spawned after its predecessor is confirmed
+        dead)."""
+        p = self._ctx.Process(
+            target=_process_worker_main,
+            args=(self._worker_factory, self._in_queue, self._out_queue,
+                  self._stop_event, index, self._heartbeats),
+            name=f"petastorm-tpu-worker-{index}", daemon=True)
+        p.start()
+        return p
 
     def put(self, item: Any, cancel_event=None) -> None:
         if self._stopped:
@@ -946,7 +1216,7 @@ class _ProcessExecutor(ExecutorBase):
         busy on an ordinal-less item.
         """
         hb = self._heartbeats
-        base = 2 * index
+        base = 3 * index
         ordinal = hb[base]
         since = hb[base + 1]
         for _ in range(3):
@@ -956,6 +1226,11 @@ class _ProcessExecutor(ExecutorBase):
             ordinal = again
             since = hb[base + 1]
         return ordinal, since
+
+    def _is_delivering(self, index: int) -> bool:
+        """True while worker ``index`` is between finishing its work
+        function and completing the result enqueue (kill-unsafe window)."""
+        return self._heartbeats[3 * index + 2] != -1.0
 
     def _try_enqueue(self, item: Any) -> bool:
         try:
@@ -991,10 +1266,12 @@ class _ProcessExecutor(ExecutorBase):
                 # clear the crash ledger BEFORE the (possibly raising)
                 # requeue so diagnostics never report a phantom stuck
                 # worker (the owner is dead; no write race)
-                self._heartbeats[2 * i + 1] = time.time()
-                self._heartbeats[2 * i] = -1.0
+                self._heartbeats[3 * i + 1] = time.time()
+                self._heartbeats[3 * i] = -1.0
+                self._heartbeats[3 * i + 2] = -1.0
             self._requeue_lost(
                 ordinal, f"worker process {i} death (exit code {p.exitcode})")
+        self._check_liveness()
         # Residual window, deliberately NOT reconciled: a SIGKILL landing in
         # the few instructions between a worker's in_queue.get and its
         # heartbeat stamp loses the item without naming it (the ledger holds
@@ -1002,8 +1279,87 @@ class _ProcessExecutor(ExecutorBase):
         # need mp.Queue emptiness, which is advisory (the feeder thread
         # buffers) - a reconciliation attempt built on it demonstrably
         # misfired on healthy pipelines.  The stall watchdog
-        # (PETASTORM_TPU_STALL_WARN_S / _ABORT_S) is the designated backstop
-        # for exactly this class of unattributable loss.
+        # (stall_warn_s / stall_abort_s) is the designated backstop for
+        # exactly this class of unattributable loss.
+
+    def _check_liveness(self) -> None:
+        """Item-deadline + hedging sweep over the shared-memory heartbeats
+        (consumer-thread context, like the requeue machinery).
+
+        Deadline: a worker whose heartbeat names the same in-flight item for
+        longer than ``item_deadline_s`` is SIGKILLed - the only interruption
+        that reaches a worker wedged in a blocking C call or a deadlocked
+        native library - and REPLACED with a fresh spawn at the same slot;
+        the item is requeued through the attempt budget, so a genuinely
+        poisoned slow item eventually surfaces as a quarantine-eligible
+        ``'data'`` error.  Hedging: an item past the hedge threshold is
+        speculatively re-issued when an idle worker exists; the per-ordinal
+        ledger dedups whichever copy loses.
+        """
+        deadline = self._item_deadline_s
+        hedge_s = (self._hedge_threshold()
+                   if self._hedge_after is not None else None)
+        if ((deadline is None and hedge_s is None)
+                or self._heartbeats is None or not self._procs):
+            return
+        now = time.time()  # heartbeats are wall-clock (cross-process)
+        idle = False
+        busy = []
+        for i, p in enumerate(self._procs):
+            if not p.is_alive():
+                continue
+            hb_ordinal, since = self._read_heartbeat(i)
+            if hb_ordinal == -1.0:
+                idle = True
+            else:
+                busy.append((i, p, hb_ordinal, max(0.0, now - since)))
+        for i, p, hb_ordinal, elapsed in busy:
+            ordinal = int(hb_ordinal) if hb_ordinal >= 0 else None
+            if self._is_delivering(i):
+                # the worker finished its work function and is mid-enqueue:
+                # SIGKILLing now could orphan the out-queue's shared write
+                # lock (held by the queue's feeder thread) and deadlock
+                # every other worker's put forever.  The result is moments
+                # away; skip this sweep.  (The consumer only runs this sweep
+                # while starving, so the pipe is drained and the delivery
+                # window is short - not a loophole a truly hung worker can
+                # hide in: a hang wedges INSIDE fn, before the flag flips.)
+                continue
+            if deadline is not None and elapsed > deadline:
+                logger.warning(
+                    "Worker process %d (pid %s) hung on item %s for %.1fs >"
+                    " item_deadline_s=%.1f; SIGKILLing and respawning", i,
+                    p.pid, ordinal if ordinal is not None else "?", elapsed,
+                    deadline)
+                if self._is_delivering(i):
+                    continue  # flipped between the first check and the kill
+                p.kill()
+                p.join(timeout=10)
+                # re-read AFTER death: the pre-kill sample may be stale (the
+                # worker can have finished that item and started another
+                # before the signal landed); the post-mortem heartbeat is the
+                # authoritative crash ledger
+                hb_ordinal, _since = self._read_heartbeat(i)
+                ordinal = int(hb_ordinal) if hb_ordinal >= 0 else None
+                self._heartbeats[3 * i + 1] = time.time()
+                self._heartbeats[3 * i] = -1.0
+                self._heartbeats[3 * i + 2] = -1.0
+                self._hung_workers_killed += 1
+                self._m_hung_killed.add(1)
+                # replace BEFORE the (possibly raising) requeue: the pool
+                # must keep its worker count whether or not the item has
+                # budget left
+                self._procs[i] = self._spawn_worker(i)
+                self._requeue_lost(
+                    ordinal, f"hung worker process {i} SIGKILLed after"
+                    f" exceeding item deadline {deadline:.1f}s",
+                    exhausted_kind="data")
+                continue
+            if (hedge_s is not None and elapsed > hedge_s and idle
+                    and self._hedge(
+                        ordinal, f"straggling {elapsed:.1f}s on worker"
+                        f" process {i} (hedge threshold {hedge_s:.1f}s)")):
+                idle = False  # one speculative copy per sweep
 
     def get(self, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1034,6 +1390,7 @@ class _ProcessExecutor(ExecutorBase):
                 value = decode_batch(self._arena, value)
             if not settled:
                 continue  # requeue duplicate: first delivery already counted
+            self._note_delivery(ordinal, getattr(result, "attempt", 0))
             self._consumed += 1
             return value
 
@@ -1084,32 +1441,63 @@ class _ProcessExecutor(ExecutorBase):
         return diag
 
 
+def parse_hedge_after(value: str):
+    """CLI string -> ``hedge_after_s`` value: ``'auto'`` or a positive
+    float.  Raises ValueError (argparse renders it as a usage error when
+    used as a ``type=``) on anything else - shared by the throughput and
+    diagnose CLIs."""
+    if value == "auto":
+        return "auto"
+    try:
+        parsed = float(value)
+    except ValueError:
+        raise ValueError(
+            f"expected a number of seconds or 'auto', got {value!r}")
+    if parsed <= 0:
+        raise ValueError("hedge threshold must be > 0 seconds")
+    return parsed
+
+
 def make_executor(kind: str = "thread", workers_count: int = 3,
                   results_queue_size: int = DEFAULT_RESULTS_QUEUE_SIZE,
                   telemetry=None, stop_on_failure: bool = True,
                   max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
-                  ) -> ExecutorBase:
+                  item_deadline_s: Optional[float] = None,
+                  hedge_after_s=None,
+                  stall_warn_s: Optional[float] = None) -> ExecutorBase:
     """'thread' | 'process' | 'serial' (reference: reader_pool_type, reader.py:139-150).
 
     ``stop_on_failure=False`` keeps the pool alive when a worker failure is
     delivered at ``get`` (the reader's ``on_error`` skip policies);
     ``max_requeue_attempts`` bounds the transparent re-ventilation of items
-    lost to worker crashes.
+    lost to worker crashes.  ``item_deadline_s``/``hedge_after_s`` arm the
+    liveness layer (hung-worker kill/abandon + straggler hedging; serial
+    pools cannot enforce either - the work runs inline on the consumer).
+    ``stall_warn_s`` reaches the serial pool's per-item watchdog (the one
+    flavor whose mid-item stalls the reader-side loop cannot observe);
+    thread/process pools take their stall thresholds from the reader.
     """
     if kind == "thread":
         return ThreadedExecutor(workers_count, results_queue_size,
                                 telemetry=telemetry,
                                 stop_on_failure=stop_on_failure,
-                                max_requeue_attempts=max_requeue_attempts)
+                                max_requeue_attempts=max_requeue_attempts,
+                                item_deadline_s=item_deadline_s,
+                                hedge_after_s=hedge_after_s)
     if kind == "process":
         return _ProcessExecutor(workers_count, results_queue_size,
                                 telemetry=telemetry,
                                 stop_on_failure=stop_on_failure,
-                                max_requeue_attempts=max_requeue_attempts)
+                                max_requeue_attempts=max_requeue_attempts,
+                                item_deadline_s=item_deadline_s,
+                                hedge_after_s=hedge_after_s)
     if kind in ("serial", "dummy"):
         return SerialExecutor(telemetry=telemetry,
                               stop_on_failure=stop_on_failure,
-                              max_requeue_attempts=max_requeue_attempts)
+                              max_requeue_attempts=max_requeue_attempts,
+                              item_deadline_s=item_deadline_s,
+                              hedge_after_s=hedge_after_s,
+                              stall_warn_s=stall_warn_s)
     raise PetastormTpuError(f"Unknown executor kind {kind!r}")
 
 

@@ -48,9 +48,11 @@ from petastorm_tpu.etl.indexing import get_row_group_indexes
 from petastorm_tpu.etl.metadata import open_dataset
 from petastorm_tpu.fs import FilesystemFactory
 from petastorm_tpu.plan import ElasticResumePlan, ReadPlan, elastic_resume_plan
-from petastorm_tpu.pool import (DEFAULT_REQUEUE_ATTEMPTS, Ventilator,
-                                WorkerError, _env_seconds, make_executor)
+from petastorm_tpu.pool import (DEFAULT_REQUEUE_ATTEMPTS, PipelineStallError,
+                                Ventilator, WorkerError, _env_seconds,
+                                make_executor)
 from petastorm_tpu.schema import Schema
+from petastorm_tpu.telemetry import dominant_stage
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 from petastorm_tpu.transform import TransformSpec, transform_schema
 from petastorm_tpu.worker import RowGroupDecoderWorker
@@ -95,6 +97,10 @@ def make_reader(dataset_url: str,
                 io_retries="auto",
                 telemetry=None,
                 on_error="raise",
+                item_deadline_s: Optional[float] = None,
+                hedge_after_s=None,
+                stall_warn_s: Optional[float] = None,
+                stall_abort_s: Optional[float] = None,
                 chaos=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -135,6 +141,27 @@ def make_reader(dataset_url: str,
     are listed in ``Reader.diagnostics['quarantined_rowgroups']`` and
     counted in telemetry (``errors.skipped_rowgroups``).
 
+    ``item_deadline_s``/``hedge_after_s``: the liveness layer
+    (docs/operations.md "Liveness & stragglers").  With a deadline, an
+    in-flight work item that produces no result for that long gets its
+    worker SIGKILLed and respawned (process pool) or its slot abandoned
+    (thread pool) and the item is requeued through the ``on_error`` requeue
+    budget - a repeatedly-hanging item eventually quarantines as a data
+    error.  ``hedge_after_s`` (seconds, or ``'auto'`` = 4x the telemetry
+    decode p99) speculatively re-issues a straggling item to an idle
+    worker; first result wins, the loser is deduplicated.  Both are
+    inoperative on the serial pool (work runs inline on the consumer).
+    Telemetry counts ``liveness.hung_workers_killed`` / ``.hedged_items`` /
+    ``.hedge_wins``.
+
+    ``stall_warn_s``/``stall_abort_s``: pipeline stall watchdog, previously
+    env-only.  ``stall_warn_s`` (default 120) logs a WARNING naming the
+    stuck workers when no result arrives for that long; ``stall_abort_s``
+    (default off) escalates a longer stall to ``PipelineStallError``
+    (diagnostics attached).  ``None`` falls back to
+    ``PETASTORM_TPU_STALL_WARN_S`` / ``PETASTORM_TPU_STALL_ABORT_S``;
+    ``0`` disables.
+
     ``chaos``: deterministic fault injection for tests/benchmarks
     (``petastorm_tpu.test_util.chaos.ChaosSpec``); never set in production.
     """
@@ -149,7 +176,11 @@ def make_reader(dataset_url: str,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
                              io_retries=io_retries, telemetry=telemetry,
-                             on_error=on_error, chaos=chaos)
+                             on_error=on_error, chaos=chaos,
+                             item_deadline_s=item_deadline_s,
+                             hedge_after_s=hedge_after_s,
+                             stall_warn_s=stall_warn_s,
+                             stall_abort_s=stall_abort_s)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -204,13 +235,18 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       io_retries="auto",
                       telemetry=None,
                       on_error="raise",
+                      item_deadline_s: Optional[float] = None,
+                      hedge_after_s=None,
+                      stall_warn_s: Optional[float] = None,
+                      stall_abort_s: Optional[float] = None,
                       chaos=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
 
     Reference: ``make_batch_reader`` (reader.py:179-290).  Yields one namedtuple of
     column arrays per decoded rowgroup.  ``io_retries``/``telemetry``/
-    ``on_error``/``chaos``: see ``make_reader``.
+    ``on_error``/``item_deadline_s``/``hedge_after_s``/``stall_warn_s``/
+    ``stall_abort_s``/``chaos``: see ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -223,7 +259,11 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
                              io_retries=io_retries, telemetry=telemetry,
-                             on_error=on_error, chaos=chaos)
+                             on_error=on_error, chaos=chaos,
+                             item_deadline_s=item_deadline_s,
+                             hedge_after_s=hedge_after_s,
+                             stall_warn_s=stall_warn_s,
+                             stall_abort_s=stall_abort_s)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -237,7 +277,11 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
                       io_retries="auto", telemetry=None,
-                      on_error="raise", chaos=None) -> "Reader":
+                      on_error="raise", chaos=None,
+                      item_deadline_s: Optional[float] = None,
+                      hedge_after_s=None,
+                      stall_warn_s: Optional[float] = None,
+                      stall_abort_s: Optional[float] = None) -> "Reader":
     telemetry = _resolve_telemetry(telemetry)
     error_policy = resolve_error_policy(on_error)
     if chaos is not None and chaos.affects_filesystem():
@@ -367,8 +411,14 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     device_fields, mixed_fields = _validate_decode_placement(
         decode_placement, full_schema, read_fields, transform_spec,
         ngram, worker_predicate)
-    from petastorm_tpu.retry import resolve_retry_policy
+    from petastorm_tpu.retry import make_circuit_breaker, resolve_retry_policy
 
+    retry_policy = resolve_retry_policy(io_retries, info.filesystem)
+    # one breaker shared by every worker of this reader (thread pools share
+    # the instance; spawned process workers unpickle per-process copies) -
+    # a storage outage fails fast with CircuitOpenError instead of every
+    # worker independently burning its full retry budget
+    circuit_breaker = make_circuit_breaker(retry_policy)
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
                                    predicate=worker_predicate,
                                    transform=transform_spec, cache=cache,
@@ -376,8 +426,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    verify_checksums=verify_checksums,
                                    raw_fields=device_fields,
                                    mixed_raw_fields=mixed_fields,
-                                   retry_policy=resolve_retry_policy(
-                                       io_retries, info.filesystem),
+                                   retry_policy=retry_policy,
+                                   circuit_breaker=circuit_breaker,
                                    telemetry=telemetry)
     if chaos is not None and chaos.affects_worker():
         from petastorm_tpu.test_util.chaos import ChaosWorker
@@ -400,7 +450,12 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         stop_on_failure=error_policy is None,
         max_requeue_attempts=(error_policy.max_requeue_attempts
                               if error_policy is not None
-                              else DEFAULT_REQUEUE_ATTEMPTS))
+                              else DEFAULT_REQUEUE_ATTEMPTS),
+        item_deadline_s=item_deadline_s,
+        hedge_after_s=hedge_after_s,
+        # the serial pool's per-item watchdog is the only observer of a
+        # mid-item stall there; it must honor the first-class kwarg too
+        stall_warn_s=stall_warn_s)
     start_item = 0
     if resume_from is not None and "elastic" not in resume_from:
         if "elastic_rebased" in resume_from:
@@ -421,7 +476,9 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     reader = Reader(info=info, schema=output_schema, plan=plan, executor=executor,
                     worker=worker, num_epochs=num_epochs, batched_output=batched_output,
                     start_item=start_item, ngram=ngram, telemetry=telemetry,
-                    error_policy=error_policy)
+                    error_policy=error_policy, stall_warn_s=stall_warn_s,
+                    stall_abort_s=stall_abort_s)
+    reader.circuit_breaker = circuit_breaker
     #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
     reader.device_decode_fields = device_fields
     #: subset using the mixed-geometry object wire format ('device-mixed')
@@ -523,7 +580,9 @@ class Reader:
     def __init__(self, info, schema: Schema, plan: ReadPlan, executor, worker,
                  num_epochs: Optional[int], batched_output: bool,
                  start_item: int = 0, ngram=None, telemetry=None,
-                 error_policy: Optional[ErrorPolicy] = None):
+                 error_policy: Optional[ErrorPolicy] = None,
+                 stall_warn_s: Optional[float] = None,
+                 stall_abort_s: Optional[float] = None):
         #: petastorm_tpu.telemetry recorder shared by the whole pipeline
         #: (no-op unless enabled); ``reader.telemetry.pipeline_report()``
         #: renders the stage-utilization bottleneck summary
@@ -553,12 +612,19 @@ class Reader:
         self._num_epochs = num_epochs
         self._stopped = False
         self._stall_aborted = False
-        # latched per reader: env wins over the module defaults (which tests
-        # may monkeypatch); <= 0 disables the respective behavior
-        self._stall_warn_s = _env_seconds("PETASTORM_TPU_STALL_WARN_S",
-                                          _STALL_WARN_S)
-        self._stall_abort_s = _env_seconds("PETASTORM_TPU_STALL_ABORT_S",
-                                           _STALL_ABORT_S)
+        # latched per reader: an explicit kwarg wins; None falls back to the
+        # env var, which wins over the module defaults (which tests may
+        # monkeypatch); <= 0 disables the respective behavior
+        self._stall_warn_s = (float(stall_warn_s) if stall_warn_s is not None
+                              else _env_seconds("PETASTORM_TPU_STALL_WARN_S",
+                                                _STALL_WARN_S))
+        self._stall_abort_s = (float(stall_abort_s)
+                               if stall_abort_s is not None
+                               else _env_seconds("PETASTORM_TPU_STALL_ABORT_S",
+                                                 _STALL_ABORT_S))
+        #: shared storage circuit breaker (petastorm_tpu.retry), set by
+        #: make_reader when io_retries arms one; None otherwise
+        self.circuit_breaker = None
         self.last_row_consumed = False
         #: set by make_reader after construction (decode_placement='device')
         self.device_decode_fields: list = []
@@ -659,14 +725,28 @@ class Reader:
         return (self._expected_items is not None
                 and self._consumed_items >= self._expected_items)
 
+    def _stalled_stage(self) -> str:
+        """Best-effort name of the stage the pipeline is stalled in (the
+        telemetry dominant stage - where cumulative busy time concentrated);
+        '' when telemetry is disabled or has no samples."""
+        if not self.telemetry.enabled:
+            return ""
+        try:
+            return dominant_stage(self.telemetry.snapshot())
+        except Exception:  # noqa: BLE001 - diagnostics must not mask a stall
+            return ""
+
     def _next_batch(self) -> ColumnBatch:
         """Next non-empty ColumnBatch, or StopIteration at end of all epochs.
 
-        Stall detection: when no result arrives for PETASTORM_TPU_STALL_WARN_S
-        seconds (default 120) a WARNING names the stuck workers and their work
-        items (executor heartbeats); PETASTORM_TPU_STALL_ABORT_S (default off)
-        escalates a longer stall to a WorkerError so a wedged pipeline fails
-        loudly instead of waiting forever.
+        Stall detection: when no result arrives for ``stall_warn_s`` seconds
+        (default 120; ``PETASTORM_TPU_STALL_WARN_S`` fallback) a WARNING
+        names the stuck workers and their work items (executor heartbeats)
+        plus the telemetry dominant stage when enabled; ``stall_abort_s``
+        (default off; ``PETASTORM_TPU_STALL_ABORT_S`` fallback) escalates a
+        longer stall to a PipelineStallError carrying the diagnostics
+        snapshot, so a wedged pipeline fails loudly instead of waiting
+        forever.
         """
         last_progress = time.monotonic()
         warned_at = 0.0
@@ -697,20 +777,25 @@ class Reader:
                 if self._stall_abort_s > 0 and stalled > self._stall_abort_s:
                     self._stall_aborted = True
                     diag = self.diagnostics  # snapshot before stop() mutates it
+                    stage = self._stalled_stage()
                     # stop the pipeline like the worker-failure path does:
                     # a caller that catches this must not inherit a live
                     # ventilator + polling workers
                     self.stop()
-                    raise WorkerError(
-                        f"No result for {stalled:.0f}s (PETASTORM_TPU_"
-                        f"STALL_ABORT_S={self._stall_abort_s:.0f}); pipeline"
-                        f" state: {diag}")
+                    raise PipelineStallError(
+                        f"No result for {stalled:.0f}s (stall_abort_s="
+                        f"{self._stall_abort_s:.0f})"
+                        + (f"; busiest stage: {stage}" if stage else "")
+                        + f"; pipeline state: {diag}", diagnostics=diag)
                 if (self._stall_warn_s > 0 and stalled > self._stall_warn_s
                         and stalled - warned_at > self._stall_warn_s):
                     warned_at = stalled
+                    stage = self._stalled_stage()
                     logger.warning(
-                        "Reader has produced no batch for %.0fs; pipeline"
-                        " state: %s", stalled, self.diagnostics)
+                        "Reader has produced no batch for %.0fs%s; pipeline"
+                        " state: %s", stalled,
+                        f" (busiest stage: {stage})" if stage else "",
+                        self.diagnostics)
                 continue
             if t0 is not None:
                 self._m_results_empty.add(time.perf_counter() - t0)
@@ -916,7 +1001,7 @@ class Reader:
         """Observability snapshot: items consumed/expected, epoch position,
         pool queue depths, and the fault ledger (skipped/quarantined
         rowgroups, requeued items)."""
-        return {**self._executor.diagnostics,
+        diag = {**self._executor.diagnostics,
                 "items_per_epoch": self._ventilator.items_per_epoch,
                 "consumed_items": self._consumed_items,
                 "expected_items": self._expected_items,
@@ -926,6 +1011,9 @@ class Reader:
                 # line into the full ledger (quarantined_rowgroups property
                 # has it all; the count above is always exact)
                 "quarantined_rowgroups": list(self._quarantine[-20:])}
+        if self.circuit_breaker is not None:
+            diag["circuit_breaker"] = self.circuit_breaker.snapshot()
+        return diag
 
     @property
     def quarantined_rowgroups(self) -> list:

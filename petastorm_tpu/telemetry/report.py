@@ -105,18 +105,19 @@ def render_pipeline_report(snapshot: Dict) -> str:
     if queue_lines:
         lines.append("queue time:")
         lines.extend(queue_lines)
-    # fault ledger: skipped/quarantined rowgroups, requeued work items and
-    # transient-IO retries get their own section - recurring weather must be
-    # visible in the report, not only in scrolled-away log warnings
+    # fault ledger: skipped/quarantined rowgroups, requeued work items,
+    # transient-IO retries and liveness interventions (hung-worker kills,
+    # hedges, circuit opens) get their own section - recurring weather must
+    # be visible in the report, not only in scrolled-away log warnings
     faults = {n: v for n, v in counters.items()
-              if n.startswith(("errors.", "io.retries"))}
+              if n.startswith(("errors.", "io.retries", "liveness."))}
     if faults:
-        lines.append("faults (skips / requeues / transient-IO retries):")
+        lines.append("faults (skips / requeues / IO retries / liveness):")
         for n, v in sorted(faults.items()):
             lines.append(f"  {n} = {v:g}")
     interesting = {n: v for n, v in counters.items()
                    if not n.startswith(("stage.", "queue.", "errors.",
-                                        "io.retries"))}
+                                        "io.retries", "liveness."))}
     if interesting:
         lines.append("counters:")
         for n, v in sorted(interesting.items()):

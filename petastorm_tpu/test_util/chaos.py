@@ -1,11 +1,13 @@
 """Deterministic chaos injection for the ingest pipeline.
 
 Degradation under faults must be measurable, not anecdotal: this module
-injects the four production failure modes - poisoned data (decode failures),
-slow items, transient IO errors, and hard worker kills (OOM/segfault) -
-deterministically by seed and work-item ordinal, so a chaos run is exactly
-reproducible and its assertions are exact ("these rowgroups were skipped",
-"this many retries fired"), not statistical.
+injects the five production failure modes - poisoned data (decode failures),
+slow items, transient IO errors, hard worker kills (OOM/segfault), and hung
+workers (a stuck blocking read / C-level deadlock that never returns NOR
+raises) - deterministically by seed and work-item ordinal, so a chaos run is
+exactly reproducible and its assertions are exact ("these rowgroups were
+skipped", "this many retries fired", "this many hung workers were killed"),
+not statistical.
 
 Usable from three places:
 
@@ -94,19 +96,31 @@ class ChaosSpec:
     kill_rate: float = 0.0
     kill_ordinals: Tuple[int, ...] = ()
     kill_on_retry: bool = False
+    #: hung workers (block inside the worker function for hang_s seconds -
+    #: effectively forever at test timescales): the liveness layer's target
+    #: failure mode (stuck GCS read, pathological decode, C-level deadlock).
+    #: Gated on attempt == 0 like kills, so the item requeued after a
+    #: deadline kill completes on its second attempt; ``hang_on_retry=True``
+    #: hangs every attempt (testing budget exhaustion -> quarantine).
+    hang_rate: float = 0.0
+    hang_ordinals: Tuple[int, ...] = ()
+    hang_on_retry: bool = False
+    hang_s: float = 3600.0
     #: transient IO failures + latency, injected via test_util.latency_fs
     fail_first_reads: int = 0
     fail_first_opens: int = 0
     io_latency_s: float = 0.0
 
     def __post_init__(self):
-        for name in ("decode_fail_rate", "slow_rate", "kill_rate"):
+        for name in ("decode_fail_rate", "slow_rate", "kill_rate",
+                     "hang_rate"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise PetastormTpuError(f"ChaosSpec.{name} must be in [0, 1]")
         # tolerate bare ints / lists in the ordinal fields (CLI parsing,
         # hand-written tests)
-        for name in ("decode_fail_ordinals", "slow_ordinals", "kill_ordinals"):
+        for name in ("decode_fail_ordinals", "slow_ordinals", "kill_ordinals",
+                     "hang_ordinals"):
             v = getattr(self, name)
             if isinstance(v, int):
                 object.__setattr__(self, name, (v,))
@@ -132,7 +146,7 @@ class ChaosSpec:
                     f"Unknown chaos key {key!r}; valid: {sorted(fields)}")
             if key.endswith("_ordinals"):
                 kwargs[key] = tuple(int(v) for v in raw.split(";") if v)
-            elif key == "kill_on_retry":
+            elif key in ("kill_on_retry", "hang_on_retry"):
                 kwargs[key] = raw.strip().lower() in ("1", "true", "yes", "on")
             elif key in ("seed", "fail_first_reads", "fail_first_opens"):
                 kwargs[key] = int(raw)
@@ -144,10 +158,12 @@ class ChaosSpec:
 
     def affects_worker(self) -> bool:
         """True when the spec injects worker-side faults (decode failures,
-        slow items, kills) - make_reader wraps the worker factory then."""
+        slow items, kills, hangs) - make_reader wraps the worker factory
+        then."""
         return bool(self.decode_fail_rate or self.decode_fail_ordinals
                     or self.slow_rate or self.slow_ordinals
-                    or self.kill_rate or self.kill_ordinals)
+                    or self.kill_rate or self.kill_ordinals
+                    or self.hang_rate or self.hang_ordinals)
 
     def affects_filesystem(self) -> bool:
         """True when the spec injects filesystem faults (transient IO
@@ -193,6 +209,18 @@ class ChaosSpec:
         return (ordinal in self.kill_ordinals
                 or self._roll("kill", ordinal, self.kill_rate))
 
+    def should_hang(self, ordinal: int, attempt: int = 0) -> bool:
+        """Deterministic decision: hang the worker handling this item?
+
+        Gated on ``attempt == 0`` unless ``hang_on_retry``: the copy
+        requeued after a deadline kill (or issued as a hedge) completes, so
+        "one hang" is recoverable; ``hang_on_retry=True`` makes the item
+        hang every attempt (the poisoned-slow-item quarantine scenario)."""
+        if attempt > 0 and not self.hang_on_retry:
+            return False
+        return (ordinal in self.hang_ordinals
+                or self._roll("hang", ordinal, self.hang_rate))
+
 
 class ChaosWorker:
     """Pool worker-factory wrapper injecting the spec's worker-side faults.
@@ -221,6 +249,18 @@ class ChaosWorker:
                         os._exit(137)
                     raise SimulatedWorkerCrash(
                         f"chaos: hard-killed worker on item {ordinal}")
+                if spec.should_hang(ordinal, attempt):
+                    # wedge like a stuck blocking read / C-level deadlock:
+                    # no result, no exception, heartbeat left naming the
+                    # item.  Only the liveness layer (SIGKILL + respawn for
+                    # process workers, slot abandonment for threads) or
+                    # stall-abort gets past this.  hang_s (default 1h) is
+                    # "forever" at test timescales while still letting an
+                    # abandoned daemon thread eventually exit.
+                    deadline = time.monotonic() + spec.hang_s
+                    while time.monotonic() < deadline:
+                        time.sleep(min(1.0, max(deadline - time.monotonic(),
+                                                0.01)))
                 if spec.should_slow(ordinal):
                     time.sleep(spec.slow_s)
                 if spec.should_fail_decode(ordinal):

@@ -67,6 +67,18 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=("raise", "skip"),
                         help="reader failure policy; 'skip' quarantines"
                              " failing rowgroups (listed in the report)")
+    parser.add_argument("--item-deadline", type=float, default=None,
+                        metavar="S",
+                        help="liveness: SIGKILL+respawn (process pool) or"
+                             " abandon (thread pool) a worker hung on one"
+                             " item for S seconds; the item is requeued")
+    from petastorm_tpu.pool import parse_hedge_after
+
+    parser.add_argument("--hedge-after", default=None, metavar="S|auto",
+                        type=parse_hedge_after,
+                        help="liveness: speculatively re-issue an item"
+                             " running longer than S seconds to an idle"
+                             " worker ('auto' = 4x telemetry decode p99)")
     return parser
 
 
@@ -74,38 +86,110 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                   pool_type: str = "thread", workers_count: int = 3,
                   num_epochs: int = 1, max_batches: int = 0,
                   telemetry: Optional[Telemetry] = None,
-                  chaos=None, on_error: str = "raise") -> dict:
+                  chaos=None, on_error: str = "raise",
+                  item_deadline_s: Optional[float] = None,
+                  hedge_after_s=None) -> dict:
     """Read ``dataset_url`` with telemetry enabled; returns a result dict
     with ``rows``, ``batches``, ``snapshot``, ``report``,
-    ``dominant_stage`` and the reader's fault ledger
-    (``quarantined_rowgroups``) - also the programmatic entry the tests
-    use."""
+    ``dominant_stage``, the reader's fault ledger
+    (``quarantined_rowgroups``) and a ``liveness`` verdict (hung-kill /
+    hedge / circuit counts + slowest observed in-flight item age) - also
+    the programmatic entry the tests use."""
     from petastorm_tpu.reader import make_batch_reader, make_reader
 
     tele = telemetry or Telemetry()
     factory = make_batch_reader if method == "batch" else make_reader
     rows = 0
     batches = 0
+    slowest_inflight = 0.0
     with factory(dataset_url, reader_pool_type=pool_type,
                  workers_count=workers_count, num_epochs=num_epochs,
                  shuffle_row_groups=False, telemetry=tele,
-                 chaos=chaos, on_error=on_error) as reader:
+                 chaos=chaos, on_error=on_error,
+                 item_deadline_s=item_deadline_s,
+                 hedge_after_s=hedge_after_s) as reader:
+
+        def _sample_inflight() -> None:
+            # slowest in-flight item age: the number a wedged production
+            # pipeline is triaged by (whose item is old, and how old)
+            nonlocal slowest_inflight
+            for _i, _o, age in reader.diagnostics.get("workers_busy", []):
+                slowest_inflight = max(slowest_inflight, age)
+
         if method == "batch":
             for batch in reader.iter_batches():
                 rows += batch.num_rows
                 batches += 1
+                _sample_inflight()
                 if max_batches and batches >= max_batches:
                     break
         else:
             for _ in reader:
                 rows += 1
+                if rows % 50 == 0:  # cheap, but not per-row
+                    _sample_inflight()
+        _sample_inflight()
         quarantined = reader.quarantined_rowgroups
+        final_diag = reader.diagnostics
     snapshot = tele.snapshot()
+    counters = snapshot.get("counters", {})
+    liveness = {
+        "hung_workers_killed": final_diag.get("hung_workers_killed", 0),
+        "hung_workers_abandoned": final_diag.get("hung_workers_abandoned", 0),
+        "hedged_items": final_diag.get("hedged_items", 0),
+        "hedge_wins": final_diag.get("hedge_wins", 0),
+        "requeued_items": final_diag.get("requeued_items", 0),
+        # parent-process view only: spawned process-pool workers hold their
+        # own breaker copies and record opens into their own telemetry
+        "circuit_opens": int(counters.get("liveness.circuit_opens", 0)),
+        "circuit_breaker": final_diag.get("circuit_breaker"),
+        # breaker signal that DOES cross the process boundary: rowgroups
+        # quarantined because a worker-side circuit was failing fast
+        "circuit_open_quarantines": sum(
+            1 for e in quarantined if e.get("exc_type") == "CircuitOpenError"),
+        "slowest_inflight_age_s": round(slowest_inflight, 3),
+    }
     return {"rows": rows, "batches": batches, "snapshot": snapshot,
             "report": tele.pipeline_report(),
             "dominant_stage": dominant_stage(snapshot),
             "quarantined_rowgroups": quarantined,
+            "liveness": liveness,
             "telemetry": tele}
+
+
+def render_liveness_verdict(liveness: dict) -> str:
+    """One-line liveness triage verdict from ``run_diagnosis``'s
+    ``liveness`` dict - the answer to "is this pipeline wedged, and on
+    what?" from one command."""
+    interventions = []
+    if liveness.get("hung_workers_killed"):
+        interventions.append(
+            f"{liveness['hung_workers_killed']} hung worker(s) killed+respawned")
+    if liveness.get("hung_workers_abandoned"):
+        interventions.append(
+            f"{liveness['hung_workers_abandoned']} hung thread slot(s) abandoned")
+    if liveness.get("hedged_items"):
+        interventions.append(
+            f"{liveness['hedged_items']} item(s) hedged"
+            f" ({liveness.get('hedge_wins', 0)} hedge win(s))")
+    if liveness.get("circuit_opens"):
+        interventions.append(
+            f"storage circuit opened {liveness['circuit_opens']}x")
+    if liveness.get("circuit_open_quarantines"):
+        # worker-side breaker activity: visible through the quarantine
+        # ledger even when the breaker lives in spawned worker processes
+        interventions.append(
+            f"{liveness['circuit_open_quarantines']} rowgroup(s) quarantined"
+            " on an open storage circuit")
+    breaker = liveness.get("circuit_breaker")
+    if breaker and breaker.get("state") != "closed":
+        interventions.append(f"circuit breaker {breaker['state']}")
+    verdict = ("liveness: " + ("; ".join(interventions) if interventions
+                               else "OK (no hung-worker kills, no hedges,"
+                                    " circuit closed)"))
+    verdict += (f"; slowest in-flight item age observed:"
+                f" {liveness.get('slowest_inflight_age_s', 0.0):.1f}s")
+    return verdict
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -132,7 +216,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                workers_count=args.workers_count,
                                num_epochs=args.num_epochs,
                                max_batches=args.max_batches,
-                               chaos=chaos, on_error=args.on_error)
+                               chaos=chaos, on_error=args.on_error,
+                               item_deadline_s=args.item_deadline,
+                               hedge_after_s=args.hedge_after)
         if args.trace_out:
             result["telemetry"].export_chrome_trace(args.trace_out)
         if args.json:
@@ -141,6 +227,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                               "dominant_stage": result["dominant_stage"],
                               "quarantined_rowgroups":
                                   result["quarantined_rowgroups"],
+                              "liveness": result["liveness"],
                               "snapshot": result["snapshot"]}))
         else:
             what = "synthetic dataset" if tmpdir else url
@@ -149,6 +236,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                      if args.method == "batch" else "")
                   + f" from {what}")
             print(result["report"])
+            print(render_liveness_verdict(result["liveness"]))
             for entry in result["quarantined_rowgroups"]:
                 print(f"quarantined: {entry['path']}#{entry['row_group']}"
                       f" (work item {entry['ordinal']}, {entry['kind']}"

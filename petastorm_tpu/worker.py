@@ -60,6 +60,7 @@ class RowGroupDecoderWorker:
                  raw_fields: Sequence[str] = (),
                  mixed_raw_fields: Sequence[str] = (),
                  retry_policy=None,
+                 circuit_breaker=None,
                  telemetry=None):
         self._fs_factory = fs_factory
         self._schema = schema
@@ -74,6 +75,13 @@ class RowGroupDecoderWorker:
         #: petastorm_tpu.retry.RetryPolicy (or None): transient read failures
         #: on remote stores are retried with the cached file handle dropped
         self._retry_policy = retry_policy
+        #: petastorm_tpu.retry.CircuitBreaker (or None), shared across this
+        #: reader's workers: consecutive transient failures open it and
+        #: rowgroup reads fail fast with CircuitOpenError instead of every
+        #: worker compounding retry storms against a down store.  Picklable:
+        #: spawned process-pool workers each hold their own copy (the
+        #: threshold is then per-process - documented in operations.md).
+        self._circuit_breaker = circuit_breaker
         #: fields delivered as raw encoded bytes (codec decode skipped) -
         #: decode_placement='device': the jax loader decodes them on-chip
         self._raw_fields = frozenset(raw_fields)
@@ -154,7 +162,8 @@ class RowGroupDecoderWorker:
                 what=f"rowgroup {item.row_group.path}"
                      f"#{item.row_group.row_group}",
                 on_retry=drop_handle,
-                telemetry=tele)
+                telemetry=tele,
+                breaker=self._circuit_breaker)
             if tele.enabled:
                 tele.counter("worker.rowgroups_decoded").add(1)
                 tele.counter("worker.rows_decoded").add(batch.num_rows)

@@ -1,0 +1,618 @@
+"""Liveness layer: per-item deadlines, hung-worker kill-and-replace,
+straggler hedging, and the storage circuit breaker (ISSUE 3 tentpole).
+
+The production contract under test: a worker that HANGS (stuck blocking GCS
+read, pathological decode, C-level deadlock) - as opposed to one that dies,
+which PR 2 already covers - must not stall the epoch.  With
+``make_reader(item_deadline_s=...)`` the hung worker is SIGKILLed and
+respawned (process pool) or its slot abandoned (thread pool), the item is
+requeued through the attempt budget, and the epoch completes with the exact
+healthy-row multiset; ``hedge_after_s`` speculatively re-issues stragglers
+with first-result-wins dedup; consecutive transient-IO failures open a
+circuit breaker that fails fast instead of compounding retry storms.  The
+same scenario WITHOUT a deadline still stalls (proving the layer is
+load-bearing), now surfacing as PipelineStallError via the first-class
+``stall_abort_s`` kwarg.
+"""
+
+import logging
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import (CircuitOpenError, ErrorPolicy,
+                                  PetastormTpuError, classify_error)
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.pool import (PipelineStallError, ThreadedExecutor,
+                                VentilatedItem, WorkerError, make_executor)
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.retry import (CircuitBreaker, RetryPolicy,
+                                 make_circuit_breaker, retry_call)
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry, render_pipeline_report
+from petastorm_tpu.test_util.chaos import ChaosSpec
+from petastorm_tpu.test_util.stub_workers import SleepyWorker
+
+SCHEMA = Schema("Liveness", [Field("x", np.int64)])
+N_ROWS = 40
+RG_ROWS = 4  # 10 rowgroups of 4 rows
+
+
+def _write(tmp_path):
+    url = str(tmp_path / "ds")
+    write_dataset(url, SCHEMA, [{"x": i} for i in range(N_ROWS)],
+                  row_group_size_rows=RG_ROWS)
+    return url
+
+
+def _rows_of_rowgroups(ordinals):
+    out = set()
+    for o in ordinals:
+        out |= set(range(o * RG_ROWS, (o + 1) * RG_ROWS))
+    return out
+
+
+# -- chaos hang injection ------------------------------------------------------
+
+def test_chaos_hang_spec_parse_gate_and_determinism():
+    spec = ChaosSpec.parse(
+        "hang_ordinals=2;5,hang_s=9,hang_on_retry=true,hang_rate=0.0,seed=3")
+    assert spec.hang_ordinals == (2, 5)
+    assert spec.hang_s == 9.0 and spec.hang_on_retry
+    assert spec.affects_worker()
+    # attempt gate mirrors kills: a requeued/hedged copy does not re-hang
+    # unless hang_on_retry
+    assert spec.should_hang(2, attempt=1)  # hang_on_retry=true in the spec
+    gated = ChaosSpec(hang_ordinals=(2,))
+    assert gated.should_hang(2, attempt=0)
+    assert not gated.should_hang(2, attempt=1)
+    # rate-based decisions are pure functions of (seed, kind, ordinal)
+    rated = ChaosSpec(seed=1, hang_rate=0.5)
+    picks = [rated.should_hang(i) for i in range(100)]
+    assert picks == [rated.should_hang(i) for i in range(100)]
+    assert 20 < sum(picks) < 80
+    with pytest.raises(PetastormTpuError):
+        ChaosSpec(hang_rate=1.5)
+
+
+# -- circuit breaker units -----------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_circuit_breaker_opens_half_opens_closes():
+    clock = _FakeClock()
+    b = CircuitBreaker(threshold=3, cooldown_s=10.0, clock=clock)
+    assert b.state == "closed"
+    for _ in range(2):
+        b.before_call()
+        assert not b.record_failure()
+    b.before_call()
+    assert b.record_failure()  # third consecutive failure OPENS
+    assert b.state == "open" and b.is_open and b.opens == 1
+    with pytest.raises(CircuitOpenError, match="circuit breaker is open"):
+        b.before_call("rowgroup read")
+    assert b.failfasts == 1
+    # cooldown elapses: exactly ONE caller is admitted as the probe,
+    # concurrent callers keep failing fast
+    clock.now += 10.0
+    b.before_call("probe")
+    assert b.state == "half-open"
+    with pytest.raises(CircuitOpenError, match="probe in flight"):
+        b.before_call("concurrent")
+    # probe fails -> re-opens and restarts the cooldown
+    assert b.record_failure()
+    assert b.state == "open" and b.opens == 2
+    clock.now += 10.0
+    b.before_call("probe2")
+    b.record_success()  # probe succeeds -> closed, count reset
+    assert b.state == "closed" and not b.is_open
+    b.before_call()
+    snap = b.snapshot()
+    assert snap["state"] == "closed" and snap["opens"] == 2
+    # a success anywhere resets the consecutive count
+    b.record_failure()
+    b.record_success()
+    assert b.snapshot()["consecutive_failures"] == 0
+
+
+def test_circuit_breaker_policy_resolution_and_validation():
+    assert make_circuit_breaker(None) is None
+    assert make_circuit_breaker(
+        RetryPolicy(circuit_threshold=None)) is None
+    b = make_circuit_breaker(RetryPolicy(circuit_threshold=5,
+                                         circuit_cooldown_s=1.0))
+    assert b.threshold == 5 and b.cooldown_s == 1.0
+    with pytest.raises(PetastormTpuError):
+        RetryPolicy(circuit_threshold=0)
+    with pytest.raises(PetastormTpuError):
+        RetryPolicy(circuit_cooldown_s=-1)
+    # CircuitOpenError is an OSError (classifies 'data', skip-eligible) but
+    # must never itself be retried as transient
+    from petastorm_tpu.retry import is_transient
+
+    err = CircuitOpenError("open")
+    assert isinstance(err, OSError)
+    assert classify_error(err) == "data"
+    assert not is_transient(err)
+
+
+def test_retry_call_fails_fast_once_circuit_opens():
+    """A failure that trips the breaker mid-retry surfaces the outage NOW
+    (CircuitOpenError before the next backoff sleep), and later calls fail
+    fast without invoking the function at all."""
+    tele = Telemetry()
+    breaker = CircuitBreaker(threshold=2, cooldown_s=60.0)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise OSError("injected transient weather")
+
+    with pytest.raises(CircuitOpenError):
+        retry_call(flaky, RetryPolicy(max_attempts=5, initial_backoff_s=0.0),
+                   what="rowgroup test", sleep=lambda s: None,
+                   telemetry=tele, breaker=breaker)
+    # opened after 2 consecutive failures: the remaining 3 attempts of the
+    # budget were NOT burned against the down store
+    assert len(calls) == 2
+    calls.clear()
+    with pytest.raises(CircuitOpenError):
+        retry_call(flaky, RetryPolicy(max_attempts=5),
+                   what="rowgroup test2", sleep=lambda s: None,
+                   breaker=breaker)
+    assert calls == []  # not even one call while open
+    assert tele.snapshot()["counters"]["liveness.circuit_opens"] == 1
+
+
+def test_circuit_breaker_under_scripted_latency_fs_weather(tmp_path):
+    """Scripted storage weather through the REAL filesystem layer: latent_fs
+    fails the first 4 opens; the breaker opens mid-storm (short-cutting the
+    retry budget), fails fast without issuing IO, re-opens on failed
+    half-open probes, and closes on the first healthy probe."""
+    from petastorm_tpu.test_util.latency_fs import latent_filesystem
+
+    victim = tmp_path / "blob.bin"
+    victim.write_bytes(b"\x01" * 128)
+    fs, stats = latent_filesystem(latency_s=0.0, fail_first_opens=4)
+    breaker = CircuitBreaker(threshold=2, cooldown_s=0.05)
+    policy = RetryPolicy(max_attempts=3, initial_backoff_s=0.0)
+
+    def read_once():
+        with fs.open_input_file(str(victim)) as f:
+            return f.read()
+
+    def call():
+        return retry_call(read_once, policy, what="blob",
+                          sleep=lambda s: None, breaker=breaker)
+
+    # injected failures 1+2 trip the threshold on the second attempt; the
+    # third attempt of the budget is NOT burned - CircuitOpenError now
+    with pytest.raises(CircuitOpenError):
+        call()
+    assert breaker.state == "open" and breaker.opens == 1
+    assert stats.failures_injected == 2
+    with pytest.raises(CircuitOpenError):  # open: fail fast, zero IO issued
+        call()
+    assert stats.failures_injected == 2
+    for expected_opens in (2, 3):  # two failed half-open probes re-open
+        time.sleep(0.06)
+        with pytest.raises(CircuitOpenError):
+            call()
+        assert breaker.opens == expected_opens
+    assert stats.failures_injected == 4  # the scripted storm is spent
+    time.sleep(0.06)  # healthy probe closes the circuit
+    assert call() == b"\x01" * 128
+    assert breaker.state == "closed"
+    assert call() == b"\x01" * 128  # and stays closed
+
+
+def test_failed_probe_with_non_transient_error_releases_slot():
+    """A half-open probe whose call dies with a NON-transient error (expired
+    credentials, deleted file) must release the probe slot - otherwise the
+    breaker reports 'probe in flight' forever and never recovers."""
+    clock = _FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    with pytest.raises(CircuitOpenError):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("weather")),
+                   RetryPolicy(max_attempts=2, initial_backoff_s=0.0),
+                   what="t", sleep=lambda s: None, breaker=b)
+    clock.now += 5.0
+
+    def durable_failure():
+        raise PermissionError("token expired")
+
+    with pytest.raises(PermissionError):  # probe call, non-transient outcome
+        retry_call(durable_failure, RetryPolicy(max_attempts=2),
+                   what="t", sleep=lambda s: None, breaker=b)
+    # the slot was released: a later caller can still probe (and close)
+    assert b.state == "half-open"
+    retry_call(lambda: "ok", RetryPolicy(), what="t", breaker=b)
+    assert b.state == "closed"
+
+
+# -- executor-level liveness ---------------------------------------------------
+
+def test_make_executor_validates_liveness_kwargs():
+    with pytest.raises(PetastormTpuError, match="item_deadline_s"):
+        make_executor("thread", item_deadline_s=0)
+    with pytest.raises(PetastormTpuError, match="hedge_after_s"):
+        make_executor("thread", hedge_after_s="sometimes")
+    with pytest.raises(PetastormTpuError, match="hedge_after_s"):
+        make_executor("process", hedge_after_s=-1)
+    ex = make_executor("thread", item_deadline_s=5.0, hedge_after_s="auto")
+    assert ex.diagnostics["hung_workers_killed"] == 0
+    assert ex.diagnostics["hedged_items"] == 0
+
+
+def test_serial_executor_accepts_but_warns_liveness(caplog):
+    with caplog.at_level(logging.WARNING, logger="petastorm_tpu.pool"):
+        ex = make_executor("serial", item_deadline_s=1.0)
+    assert any("inoperative" in rec.message for rec in caplog.records)
+    ex.start(SleepyWorker(0))
+    ex.put(VentilatedItem(0, 0))
+    assert ex.get(timeout=5).item == 0
+    ex.stop()
+    ex.join()
+
+
+def test_hedge_auto_threshold_derives_from_decode_p99():
+    tele = Telemetry()
+    executors = [ThreadedExecutor(workers_count=1, telemetry=tele,
+                                  hedge_after_s="auto"),
+                 ThreadedExecutor(workers_count=1, hedge_after_s=2.5),
+                 ThreadedExecutor(workers_count=1, hedge_after_s="auto")]
+    ex, ex_numeric, ex_untelemetered = executors
+    try:
+        assert ex._hedge_threshold() is None  # no decode samples yet
+        hist = tele.histogram("stage.decode.latency_s")
+        for _ in range(25):
+            hist.record(0.01)
+        thr = ex._hedge_threshold()
+        assert thr == pytest.approx(max(4.0 * hist.quantile(0.99), 0.5))
+        # numeric thresholds pass straight through
+        assert ex_numeric._hedge_threshold() == 2.5
+        # auto without telemetry never arms (no data to calibrate against)
+        assert ex_untelemetered._hedge_threshold() is None
+    finally:
+        for e in executors:
+            e.stop()
+            e.join()
+
+
+# -- reader-level: hung worker recovery ----------------------------------------
+
+def test_thread_pool_hung_worker_abandoned_epoch_exact(tmp_path):
+    """A thread worker hung past item_deadline_s is abandoned (threads
+    cannot be killed), its item requeued onto a sibling, and the epoch
+    completes with the exact row multiset - no hang, no loss, no dupes."""
+    url = _write(tmp_path)
+    chaos = ChaosSpec(hang_ordinals=(3,), hang_s=60)
+    tele = Telemetry()
+    t0 = time.monotonic()
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=2,
+                           shuffle_row_groups=False, chaos=chaos,
+                           item_deadline_s=0.7, telemetry=tele) as r:
+        rows = sorted(x for b in r.iter_batches() for x in b.columns["x"])
+        diag = r.diagnostics
+    # completes promptly (deadline + margin + bounded liveness join), not
+    # after the 60s hang
+    assert time.monotonic() - t0 < 30
+    assert rows == list(range(N_ROWS))
+    assert diag["hung_workers_abandoned"] == 1
+    assert diag["requeued_items"] == 1
+    counters = tele.snapshot()["counters"]
+    assert counters["liveness.hung_workers_abandoned"] == 1
+    assert counters["errors.requeued_items"] == 1
+
+
+def test_thread_pool_repeat_hanging_item_quarantines_as_data(tmp_path):
+    """An item that hangs EVERY worker that touches it (hang_on_retry)
+    exhausts the requeue budget and quarantines as a data error under a
+    skip policy - the poisoned-slow-item path of the ISSUE tentpole."""
+    url = _write(tmp_path)
+    chaos = ChaosSpec(hang_ordinals=(3,), hang_on_retry=True, hang_s=60)
+    policy = ErrorPolicy(max_requeue_attempts=1)
+    t0 = time.monotonic()
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=3,
+                           shuffle_row_groups=False, chaos=chaos,
+                           item_deadline_s=0.5, on_error=policy) as r:
+        rows = sorted(x for b in r.iter_batches() for x in b.columns["x"])
+        diag = r.diagnostics
+    assert time.monotonic() - t0 < 60
+    assert rows == sorted(set(range(N_ROWS)) - _rows_of_rowgroups([3]))
+    # attempt 0 and the requeued attempt 1 both hung -> two slots abandoned
+    assert diag["hung_workers_abandoned"] == 2
+    assert diag["skipped_rowgroups"] == 1
+    entry = diag["quarantined_rowgroups"][0]
+    assert entry["ordinal"] == 3 and entry["kind"] == "data"
+
+
+def test_all_thread_workers_abandoned_raises_not_wedges():
+    """When every thread slot has been abandoned as hung, queued items have
+    no one to run them: the pool must raise a classified WorkerError (like
+    the all-dead path), never wait forever on work nobody will do."""
+    from petastorm_tpu.test_util.chaos import ChaosWorker
+
+    chaos = ChaosSpec(hang_ordinals=(0,), hang_s=60)
+    ex = ThreadedExecutor(workers_count=1, item_deadline_s=0.3,
+                          max_requeue_attempts=2)
+    try:
+        ex.start(ChaosWorker(SleepyWorker(0), chaos))
+        ex.put(VentilatedItem(0, 0))
+        ex.put(VentilatedItem(1, 1))
+        t0 = time.monotonic()
+        with pytest.raises(WorkerError, match="abandoned as hung"):
+            while True:
+                try:
+                    ex.get(timeout=0.5)
+                except queue.Empty:
+                    pass
+                assert time.monotonic() - t0 < 30
+    finally:
+        ex.stop()
+        ex.join()
+
+
+# -- reader-level: straggler hedging -------------------------------------------
+
+def test_thread_pool_hedged_straggler_delivers_exactly_once(tmp_path):
+    """An item straggling past hedge_after_s is speculatively re-issued to
+    an idle worker; the hedge copy (attempt 1, which the chaos hang gate
+    skips) wins, the row multiset is exact, and the win is counted."""
+    url = _write(tmp_path)
+    chaos = ChaosSpec(hang_ordinals=(4,), hang_s=60)
+    tele = Telemetry()
+    t0 = time.monotonic()
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=2,
+                           shuffle_row_groups=False, chaos=chaos,
+                           hedge_after_s=0.4, telemetry=tele) as r:
+        rows = sorted(x for b in r.iter_batches() for x in b.columns["x"])
+        diag = r.diagnostics
+    assert time.monotonic() - t0 < 30
+    assert rows == list(range(N_ROWS))  # exactly once, loser deduped
+    assert diag["hedged_items"] == 1
+    assert diag["hedge_wins"] == 1
+    counters = tele.snapshot()["counters"]
+    assert counters["liveness.hedged_items"] == 1
+    assert counters["liveness.hedge_wins"] == 1
+
+
+def test_hedge_duplicate_delivery_is_deduped():
+    """Both copies of a hedged item eventually deliver: the ledger settles
+    the first and drops the second (consumed counts stay exact)."""
+    chaos = ChaosSpec(slow_ordinals=(2,), slow_s=1.2)
+    from petastorm_tpu.test_util.chaos import ChaosWorker
+
+    ex = ThreadedExecutor(workers_count=2, hedge_after_s=0.3)
+    try:
+        ex.start(ChaosWorker(SleepyWorker(0), chaos))
+        for i in range(6):
+            ex.put(VentilatedItem(i, i))
+        out = []
+        deadline = time.monotonic() + 30
+        while len(out) < 6 and time.monotonic() < deadline:
+            try:
+                out.append(ex.get(timeout=0.5))
+            except queue.Empty:
+                continue
+        assert sorted(v.item for v in out) == list(range(6))
+        # the slow original ALSO finishes: give its duplicate time to land,
+        # then verify nothing extra is ever delivered
+        time.sleep(1.5)
+        with pytest.raises(queue.Empty):
+            ex.get(timeout=0.5)
+        assert ex.diagnostics["hedged_items"] >= 1
+        assert ex.diagnostics["consumed"] == 6
+    finally:
+        ex.stop()
+        ex.join()
+
+
+# -- the headline acceptance e2e ----------------------------------------------
+
+def test_process_pool_hang_kill_and_replace_e2e(tmp_path):
+    """Acceptance: >= 2 permanent hangs across process workers; with
+    item_deadline_s the hung workers are SIGKILLed and REPLACED, the items
+    requeue onto the respawned workers, and the epoch completes with the
+    exact healthy-row multiset and liveness.hung_workers_killed >= 2."""
+    url = _write(tmp_path)
+    chaos = ChaosSpec(hang_ordinals=(2, 6), hang_s=300)
+    tele = Telemetry()
+    t0 = time.monotonic()
+    with make_batch_reader(url, reader_pool_type="process", workers_count=2,
+                           shuffle_row_groups=False, chaos=chaos,
+                           item_deadline_s=1.5, telemetry=tele) as r:
+        rows = sorted(x for b in r.iter_batches() for x in b.columns["x"])
+        diag = r.diagnostics
+    assert time.monotonic() - t0 < 120  # NOT the 300s hang
+    assert rows == list(range(N_ROWS))  # no hang, no dupes, no lost rows
+    assert diag["hung_workers_killed"] >= 2
+    assert diag["requeued_items"] >= 2
+    assert tele.snapshot()["counters"]["liveness.hung_workers_killed"] >= 2
+
+
+def test_same_scenario_without_deadline_stalls(tmp_path):
+    """Load-bearing proof: the identical hang scenario WITHOUT a deadline
+    wedges the pipeline - surfaced (bounded by the test timeout) as a
+    PipelineStallError from the first-class stall_abort_s kwarg, carrying
+    the diagnostics snapshot that names the stuck workers."""
+    url = _write(tmp_path)
+    chaos = ChaosSpec(hang_ordinals=(2, 6), hang_s=300)
+    t0 = time.monotonic()
+    with pytest.raises(PipelineStallError) as ei:
+        with make_batch_reader(url, reader_pool_type="process",
+                               workers_count=2, shuffle_row_groups=False,
+                               chaos=chaos, stall_warn_s=1.0,
+                               stall_abort_s=3.0) as r:
+            list(r.iter_batches())
+    assert time.monotonic() - t0 < 90
+    err = ei.value
+    assert isinstance(err, WorkerError)  # existing handlers keep working
+    assert err.kind == "infra"
+    # diagnostics attached: the wedged state survives into the exception
+    # (workers_busy may be empty if the stall raced worker spawn - the
+    # snapshot itself, not its timing, is the contract)
+    assert "workers_busy" in err.diagnostics, err.diagnostics
+    assert err.diagnostics["consumed_items"] < err.diagnostics["expected_items"]
+    assert "stall_abort_s" in str(err)
+
+
+# -- stall kwargs satellite ----------------------------------------------------
+
+def test_stall_kwargs_override_env(tmp_path, monkeypatch):
+    url = _write(tmp_path)
+    monkeypatch.setenv("PETASTORM_TPU_STALL_WARN_S", "77")
+    monkeypatch.setenv("PETASTORM_TPU_STALL_ABORT_S", "88")
+    with make_batch_reader(url, reader_pool_type="serial",
+                           shuffle_row_groups=False) as r:
+        assert r._stall_warn_s == 77.0 and r._stall_abort_s == 88.0
+    with make_batch_reader(url, reader_pool_type="serial",
+                           shuffle_row_groups=False,
+                           stall_warn_s=5.0, stall_abort_s=9.0) as r:
+        assert r._stall_warn_s == 5.0 and r._stall_abort_s == 9.0
+    # 0 disables explicitly even when the env arms it
+    with make_batch_reader(url, reader_pool_type="serial",
+                           shuffle_row_groups=False, stall_abort_s=0) as r:
+        assert r._stall_abort_s == 0.0
+
+
+def test_stall_warn_kwarg_reaches_serial_watchdog(tmp_path, monkeypatch):
+    """The serial pool's per-item watchdog is the only observer of a
+    mid-item stall on that flavor: the first-class kwarg must reach it,
+    not just the reader-side loop (which cannot see serial stalls)."""
+    url = _write(tmp_path)
+    monkeypatch.setenv("PETASTORM_TPU_STALL_WARN_S", "120")
+    with make_batch_reader(url, reader_pool_type="serial",
+                           shuffle_row_groups=False, stall_warn_s=7.0) as r:
+        assert r._executor._stall_warn_s == 7.0
+    assert make_executor("serial", stall_warn_s=3.0)._stall_warn_s == 3.0
+
+
+# -- observability surfaces ----------------------------------------------------
+
+def test_report_renders_liveness_counters_in_faults_section():
+    tele = Telemetry()
+    tele.counter("liveness.hung_workers_killed").add(2)
+    tele.counter("liveness.hedged_items").add(3)
+    tele.counter("liveness.circuit_opens").add(1)
+    report = render_pipeline_report(tele.snapshot())
+    faults_at = report.index("faults (")
+    for name in ("liveness.hung_workers_killed = 2",
+                 "liveness.hedged_items = 3",
+                 "liveness.circuit_opens = 1"):
+        assert report.index(name) > faults_at, report
+
+
+def test_diagnose_liveness_verdict(tmp_path):
+    from petastorm_tpu.tools.diagnose import (render_liveness_verdict,
+                                              run_diagnosis)
+
+    url = _write(tmp_path)
+    result = run_diagnosis(url, pool_type="thread", workers_count=2)
+    liveness = result["liveness"]
+    for key in ("hung_workers_killed", "hedged_items", "hedge_wins",
+                "circuit_opens", "circuit_open_quarantines",
+                "slowest_inflight_age_s"):
+        assert key in liveness
+    assert "OK" in render_liveness_verdict(liveness)
+    # a degraded run flips the verdict and names the intervention
+    chaos = ChaosSpec(hang_ordinals=(3,), hang_s=60)
+    result = run_diagnosis(url, pool_type="thread", workers_count=2,
+                           chaos=chaos, item_deadline_s=0.6)
+    assert result["rows"] == N_ROWS
+    assert result["liveness"]["hung_workers_abandoned"] >= 1
+    verdict = render_liveness_verdict(result["liveness"])
+    assert "abandoned" in verdict and "OK" not in verdict
+
+
+def test_cli_parsers_accept_liveness_flags(tmp_path, capsys):
+    from petastorm_tpu.benchmark.cli import build_parser as bench_parser
+    from petastorm_tpu.tools.diagnose import build_parser as diag_parser
+
+    args = bench_parser().parse_args(
+        ["file:///ds", "--item-deadline", "30", "--hedge-after", "auto"])
+    assert args.item_deadline == 30.0 and args.hedge_after == "auto"
+    args = diag_parser().parse_args(
+        ["--synthetic", "--item-deadline", "10", "--hedge-after", "2.5"])
+    assert args.item_deadline == 10.0 and args.hedge_after == 2.5
+    # malformed values are argparse usage errors, not raw tracebacks
+    for parser, argv in ((bench_parser(), ["file:///ds"]),
+                         (diag_parser(), ["--synthetic"])):
+        with pytest.raises(SystemExit):
+            parser.parse_args(argv + ["--hedge-after", "2s"])
+        assert "hedge-after" in capsys.readouterr().err
+
+
+def test_reader_diagnostics_include_circuit_breaker(tmp_path):
+    """A reader whose io_retries armed a breaker surfaces its state in
+    diagnostics (local fs never arms one; a latent 'remote' fs does)."""
+    from petastorm_tpu.test_util.latency_fs import latent_filesystem
+
+    url = _write(tmp_path)
+    with make_batch_reader(url, reader_pool_type="serial",
+                           shuffle_row_groups=False) as r:
+        assert r.circuit_breaker is None  # local fs: no retries, no breaker
+    fs, _stats = latent_filesystem(latency_s=0.0)
+    with make_batch_reader(url, reader_pool_type="serial",
+                           shuffle_row_groups=False, filesystem=fs) as r:
+        rows = sorted(x for b in r.iter_batches() for x in b.columns["x"])
+        assert r.circuit_breaker is not None
+        diag = r.diagnostics
+    assert rows == list(range(N_ROWS))
+    assert diag["circuit_breaker"]["state"] == "closed"
+    assert diag["circuit_breaker"]["opens"] == 0
+
+
+# -- loader shutdown-join satellite -------------------------------------------
+
+class _WedgedThread:
+    name = "petastorm-tpu-jax-assembly"
+
+    def join(self, timeout=None):
+        pass  # never quiesces
+
+    def is_alive(self):
+        return True
+
+
+def test_loader_join_surfaces_unquiesced_threads(tmp_path, caplog):
+    """JaxDataLoader.join() no longer swallows a producer thread that missed
+    the stop() join budget: it logs the thread + stage and records it in
+    diagnostics['unquiesced_threads']."""
+    jax = pytest.importorskip("jax")  # noqa: F841 - loader needs a backend
+    from petastorm_tpu.jax.loader import JaxDataLoader
+
+    url = _write(tmp_path)
+    reader = make_batch_reader(url, reader_pool_type="serial",
+                               shuffle_row_groups=False)
+    loader = JaxDataLoader(reader, batch_size=4)
+    try:
+        assert loader.diagnostics["unquiesced_threads"] == []
+        # simulate a wedged assembly thread (a hung transform_fn): the real
+        # thread never started, so stand in a permanently-alive stub
+        loader._started = True
+        wedged = _WedgedThread()
+        loader._thread = wedged
+        transfer = _WedgedThread()
+        transfer.name = "petastorm-tpu-jax-transfer"
+        loader._transfer_thread = transfer
+        loader.stop()
+        with caplog.at_level(logging.WARNING, logger="petastorm_tpu.jax.loader"):
+            loader.join()
+        assert any("failed to quiesce" in rec.message
+                   for rec in caplog.records)
+        entries = loader.diagnostics["unquiesced_threads"]
+        assert {e["stage"] for e in entries} == {"host-assemble",
+                                                 "device-transfer"}
+        assert entries[0]["thread"] == "petastorm-tpu-jax-assembly"
+    finally:
+        reader.stop()
+        reader.join()
