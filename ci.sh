@@ -128,6 +128,45 @@ PY
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 python "$HANG_SMOKE"
 rm -f "$HANG_SMOKE"
 
+echo "== metrics endpoint smoke (ephemeral port scrape during a chaos read) =="
+# a chaos read serving --metrics-port 0 must expose Prometheus series for the
+# decode stage and the liveness fault counters on one scrape of the ephemeral
+# endpoint - the live-observability contract (docs/operations.md "Live
+# monitoring").  stdlib urllib stands in for curl (same GET, no extra dep).
+JAX_PLATFORMS=cpu python - <<'PY'
+import tempfile
+import urllib.request
+import numpy as np
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.test_util.chaos import ChaosSpec
+
+tmp = tempfile.mkdtemp(prefix="petastorm_tpu_metrics_smoke_")
+schema = Schema("MetricsSmoke", [Field("x", np.int64)])
+write_dataset(tmp, schema, [{"x": i} for i in range(60)],
+              row_group_size_rows=10)
+chaos = ChaosSpec(decode_fail_ordinals=(2,))
+with make_batch_reader(tmp, reader_pool_type="thread", workers_count=2,
+                       shuffle_row_groups=False, chaos=chaos,
+                       on_error="skip", metrics_port=0,
+                       sample_interval_s=0.2) as reader:
+    port = reader.metrics_server.port
+    rows = sorted(x for b in reader.iter_batches() for x in b.columns["x"])
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+assert rows == sorted(set(range(60)) - set(range(20, 30))), len(rows)
+assert 'petastorm_tpu_stage_ops_total{stage="decode"}' in body, body[:400]
+assert 'petastorm_tpu_stage_latency_seconds{stage="decode"' in body
+assert "petastorm_tpu_liveness_hung_workers_killed_total" in body
+assert "petastorm_tpu_errors_skipped_rowgroups_total 1" in body
+diag = reader.diagnostics
+assert diag["telemetry"]["counters"]["errors.skipped_rowgroups"] == 1
+print(f"metrics endpoint smoke OK (port {port}, {len(body.splitlines())}"
+      " exposition lines, stage_decode + liveness series present,"
+      " final snapshot attached)")
+PY
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

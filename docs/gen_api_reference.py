@@ -85,8 +85,14 @@ API = [
                                  "Histogram", "TraceBuffer", "resolve",
                                  "enable", "enabled_from_env",
                                  "render_pipeline_report", "dominant_stage"]),
+    ("petastorm_tpu.telemetry.sampler", ["MetricsSampler", "flight_record",
+                                         "dump_flight_record",
+                                         "load_flight_records"]),
+    ("petastorm_tpu.telemetry.export", ["MetricsExportServer",
+                                        "render_prometheus", "write_jsonl"]),
     ("petastorm_tpu.tools.diagnose", ["run_diagnosis",
-                                      "render_liveness_verdict"]),
+                                      "render_liveness_verdict",
+                                      "render_watch_frame"]),
     ("petastorm_tpu.test_util.chaos", ["ChaosSpec", "ChaosWorker",
                                        "SimulatedWorkerCrash"]),
 ]

@@ -86,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
                              " running longer than S seconds to an idle"
                              " worker, first result wins ('auto' = 4x the"
                              " telemetry decode p99; needs --telemetry)")
+    parser.add_argument("--metrics-port", type=int, default=None, metavar="N",
+                        help="serve live metrics in Prometheus text format"
+                             " on localhost:N for the benchmark's lifetime"
+                             " (0 = ephemeral); auto-enables telemetry")
+    parser.add_argument("--flight-record", metavar="PATH", default=None,
+                        help="on a terminal reader failure, dump the flight"
+                             " record (sampled series + trace tail) to PATH"
+                             " as JSONL; auto-enables telemetry")
     return parser
 
 
@@ -120,7 +128,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             device_decode_fields=args.decode_device,
             prefetch=args.prefetch, telemetry=telemetry,
             chaos=chaos, on_error=args.on_error,
-            item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after)
+            item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after,
+            metrics_port=args.metrics_port,
+            flight_record_path=args.flight_record)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(
@@ -129,7 +139,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             pool_type=args.pool_type, workers_count=args.workers_count,
             read_method=args.method, shuffle_row_groups=not args.no_shuffle,
             telemetry=telemetry, chaos=chaos, on_error=args.on_error,
-            item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after)
+            item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after,
+            metrics_port=args.metrics_port,
+            flight_record_path=args.flight_record)
 
     if telemetry is not None and args.trace_out and not args.isolated:
         telemetry.export_chrome_trace(args.trace_out)

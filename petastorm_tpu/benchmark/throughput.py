@@ -90,7 +90,9 @@ def reader_throughput(dataset_url: str,
                       telemetry=None, chaos=None,
                       on_error="raise",
                       item_deadline_s: Optional[float] = None,
-                      hedge_after_s=None) -> BenchmarkResult:
+                      hedge_after_s=None,
+                      metrics_port: Optional[int] = None,
+                      flight_record_path: Optional[str] = None) -> BenchmarkResult:
     """Measure raw reader throughput in samples/sec.
 
     ``read_method='row'`` counts one sample per ``next()`` (make_reader);
@@ -116,7 +118,15 @@ def reader_throughput(dataset_url: str,
                  storage_options=storage_options, telemetry=tele,
                  chaos=chaos, on_error=on_error,
                  item_deadline_s=item_deadline_s,
-                 hedge_after_s=hedge_after_s) as reader:
+                 hedge_after_s=hedge_after_s,
+                 metrics_port=metrics_port,
+                 flight_record_path=flight_record_path) as reader:
+        if reader.metrics_server is not None:
+            # stderr so --json stdout stays one parseable line; without this
+            # an ephemeral --metrics-port 0 endpoint would be unreachable
+            # (the bound port lives only on the reader)
+            print("metrics endpoint: http://127.0.0.1:"
+                  f"{reader.metrics_server.port}/metrics", file=sys.stderr)
         it = iter(reader)
 
         def consume(cycles: int) -> int:
@@ -152,7 +162,9 @@ def jax_loader_throughput(dataset_url: str,
                           telemetry=None, chaos=None,
                           on_error="raise",
                           item_deadline_s: Optional[float] = None,
-                          hedge_after_s=None) -> BenchmarkResult:
+                          hedge_after_s=None,
+                          metrics_port: Optional[int] = None,
+                          flight_record_path: Optional[str] = None) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
@@ -181,7 +193,13 @@ def jax_loader_throughput(dataset_url: str,
         decode_placement=({f: "device" for f in device_decode_fields}
                           if device_decode_fields else None),
         telemetry=tele, chaos=chaos, on_error=on_error,
-        item_deadline_s=item_deadline_s, hedge_after_s=hedge_after_s)
+        item_deadline_s=item_deadline_s, hedge_after_s=hedge_after_s,
+        metrics_port=metrics_port, flight_record_path=flight_record_path)
+    if reader.metrics_server is not None:
+        # same stderr contract as reader_throughput: the ephemeral bound
+        # port must be reachable by the user
+        print("metrics endpoint: http://127.0.0.1:"
+              f"{reader.metrics_server.port}/metrics", file=sys.stderr)
     try:
         loader = JaxDataLoader(reader, batch_size=batch_size, prefetch=prefetch)
     except Exception:

@@ -67,7 +67,17 @@ class ErrorBudgetExceededError(PetastormTpuError):
     exceeds the :class:`ErrorPolicy` limits - too many failures stop looking
     like weather and start looking like a broken dataset or outage, which
     must fail loudly rather than silently train on a shrinking sample.
+
+    ``diagnostics``: the reader's pipeline-state snapshot taken at abort
+    time (queue depths, quarantine ledger, and - when telemetry is on - the
+    flight-recorder record with the sampled series leading into the
+    exhaustion), same contract as
+    :class:`~petastorm_tpu.pool.PipelineStallError`.
     """
+
+    def __init__(self, message: str, diagnostics: Optional[dict] = None):
+        super().__init__(message)
+        self.diagnostics = diagnostics or {}
 
 
 class CircuitOpenError(OSError, PetastormTpuError):

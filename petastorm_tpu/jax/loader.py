@@ -135,6 +135,16 @@ class JaxDataLoader:
         self._m_consumer_wait = self._telemetry.counter(
             "loader.consumer_wait_s")
         self._m_delivered = self._telemetry.counter("loader.batches_delivered")
+        #: host-queue depth gauge: with the prefetch-depth gauge (set in
+        #: __next__) the metrics sampler sees both producer stages' backlogs
+        self._g_host_depth = self._telemetry.gauge("loader.host_queue_depth")
+        if self._telemetry.enabled:
+            register = getattr(self._telemetry, "register_stage", None)
+            if register is not None:
+                # visible as "no samples yet" before the first batch lands
+                for stage in ("host-assemble", "host-prep",
+                              "device-transfer"):
+                    register(stage)
         self._mesh = mesh
         self._specs = shardings
         #: K > 1 = scan-feed delivery: each delivered unit stacks K
@@ -536,6 +546,12 @@ class JaxDataLoader:
                     item = self._host_q.get(timeout=_QUEUE_POLL_S)
                 except queue.Empty:
                     continue
+                if self._telemetry.enabled:
+                    # stamp on the GET side too: a gauge updated only by the
+                    # producer freezes at its last (high) value the moment
+                    # the producer stalls - inverting the very drain-vs-stall
+                    # signal the flight recorder reads it for
+                    self._g_host_depth.set(self._host_q.qsize())
                 if isinstance(item, _Error):
                     self._push(item)
                     self._sentinel_pending = True
@@ -591,6 +607,8 @@ class JaxDataLoader:
         while not self._stop_event.is_set():
             try:
                 self._host_q.put(value, timeout=_QUEUE_POLL_S)
+                if self._telemetry.enabled:
+                    self._g_host_depth.set(self._host_q.qsize())
                 return
             except queue.Full:
                 continue

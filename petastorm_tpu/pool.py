@@ -244,6 +244,11 @@ class ExecutorBase(ABC):
         self._m_input_full = self._telemetry.counter("queue.input_full_wait_s")
         self._m_results_full = self._telemetry.counter(
             "queue.results_full_wait_s")
+        # queue-depth gauges: stamped at put/get so the metrics sampler's
+        # 1 s frames carry a live depth curve (the signal a flight record
+        # needs to show "the queue drained, then the stall began")
+        self._g_in_depth = self._telemetry.gauge("pool.in_queue_depth")
+        self._g_out_depth = self._telemetry.gauge("pool.results_queue_depth")
         self._m_requeued = self._telemetry.counter("errors.requeued_items")
         self._m_hung_killed = self._telemetry.counter(
             "liveness.hung_workers_killed")
@@ -805,6 +810,7 @@ class ThreadedExecutor(ExecutorBase):
                     # time the ventilator spent blocked on a full input queue:
                     # the worker plane is saturated (healthy backpressure)
                     self._m_input_full.add(time.perf_counter() - t0)
+                    self._g_in_depth.set(self._in_queue.qsize())
                 return
             if cancel_event is not None and cancel_event.is_set():
                 # caller withdrew the put while the queue was full (quiesce
@@ -936,8 +942,8 @@ class ThreadedExecutor(ExecutorBase):
             self._note_delivery(result.ordinal, getattr(result, "attempt", 0))
             self._consumed += 1
             if self._telemetry.enabled:
-                self._telemetry.gauge("pool.results_queue_depth").set(
-                    self._out_queue.qsize())
+                self._g_out_depth.set(self._out_queue.qsize())
+                self._g_in_depth.set(self._in_queue.qsize())
             return result.value
 
     def stop(self) -> None:
@@ -1192,6 +1198,10 @@ class _ProcessExecutor(ExecutorBase):
                     self._ventilated += 1
                     if t0 is not None:
                         self._m_input_full.add(time.perf_counter() - t0)
+                        try:  # mp.Queue.qsize raises on some platforms
+                            self._g_in_depth.set(self._in_queue.qsize())
+                        except NotImplementedError:
+                            pass
                     return
                 except queue.Full:
                     if self._stopped:
@@ -1392,6 +1402,12 @@ class _ProcessExecutor(ExecutorBase):
                 continue  # requeue duplicate: first delivery already counted
             self._note_delivery(ordinal, getattr(result, "attempt", 0))
             self._consumed += 1
+            if self._telemetry.enabled:
+                try:  # mp.Queue.qsize raises on some platforms
+                    self._g_out_depth.set(self._out_queue.qsize())
+                    self._g_in_depth.set(self._in_queue.qsize())
+                except NotImplementedError:
+                    pass
             return value
 
     def stop(self) -> None:
@@ -1520,6 +1536,12 @@ class Ventilator:
         self._num_epochs = num_epochs
         self._start_item = start_item
         self._telemetry = _resolve_telemetry(telemetry)
+        if self._telemetry.enabled:
+            # visible (as "no samples yet") in reports and --watch frames
+            # even before the first item is handed to the executor
+            register = getattr(self._telemetry, "register_stage", None)
+            if register is not None:
+                register("ventilate")
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.items_per_epoch = len(plan.epoch_items(0))

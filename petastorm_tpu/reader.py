@@ -101,6 +101,9 @@ def make_reader(dataset_url: str,
                 hedge_after_s=None,
                 stall_warn_s: Optional[float] = None,
                 stall_abort_s: Optional[float] = None,
+                metrics_port: Optional[int] = None,
+                flight_record_path: Optional[str] = None,
+                sample_interval_s: Optional[float] = None,
                 chaos=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -162,6 +165,27 @@ def make_reader(dataset_url: str,
     ``PETASTORM_TPU_STALL_WARN_S`` / ``PETASTORM_TPU_STALL_ABORT_S``;
     ``0`` disables.
 
+    ``metrics_port``/``flight_record_path``/``sample_interval_s``: the live
+    observability layer (docs/operations.md "Live monitoring").  With
+    telemetry enabled a background :class:`~petastorm_tpu.telemetry.sampler.
+    MetricsSampler` continuously snapshots the registry (default every 1 s;
+    ``sample_interval_s`` / ``PETASTORM_TPU_SAMPLE_INTERVAL_S`` tune it)
+    into a bounded time-series ring (``reader.sampler``).  ``metrics_port``
+    (or ``PETASTORM_TPU_METRICS_PORT``; ``0`` = ephemeral, read back via
+    ``reader.metrics_server.port``) serves the metrics in Prometheus text
+    format from a localhost-only HTTP thread.  ``flight_record_path`` (or
+    ``PETASTORM_TPU_FLIGHT_RECORD``) dumps a flight record - the last ~60 s
+    of sampled series plus the trace tail - as JSONL on any terminal failure
+    (stall abort, terminal worker error, error-budget exhaustion,
+    circuit-open abort); the record also lands in
+    ``Reader.diagnostics['flight_recorder']``.  Passing any of the three
+    KWARGS (a positive ``sample_interval_s`` counts - asking for a sampling
+    cadence is asking to sample) auto-enables a private telemetry recorder
+    when none is configured; the env vars for ``metrics_port`` and
+    ``flight_record_path`` do too, but ``PETASTORM_TPU_SAMPLE_INTERVAL_S``
+    alone only TUNES the cadence of telemetry that is otherwise enabled
+    (a process-wide interval export must not silently switch recording on).
+
     ``chaos``: deterministic fault injection for tests/benchmarks
     (``petastorm_tpu.test_util.chaos.ChaosSpec``); never set in production.
     """
@@ -180,7 +204,10 @@ def make_reader(dataset_url: str,
                              item_deadline_s=item_deadline_s,
                              hedge_after_s=hedge_after_s,
                              stall_warn_s=stall_warn_s,
-                             stall_abort_s=stall_abort_s)
+                             stall_abort_s=stall_abort_s,
+                             metrics_port=metrics_port,
+                             flight_record_path=flight_record_path,
+                             sample_interval_s=sample_interval_s)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -239,6 +266,9 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       hedge_after_s=None,
                       stall_warn_s: Optional[float] = None,
                       stall_abort_s: Optional[float] = None,
+                      metrics_port: Optional[int] = None,
+                      flight_record_path: Optional[str] = None,
+                      sample_interval_s: Optional[float] = None,
                       chaos=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
@@ -246,7 +276,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
     Reference: ``make_batch_reader`` (reader.py:179-290).  Yields one namedtuple of
     column arrays per decoded rowgroup.  ``io_retries``/``telemetry``/
     ``on_error``/``item_deadline_s``/``hedge_after_s``/``stall_warn_s``/
-    ``stall_abort_s``/``chaos``: see ``make_reader``.
+    ``stall_abort_s``/``metrics_port``/``flight_record_path``/
+    ``sample_interval_s``/``chaos``: see ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -263,7 +294,10 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              item_deadline_s=item_deadline_s,
                              hedge_after_s=hedge_after_s,
                              stall_warn_s=stall_warn_s,
-                             stall_abort_s=stall_abort_s)
+                             stall_abort_s=stall_abort_s,
+                             metrics_port=metrics_port,
+                             flight_record_path=flight_record_path,
+                             sample_interval_s=sample_interval_s)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -281,8 +315,39 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       item_deadline_s: Optional[float] = None,
                       hedge_after_s=None,
                       stall_warn_s: Optional[float] = None,
-                      stall_abort_s: Optional[float] = None) -> "Reader":
+                      stall_abort_s: Optional[float] = None,
+                      metrics_port: Optional[int] = None,
+                      flight_record_path: Optional[str] = None,
+                      sample_interval_s: Optional[float] = None) -> "Reader":
     telemetry = _resolve_telemetry(telemetry)
+    if not flight_record_path:
+        flight_record_path = (
+            os.environ.get("PETASTORM_TPU_FLIGHT_RECORD", "").strip() or None)
+    if metrics_port is None:
+        raw_port = os.environ.get("PETASTORM_TPU_METRICS_PORT", "").strip()
+        if raw_port:
+            try:
+                metrics_port = int(raw_port)
+            except ValueError:
+                logger.warning("Ignoring non-integer"
+                               " PETASTORM_TPU_METRICS_PORT=%r", raw_port)
+    if (flight_record_path or metrics_port is not None
+            or (sample_interval_s is not None and sample_interval_s > 0)) \
+            and not telemetry.enabled:
+        # the continuous-observability knobs need a live recorder; a private
+        # one keeps them usable without opting the whole process in
+        from petastorm_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry()
+    if telemetry.enabled:
+        # pre-register the canonical stages this pipeline will run, so early
+        # sampler frames and short runs render them as "no samples yet"
+        # instead of omitting them (report.py)
+        register = getattr(telemetry, "register_stage", None)
+        if register is not None:
+            register("decode")
+            if transform_spec is not None:
+                register("transform")
     error_policy = resolve_error_policy(on_error)
     if chaos is not None and chaos.affects_filesystem():
         # transient-IO chaos lives in the filesystem layer so it exercises
@@ -477,7 +542,9 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                     worker=worker, num_epochs=num_epochs, batched_output=batched_output,
                     start_item=start_item, ngram=ngram, telemetry=telemetry,
                     error_policy=error_policy, stall_warn_s=stall_warn_s,
-                    stall_abort_s=stall_abort_s)
+                    stall_abort_s=stall_abort_s, metrics_port=metrics_port,
+                    flight_record_path=flight_record_path,
+                    sample_interval_s=sample_interval_s)
     reader.circuit_breaker = circuit_breaker
     #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
     reader.device_decode_fields = device_fields
@@ -582,7 +649,10 @@ class Reader:
                  start_item: int = 0, ngram=None, telemetry=None,
                  error_policy: Optional[ErrorPolicy] = None,
                  stall_warn_s: Optional[float] = None,
-                 stall_abort_s: Optional[float] = None):
+                 stall_abort_s: Optional[float] = None,
+                 metrics_port: Optional[int] = None,
+                 flight_record_path: Optional[str] = None,
+                 sample_interval_s: Optional[float] = None):
         #: petastorm_tpu.telemetry recorder shared by the whole pipeline
         #: (no-op unless enabled); ``reader.telemetry.pipeline_report()``
         #: renders the stage-utilization bottleneck summary
@@ -646,12 +716,68 @@ class Reader:
         self._namedtuple_type = schema.make_namedtuple_type()
         self._field_names = list(schema.fields)
 
-        self._executor.start(worker)
-        self._ventilator = Ventilator(executor, plan, num_epochs,
-                                      start_item=start_item,
-                                      telemetry=self.telemetry)
-        self._expected_items = self._ventilator.total_items
-        self._ventilator.start()
+        # -- live observability (docs/operations.md "Live monitoring") -----
+        #: continuous time-series sampler over ``telemetry`` (None when
+        #: telemetry is disabled); ``reader.sampler.series()`` is the live
+        #: rate/latency history, and the flight recorder's data source
+        self.sampler = None
+        #: localhost-only Prometheus endpoint (None unless ``metrics_port``);
+        #: the bound port is ``reader.metrics_server.port``
+        self.metrics_server = None
+        self._flight_record_path = flight_record_path
+        self._flight_record: Optional[dict] = None
+        self._final_snapshot: Optional[dict] = None
+        self._observability_closed = False
+        try:
+            if self.telemetry.enabled:
+                from petastorm_tpu.telemetry.sampler import (
+                    DEFAULT_INTERVAL_S, MetricsSampler)
+
+                interval = (float(sample_interval_s)
+                            if sample_interval_s is not None
+                            else _env_seconds(
+                                "PETASTORM_TPU_SAMPLE_INTERVAL_S",
+                                DEFAULT_INTERVAL_S))
+                if interval > 0:  # <= 0 keeps telemetry, disables sampling
+                    self.sampler = MetricsSampler(self.telemetry,
+                                                  interval_s=interval)
+                    self.sampler.start()
+            if flight_record_path and self.sampler is None:
+                # the artifact was explicitly requested but nothing will feed
+                # it - say so NOW, not after the incident the record was for
+                logger.warning(
+                    "flight_record_path=%r is inert: sampling is disabled"
+                    " (sample_interval_s <= 0 or telemetry has no sampler);"
+                    " no flight record will be written on failure",
+                    flight_record_path)
+            if metrics_port is not None:
+                from petastorm_tpu.telemetry.export import MetricsExportServer
+
+                self.metrics_server = MetricsExportServer(
+                    self.telemetry, sampler=self.sampler, port=metrics_port)
+                self.metrics_server.start()
+
+            self._executor.start(worker)
+            self._ventilator = Ventilator(executor, plan, num_epochs,
+                                          start_item=start_item,
+                                          telemetry=self.telemetry)
+            self._expected_items = self._ventilator.total_items
+            self._ventilator.start()
+        except BaseException:
+            # the reader never came to life (incl. a metrics-port bind
+            # failure): release the observability layer - the sampler
+            # thread, and any bound metrics port - or a construct-retry
+            # loop leaks a 1 Hz sampler per attempt and hits EADDRINUSE.
+            # The executor may already have live workers (a Ventilator
+            # failure lands here after start): stop them too, or each retry
+            # leaks a polling worker plane
+            self._close_observability()
+            try:
+                self._executor.stop()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.debug("executor stop during construction failure"
+                             " cleanup failed", exc_info=True)
+            raise
 
     # -- iteration ------------------------------------------------------------
 
@@ -776,17 +902,26 @@ class Reader:
                 stalled = time.monotonic() - last_progress
                 if self._stall_abort_s > 0 and stalled > self._stall_abort_s:
                     self._stall_aborted = True
+                    # flight record BEFORE the diagnostics snapshot (so the
+                    # raised error carries it) and before stop() ends sampling
+                    self._record_flight(
+                        f"PipelineStallError: no result for {stalled:.0f}s")
                     diag = self.diagnostics  # snapshot before stop() mutates it
                     stage = self._stalled_stage()
                     # stop the pipeline like the worker-failure path does:
                     # a caller that catches this must not inherit a live
                     # ventilator + polling workers
                     self.stop()
+                    # the message interpolates a TRIMMED pipeline state: the
+                    # flight record (whole sampled series) rides .diagnostics
+                    # for programmatic triage, not the traceback text
+                    msg_diag = {k: v for k, v in diag.items()
+                                if k not in ("flight_recorder", "telemetry")}
                     raise PipelineStallError(
                         f"No result for {stalled:.0f}s (stall_abort_s="
                         f"{self._stall_abort_s:.0f})"
                         + (f"; busiest stage: {stage}" if stage else "")
-                        + f"; pipeline state: {diag}", diagnostics=diag)
+                        + f"; pipeline state: {msg_diag}", diagnostics=diag)
                 if (self._stall_warn_s > 0 and stalled > self._stall_warn_s
                         and stalled - warned_at > self._stall_warn_s):
                     warned_at = stalled
@@ -824,6 +959,30 @@ class Reader:
                 self._consumed_ordinals.discard(self._prefix)
                 self._prefix += 1
 
+    # -- flight recorder (docs/operations.md "Live monitoring") ---------------
+
+    def _record_flight(self, reason: str) -> None:
+        """Capture the flight record - the last ~60 s of sampled series plus
+        the trace tail - once, at the FIRST terminal failure, and dump it to
+        ``flight_record_path`` when set.  Best-effort: the crash artifact
+        must never mask the crash itself."""
+        if self._flight_record is not None or self.sampler is None:
+            return
+        try:
+            from petastorm_tpu.telemetry.sampler import (dump_flight_record,
+                                                         flight_record)
+
+            self._flight_record = flight_record(self.sampler, reason=reason)
+            if self._flight_record_path:
+                dump_flight_record(self._flight_record,
+                                   self._flight_record_path)
+                logger.warning(
+                    "Flight record (%d sampled points) written to %s",
+                    len(self._flight_record["points"]),
+                    self._flight_record_path)
+        except Exception:  # noqa: BLE001 - diagnostics must not mask failure
+            logger.warning("flight-record capture failed", exc_info=True)
+
     # -- failure handling (docs/operations.md "Failure handling") -------------
 
     def _skip_or_raise(self, exc: WorkerError) -> None:
@@ -837,6 +996,12 @@ class Reader:
         """
         policy = self._error_policy
         if policy is None or exc.item is None:
+            # terminal in both modes (raise-mode failure, or an
+            # unattributable failure under a skip policy): capture the
+            # flight record while the sampler still runs
+            self._record_flight(
+                f"WorkerError ({exc.exc_type or 'unattributable'},"
+                f" kind={exc.kind})")
             if policy is not None:
                 # terminal under a skip policy (all workers died, or another
                 # unattributable failure): the pool was constructed with
@@ -884,11 +1049,14 @@ class Reader:
                         f" max_skipped_fraction="
                         f"{policy.max_skipped_fraction}")
         if over is not None:
+            self._record_flight(f"ErrorBudgetExceededError: {over}")
+            diag = self.diagnostics  # snapshot before stop() mutates it
             self.stop()
             raise ErrorBudgetExceededError(
                 f"Error budget exceeded: {over}. Quarantined rowgroups: "
                 + ", ".join(f"{e['path']}#{e['row_group']}"
-                            for e in self._quarantine)) from exc
+                            for e in self._quarantine),
+                diagnostics=diag) from exc
 
     # -- epoch control --------------------------------------------------------
 
@@ -965,10 +1133,39 @@ class Reader:
     # -- lifecycle ------------------------------------------------------------
 
     def stop(self) -> None:
-        """Stop ventilation and the worker pool; in-flight items are discarded."""
+        """Stop ventilation and the worker pool; in-flight items are discarded.
+
+        Every close path (clean close, stall abort, budget exhaustion, error
+        propagation) funnels through here, so this is also where the final
+        telemetry snapshot is latched into ``diagnostics['telemetry']`` and
+        the sampler / metrics endpoint shut down - a failed run must not lose
+        its counters just because nobody held the ``Telemetry`` object.
+        """
         self._stopped = True
         self._ventilator.stop()
         self._executor.stop()
+        self._close_observability()
+
+    def _close_observability(self) -> None:
+        """Latch the final snapshot and stop the sampler + metrics endpoint;
+        idempotent (every close path and the constructor-failure path funnel
+        here)."""
+        if self._observability_closed:
+            return
+        self._observability_closed = True
+        if self.sampler is not None:
+            try:  # flush the trailing partial interval into the series
+                self.sampler.sample_now()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.debug("final sample failed", exc_info=True)
+            self.sampler.stop()
+        if self.telemetry.enabled and self._final_snapshot is None:
+            try:
+                self._final_snapshot = self.telemetry.snapshot()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.debug("final snapshot failed", exc_info=True)
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
 
     def join(self) -> None:
         """Wait for the pool workers and ventilator to exit (after stop()).
@@ -1013,6 +1210,14 @@ class Reader:
                 "quarantined_rowgroups": list(self._quarantine[-20:])}
         if self.circuit_breaker is not None:
             diag["circuit_breaker"] = self.circuit_breaker.snapshot()
+        if self._flight_record is not None:
+            # the sampled series + trace tail leading into a terminal failure
+            diag["flight_recorder"] = self._flight_record
+        if self._final_snapshot is not None:
+            # full telemetry snapshot latched at close, on every close path
+            diag["telemetry"] = self._final_snapshot
+        if self.metrics_server is not None:
+            diag["metrics_port"] = self.metrics_server.port
         return diag
 
     @property

@@ -54,7 +54,34 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "TraceBuffer", "resolve", "enable",
     "enabled_from_env", "render_pipeline_report", "dominant_stage",
     "STAGE_ORDER", "DEFAULT_LATENCY_BUCKETS_S", "ENV_VAR", "NULL_CONTEXT",
+    # live observability layer (imported lazily - see module __getattr__):
+    # continuous sampling + flight recorder (telemetry.sampler) and the
+    # Prometheus/JSONL export sinks (telemetry.export)
+    "MetricsSampler", "flight_record", "dump_flight_record",
+    "load_flight_records", "MetricsExportServer", "render_prometheus",
+    "write_jsonl",
 ]
+
+_LAZY = {
+    "MetricsSampler": "petastorm_tpu.telemetry.sampler",
+    "flight_record": "petastorm_tpu.telemetry.sampler",
+    "dump_flight_record": "petastorm_tpu.telemetry.sampler",
+    "load_flight_records": "petastorm_tpu.telemetry.sampler",
+    "MetricsExportServer": "petastorm_tpu.telemetry.export",
+    "render_prometheus": "petastorm_tpu.telemetry.export",
+    "write_jsonl": "petastorm_tpu.telemetry.export",
+}
+
+
+def __getattr__(name: str):
+    # keep `import petastorm_tpu.telemetry` free of http.server etc. on the
+    # hot import path; the observability layer loads on first touch
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
 
 #: setting this to 1/true/yes/on enables the process-default recorder
 ENV_VAR = "PETASTORM_TPU_TELEMETRY"
@@ -137,6 +164,20 @@ class Telemetry:
         """The histogram named ``name`` (created on first use; ``buckets``
         default to the stage-latency buckets)."""
         return self.registry.histogram(name, buckets)
+
+    def register_stage(self, name: str) -> None:
+        """Pre-create stage ``name``'s instruments (zero-valued counters +
+        empty histogram) ahead of its first execution, so reports, the
+        metrics sampler and ``diagnose --watch`` frames show the stage as
+        "no samples yet" instead of omitting it - a short or just-started
+        run must not misname the dominant stage by eliding a late-starting
+        one.  Components that know their stages call this at construction
+        (ventilator, reader, jax loader)."""
+        self.registry.counter(f"stage.{name}.busy_s")
+        self.registry.counter(f"stage.{name}.count")
+        with self._stage_lock:
+            self._stage_hists.setdefault(
+                name, self.registry.histogram(f"stage.{name}.latency_s"))
 
     # -- spans / stage timers -------------------------------------------------
 
@@ -250,6 +291,9 @@ class NullTelemetry:
     def histogram(self, name: str, buckets=None) -> _NullInstrument:
         """The shared no-op instrument."""
         return _NULL_INSTRUMENT
+
+    def register_stage(self, name: str) -> None:
+        """No-op."""
 
     def span(self, name: str, cat: str = "span", **args) -> _NullContext:
         """The shared do-nothing context manager."""

@@ -35,6 +35,13 @@ def _stage_rows(snapshot: Dict) -> List[Dict]:
     histograms = snapshot.get("histograms", {})
     names = {n.split(".", 2)[1] for n in counters
              if n.startswith("stage.") and n.endswith(".busy_s")}
+    # stages registered ahead of their first execution
+    # (Telemetry.register_stage, or a histogram minted by hedge_after='auto')
+    # must still get a row - rendered as "no samples yet", never silently
+    # omitted, so early --watch frames and short runs cannot misname the
+    # dominant stage by eliding a late-starting one
+    names |= {n.split(".", 2)[1] for n in histograms
+              if n.startswith("stage.") and n.endswith(".latency_s")}
     ordered = [s for s in STAGE_ORDER if s in names]
     ordered += sorted(names - set(STAGE_ORDER))
     rows = []
@@ -65,8 +72,11 @@ def _hist_quantile(hist: Dict, q: float) -> float:
 
 
 def dominant_stage(snapshot: Dict) -> str:
-    """Name of the stage with the most cumulative busy time ('' if none)."""
-    rows = _stage_rows(snapshot)
+    """Name of the stage with the most cumulative busy time ('' if none).
+    Stages that are registered but have recorded no execution yet are not
+    candidates - an early frame must say "nothing yet", not crown whichever
+    zero-count stage happened to sort first."""
+    rows = [r for r in _stage_rows(snapshot) if r["count"] > 0]
     if not rows:
         return ""
     return max(rows, key=lambda r: r["busy_s"])["stage"]
@@ -83,17 +93,28 @@ def render_pipeline_report(snapshot: Dict) -> str:
         lines.append(f"{'stage':<16} {'busy_s':>8} {'util%':>7} {'count':>7}"
                      f" {'mean_ms':>9} {'p50_ms':>8} {'p99_ms':>8}")
         for r in rows:
+            if r["count"] == 0:
+                # registered but not yet executed: a visible placeholder row
+                # beats omission (the stage exists; it just hasn't run)
+                lines.append(f"{r['stage']:<16} {'-':>8} {'-':>7} {'-':>7}"
+                             f" {'-':>9} {'-':>8} {'-':>8}  (no samples yet)")
+                continue
             p50 = f"{r['p50_s'] * 1e3:>8.1f}" if r["p50_s"] is not None else f"{'-':>8}"
             p99 = f"{r['p99_s'] * 1e3:>8.1f}" if r["p99_s"] is not None else f"{'-':>8}"
             lines.append(
                 f"{r['stage']:<16} {r['busy_s']:>8.3f}"
                 f" {100.0 * r['busy_s'] / wall:>6.1f}% {r['count']:>7d}"
                 f" {r['mean_ms']:>9.2f} {p50} {p99}")
-        best = max(rows, key=lambda r: r["busy_s"])
-        lines.append(f"dominant stage: {best['stage']}"
-                     f" ({best['busy_s']:.3f} s busy,"
-                     f" {100.0 * best['busy_s'] / wall:.1f}% of wall;"
-                     " util% can exceed 100 - stages run on parallel workers)")
+        sampled = [r for r in rows if r["count"] > 0]
+        if sampled:
+            best = max(sampled, key=lambda r: r["busy_s"])
+            lines.append(
+                f"dominant stage: {best['stage']}"
+                f" ({best['busy_s']:.3f} s busy,"
+                f" {100.0 * best['busy_s'] / wall:.1f}% of wall;"
+                " util% can exceed 100 - stages run on parallel workers)")
+        else:
+            lines.append("dominant stage: (no samples yet)")
     else:
         lines.append("no stage samples recorded (telemetry enabled but no"
                      " instrumented work ran)")

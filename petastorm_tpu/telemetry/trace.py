@@ -57,6 +57,28 @@ class TraceBuffer:
         """Spans discarded because the buffer hit ``max_events``."""
         return self._dropped
 
+    def tail(self, n: int = 200) -> List[Dict]:
+        """The last ``n`` finished spans as plain dicts, oldest first:
+        ``{name, cat, thread, ts_ms, dur_ms[, args]}`` (milliseconds relative
+        to the buffer origin, same clock as ``chrome_trace`` timestamps) -
+        the flight recorder's trace payload."""
+        if n <= 0:
+            return []
+        with self._lock:
+            events = self._events[-n:]
+            names = dict(self._thread_names)
+        origin = self._origin_ns
+        out = []
+        for name, cat, tid, start_ns, dur_ns, args in events:
+            ev = {"name": name, "cat": cat,
+                  "thread": names.get(tid, str(tid)),
+                  "ts_ms": (start_ns - origin) / 1e6,
+                  "dur_ms": dur_ns / 1e6}
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
     def chrome_trace(self) -> Dict:
         """The buffered spans as a Chrome ``trace_event`` JSON object."""
         pid = os.getpid()

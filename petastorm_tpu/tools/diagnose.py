@@ -6,10 +6,19 @@ and prints the ``pipeline_report()`` bottleneck summary - which stage
 the worker plane or the consumer.  Optionally exports the run's span
 timeline as Chrome ``trace_event`` JSON for Perfetto.
 
+``--watch`` switches to live mode: the read runs in the background while a
+``top``-style view refreshes every ``--interval`` seconds from the reader's
+metrics sampler - per-stage rates and interval p50/p99, queue depths,
+queue-wait rates, faults/liveness interventions, and the interval's dominant
+stage.  ``--duration S`` bounds the capture (the read stops cleanly after S
+seconds); ``--metrics-port`` additionally serves the Prometheus endpoint for
+the run's lifetime.
+
 Examples::
 
     petastorm-tpu-diagnose file:///data/imagenet --pool thread --workers 4
     petastorm-tpu-diagnose --synthetic --trace-out /tmp/trace.json
+    petastorm-tpu-diagnose file:///data/imagenet --watch --duration 30
     python -m petastorm_tpu.tools.diagnose --synthetic --json
 
 Deliberately jax-free (reader + pool plane only): it runs anywhere the host
@@ -24,9 +33,23 @@ import json
 import shutil
 import sys
 import tempfile
-from typing import List, Optional
+import threading
+import time
+from typing import Dict, List, Optional
 
+from petastorm_tpu.errors import ReaderClosedError
 from petastorm_tpu.telemetry import Telemetry, dominant_stage
+from petastorm_tpu.telemetry.report import STAGE_ORDER
+
+
+def _positive_seconds(value: str) -> float:
+    """argparse type for strictly-positive second values (an interval of 0
+    would busy-spin the watch loop and disable the reader's sampler)."""
+    parsed = float(value)
+    if parsed <= 0:
+        raise argparse.ArgumentTypeError(
+            f"must be a positive number of seconds, got {value!r}")
+    return parsed
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -79,22 +102,54 @@ def build_parser() -> argparse.ArgumentParser:
                         help="liveness: speculatively re-issue an item"
                              " running longer than S seconds to an idle"
                              " worker ('auto' = 4x telemetry decode p99)")
+    parser.add_argument("--watch", action="store_true",
+                        help="live mode: refresh a top-style per-stage"
+                             " rate/latency/queue view every --interval"
+                             " seconds while the read runs (Ctrl-C stops)")
+    parser.add_argument("--interval", type=_positive_seconds, default=1.0,
+                        metavar="S",
+                        help="sampling + refresh interval for --watch and"
+                             " the reader's metrics sampler (default 1s;"
+                             " must be > 0)")
+    parser.add_argument("--duration", type=float, default=None, metavar="S",
+                        help="stop the read cleanly after S seconds (bounded"
+                             " capture; mostly with --watch)")
+    parser.add_argument("--metrics-port", type=int, default=None, metavar="N",
+                        help="serve the run's metrics in Prometheus text"
+                             " format on localhost:N for the run's lifetime"
+                             " (0 = ephemeral; the bound port is printed)")
+    parser.add_argument("--flight-record", metavar="PATH", default=None,
+                        help="on a terminal failure, dump the flight record"
+                             " (sampled series + trace tail) to PATH as"
+                             " JSONL")
     return parser
 
 
 def run_diagnosis(dataset_url: str, method: str = "batch",
                   pool_type: str = "thread", workers_count: int = 3,
-                  num_epochs: int = 1, max_batches: int = 0,
+                  num_epochs: Optional[int] = 1, max_batches: int = 0,
                   telemetry: Optional[Telemetry] = None,
                   chaos=None, on_error: str = "raise",
                   item_deadline_s: Optional[float] = None,
-                  hedge_after_s=None) -> dict:
+                  hedge_after_s=None,
+                  duration_s: Optional[float] = None,
+                  metrics_port: Optional[int] = None,
+                  flight_record_path: Optional[str] = None,
+                  sample_interval_s: Optional[float] = None,
+                  on_reader=None) -> dict:
     """Read ``dataset_url`` with telemetry enabled; returns a result dict
     with ``rows``, ``batches``, ``snapshot``, ``report``,
     ``dominant_stage``, the reader's fault ledger
     (``quarantined_rowgroups``) and a ``liveness`` verdict (hung-kill /
     hedge / circuit counts + slowest observed in-flight item age) - also
-    the programmatic entry the tests use."""
+    the programmatic entry the tests use.
+
+    ``duration_s`` bounds the read in wall-clock time (the iterator stops
+    cleanly once elapsed - the ``--watch --duration`` capture).
+    ``metrics_port``/``flight_record_path``/``sample_interval_s`` pass
+    through to the reader (docs/operations.md "Live monitoring").
+    ``on_reader`` is called with the live Reader right after construction -
+    the watch loop uses it to poll ``reader.sampler`` and diagnostics."""
     from petastorm_tpu.reader import make_batch_reader, make_reader
 
     tele = telemetry or Telemetry()
@@ -102,12 +157,18 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
     rows = 0
     batches = 0
     slowest_inflight = 0.0
+    t_start = time.monotonic()
     with factory(dataset_url, reader_pool_type=pool_type,
                  workers_count=workers_count, num_epochs=num_epochs,
                  shuffle_row_groups=False, telemetry=tele,
                  chaos=chaos, on_error=on_error,
                  item_deadline_s=item_deadline_s,
-                 hedge_after_s=hedge_after_s) as reader:
+                 hedge_after_s=hedge_after_s,
+                 metrics_port=metrics_port,
+                 flight_record_path=flight_record_path,
+                 sample_interval_s=sample_interval_s) as reader:
+        if on_reader is not None:
+            on_reader(reader)
 
         def _sample_inflight() -> None:
             # slowest in-flight item age: the number a wedged production
@@ -116,6 +177,10 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
             for _i, _o, age in reader.diagnostics.get("workers_busy", []):
                 slowest_inflight = max(slowest_inflight, age)
 
+        def _out_of_time() -> bool:
+            return (duration_s is not None
+                    and time.monotonic() - t_start >= duration_s)
+
         if method == "batch":
             for batch in reader.iter_batches():
                 rows += batch.num_rows
@@ -123,14 +188,23 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                 _sample_inflight()
                 if max_batches and batches >= max_batches:
                     break
+                if _out_of_time():
+                    break
         else:
             for _ in reader:
                 rows += 1
                 if rows % 50 == 0:  # cheap, but not per-row
                     _sample_inflight()
+                # the duration check IS per-row (one clock read, only when a
+                # bound is set): a slow decode must not overshoot the
+                # "bounded capture" contract by up to 50 rows
+                if duration_s is not None and _out_of_time():
+                    break
         _sample_inflight()
         quarantined = reader.quarantined_rowgroups
         final_diag = reader.diagnostics
+        bound_port = (reader.metrics_server.port
+                      if reader.metrics_server is not None else None)
     snapshot = tele.snapshot()
     counters = snapshot.get("counters", {})
     liveness = {
@@ -154,7 +228,203 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
             "dominant_stage": dominant_stage(snapshot),
             "quarantined_rowgroups": quarantined,
             "liveness": liveness,
+            "metrics_port": bound_port,
             "telemetry": tele}
+
+
+#: watch-frame fault counters worth a line the moment they move
+_WATCH_FAULT_PREFIXES = ("errors.", "liveness.", "io.retries")
+
+#: short watch labels per queue-wait counter; the counter LIST itself comes
+#: from report._QUEUE_WAITS (one source of truth - a new queue-wait counter
+#: added there shows up in watch frames automatically, with its report
+#: meaning until a short label is added here)
+_WATCH_QUEUE_LABELS = {
+    "queue.input_full_wait_s": "ventilator blocked (workers saturated)",
+    "queue.results_full_wait_s": "workers blocked (consumer-bound)",
+    "queue.results_empty_wait_s": "consumer starved (worker-bound)",
+}
+
+
+def _watch_queue_waits():
+    from petastorm_tpu.telemetry.report import _QUEUE_WAITS
+
+    return [(name, _WATCH_QUEUE_LABELS.get(name, meaning))
+            for name, meaning in _QUEUE_WAITS]
+
+
+def render_watch_frame(point: Dict, diagnostics: Optional[Dict] = None,
+                       elapsed_s: float = 0.0) -> str:
+    """One ``--watch`` frame from a sampler point (+ optional live reader
+    diagnostics): per-stage rate and interval p50/p99, queue depths and
+    wait rates, fault/liveness counters, and the interval's dominant stage.
+    Pure function of its inputs (tests render from canned points)."""
+    lines = [f"== petastorm-tpu watch  t={elapsed_s:6.1f}s  "
+             f"interval={point.get('dt_s', 0.0):.2f}s =="]
+    rates = point.get("rates", {})
+    rows_rate = rates.get("reader.rows_emitted", 0.0)
+    batches_rate = rates.get("reader.batches_consumed", 0.0)
+    lines.append(f"rows/s: {rows_rate:10.1f}    batches/s:"
+                 f" {batches_rate:7.2f}    total rows:"
+                 f" {point.get('counters', {}).get('reader.rows_emitted', 0):.0f}")
+    stages = point.get("stages", {})
+    if stages:
+        ordered = [s for s in STAGE_ORDER if s in stages]
+        ordered += sorted(set(stages) - set(STAGE_ORDER))
+        lines.append(f"{'stage':<16} {'rate/s':>8} {'p50_ms':>8}"
+                     f" {'p99_ms':>8} {'busy%':>7}")
+        busiest, busiest_frac = "", 0.0
+        for name in ordered:
+            st = stages[name]
+            if st["count"] == 0 and st["rate_per_s"] == 0.0:
+                lines.append(f"{name:<16} {'-':>8} {'-':>8} {'-':>8} {'-':>7}"
+                             "  (no samples yet)")
+                continue
+            p50 = (f"{st['p50_s'] * 1e3:>8.1f}"
+                   if st.get("p50_s") is not None else f"{'-':>8}")
+            p99 = (f"{st['p99_s'] * 1e3:>8.1f}"
+                   if st.get("p99_s") is not None else f"{'-':>8}")
+            frac = st.get("busy_frac", 0.0)
+            lines.append(f"{name:<16} {st['rate_per_s']:>8.2f} {p50} {p99}"
+                         f" {100.0 * frac:>6.1f}%")
+            if frac > busiest_frac:
+                busiest, busiest_frac = name, frac
+        lines.append(f"dominant stage (this interval): "
+                     f"{busiest or '(no samples yet)'}")
+    waits = [(label, rates.get(name, 0.0))
+             for name, label in _watch_queue_waits() if rates.get(name)]
+    if waits:
+        lines.append("queue wait (blocked-seconds/second):")
+        lines.extend(f"  {rate:6.2f}  {label}" for label, rate in waits)
+    gauges = point.get("gauges", {})
+    depth_parts = [f"{name.split('.', 1)[1]}={gauges[name]:g}"
+                   for name in sorted(gauges)
+                   if "depth" in name or "queue" in name]
+    if depth_parts:
+        lines.append("queue depths: " + "  ".join(depth_parts))
+    counters = point.get("counters", {})
+    faults = {n: v for n, v in counters.items()
+              if n.startswith(_WATCH_FAULT_PREFIXES) and v}
+    if faults:
+        lines.append("faults/liveness (totals): " + "  ".join(
+            f"{n}={v:g}" for n, v in sorted(faults.items())))
+    if diagnostics:
+        busy = diagnostics.get("workers_busy", [])
+        if busy:
+            oldest = max(age for _i, _o, age in busy)
+            lines.append(f"in-flight: {len(busy)} worker(s) busy, oldest item"
+                         f" {oldest:.1f}s (worker, item, age):"
+                         f" {busy[:6]}")
+        lines.append(
+            f"consumed {diagnostics.get('consumed_items', 0)}"
+            f"/{diagnostics.get('expected_items', '?')} items"
+            f"  requeued={diagnostics.get('requeued_items', 0)}"
+            f"  hedged={diagnostics.get('hedged_items', 0)}"
+            f"  hung_killed={diagnostics.get('hung_workers_killed', 0)}"
+            f"  skipped={diagnostics.get('skipped_rowgroups', 0)}")
+    return "\n".join(lines)
+
+
+def _watch(args, url: str, chaos) -> int:
+    """Drive ``run_diagnosis`` in a background thread while rendering watch
+    frames from the reader's sampler every ``--interval`` seconds."""
+    tele = Telemetry()
+    box: Dict = {}
+    reader_box: Dict = {}
+    num_epochs = args.num_epochs if args.num_epochs > 0 else None
+    # completion is signaled via an Event, NOT Thread.join/is_alive: a
+    # Thread.join(timeout) interrupted by Ctrl-C corrupts the thread state on
+    # this interpreter (cpython bpo-45274: is_alive() then reports False
+    # while the thread still runs), which silently dropped the final report
+    done = threading.Event()
+
+    def _run() -> None:
+        try:
+            box["result"] = run_diagnosis(
+                url, method=args.method, pool_type=args.pool_type,
+                workers_count=args.workers_count, num_epochs=num_epochs,
+                max_batches=args.max_batches, telemetry=tele, chaos=chaos,
+                on_error=args.on_error, item_deadline_s=args.item_deadline,
+                hedge_after_s=args.hedge_after, duration_s=args.duration,
+                metrics_port=args.metrics_port,
+                flight_record_path=args.flight_record,
+                sample_interval_s=args.interval,
+                on_reader=lambda r: reader_box.update(reader=r))
+        except BaseException as exc:  # noqa: BLE001 - reported on main thread
+            box["error"] = exc
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=_run, daemon=True,
+                              name="petastorm-tpu-diagnose-read")
+    thread.start()
+    t0 = time.monotonic()
+    interrupted = False
+    clear = "\x1b[H\x1b[2J" if sys.stdout.isatty() else ""
+    try:
+        while not done.wait(timeout=args.interval):
+            reader = reader_box.get("reader")
+            sampler = getattr(reader, "sampler", None)
+            point = sampler.latest() if sampler is not None else None
+            if point is None:
+                continue
+            try:
+                diag = reader.diagnostics
+            except Exception:  # noqa: BLE001 - reader may be mid-teardown
+                diag = None
+            frame = render_watch_frame(point, diag,
+                                       elapsed_s=time.monotonic() - t0)
+            if reader is not None and reader.metrics_server is not None:
+                frame += (f"\nmetrics: http://127.0.0.1:"
+                          f"{reader.metrics_server.port}/metrics")
+            print(f"{clear}{frame}" + ("" if clear else "\n"), flush=True)
+    except KeyboardInterrupt:
+        interrupted = True
+        reader = reader_box.get("reader")
+        if reader is not None:
+            reader.stop()
+        done.wait(timeout=10)
+    if args.trace_out:
+        tele.export_chrome_trace(args.trace_out)
+        print(f"chrome trace written to {args.trace_out}"
+              " (load in Perfetto / chrome://tracing)")
+    if not box:
+        # the read thread neither returned nor raised within the post-Ctrl-C
+        # grace (a wedged pipeline the stop could not unwedge): that is a
+        # failure - never a silent success exit
+        print("watch aborted: the read did not stop within 10s of Ctrl-C"
+              " (pipeline wedged?); state below is the last observed",
+              file=sys.stderr)
+        print(tele.pipeline_report())
+        return 1
+    error = box.get("error")
+    if interrupted and isinstance(error, ReaderClosedError):
+        # Ctrl-C is the documented way to END a watch, not a failure: our
+        # own stop() is what raised ReaderClosedError in the read thread
+        print("watch stopped")
+        print(tele.pipeline_report())
+        return 0
+    if error is not None:
+        print(f"read failed: {type(error).__name__}: {error}",
+              file=sys.stderr)
+        diag = getattr(error, "diagnostics", None)
+        if isinstance(diag, dict) and diag.get("flight_recorder"):
+            print(f"flight record captured"
+                  f" ({len(diag['flight_recorder']['points'])} points"
+                  + (f"; written to {args.flight_record}"
+                     if args.flight_record else "") + ")",
+                  file=sys.stderr)
+        print(tele.pipeline_report())
+        return 1
+    result = box.get("result")
+    if result is not None:
+        # a Ctrl-C'd batch read ends CLEANLY (iter_batches absorbs the
+        # stop), so it reaches here too - name it a stop, not a finish
+        print(f"watch {'stopped' if interrupted else 'finished'}:"
+              f" read {result['rows']} rows")
+        print(result["report"])
+        print(render_liveness_verdict(result["liveness"]))
+    return 0
 
 
 def render_liveness_verdict(liveness: dict) -> str:
@@ -193,7 +463,14 @@ def render_liveness_verdict(liveness: dict) -> str:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.watch and args.json:
+        # watch is a human-paced frame stream; silently printing the human
+        # report under --json would break any script parsing stdout
+        parser.error("--watch and --json are incompatible (watch renders"
+                     " refreshing frames; use --watch with --metrics-port"
+                     " for machine-readable live series)")
     if args.dataset_url is None and not args.synthetic:
         args.synthetic = True
     tmpdir = None
@@ -211,6 +488,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             from petastorm_tpu.test_util.chaos import ChaosSpec
 
             chaos = ChaosSpec.parse(args.chaos)
+        if args.watch:
+            return _watch(args, url, chaos)
         result = run_diagnosis(url, method=args.method,
                                pool_type=args.pool_type,
                                workers_count=args.workers_count,
@@ -218,7 +497,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                                max_batches=args.max_batches,
                                chaos=chaos, on_error=args.on_error,
                                item_deadline_s=args.item_deadline,
-                               hedge_after_s=args.hedge_after)
+                               hedge_after_s=args.hedge_after,
+                               duration_s=args.duration,
+                               metrics_port=args.metrics_port,
+                               flight_record_path=args.flight_record,
+                               sample_interval_s=args.interval)
         if args.trace_out:
             result["telemetry"].export_chrome_trace(args.trace_out)
         if args.json:

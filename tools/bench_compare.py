@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Compare two benchmark result files and gate CI on the delta.
+
+Inputs: any two of
+
+* a driver-captured ``BENCH_rNN.json`` (``{"tail": "<bench.py stdout>", ...}``)
+* raw ``python bench.py`` stdout saved to a file (one JSON line per metric)
+* a bare JSON object ``{"metric_name": value, ...}``
+
+Metric lines recognized inside a tail/stdout::
+
+    {"metric": "<name>", "value": <float>, ...}
+    {"metric": "bench_summary", "metrics": {"<name>": [<value>, <vs_b>], ...}}
+
+Usage::
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py old.json new.json --fail-threshold 10
+    python tools/bench_compare.py old.json new.json --json
+
+``--fail-threshold PCT`` arms the gate: exit 1 when any shared metric
+regresses by more than PCT percent (direction-aware - ``*_pct`` metrics
+matching the lower-is-better markers fail on increase, everything else on
+decrease).  Without it the comparison is report-only and always exits 0, so
+the same command serves both a human diff and a CI gate on the bench
+trajectory (RESULTS.md notes this host's rates drift +-30% between sessions;
+pick thresholds accordingly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+#: substrings marking a metric where SMALLER is better (idle/stall
+#: percentages, latency ratios); everything else is treated as a rate
+LOWER_IS_BETTER_MARKERS = ("idle_pct", "stall_pct", "latency",
+                           "latent_vs_local")
+
+
+def lower_is_better(name: str) -> bool:
+    """True when a decrease in ``name`` is an improvement."""
+    return any(m in name for m in LOWER_IS_BETTER_MARKERS)
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """Extract ``{metric: value}`` from a bench artifact (see module doc)."""
+    with open(path) as f:
+        text = f.read()
+    lines = text.splitlines()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict):
+        if "tail" in obj:            # driver-captured BENCH_rNN.json
+            lines = str(obj["tail"]).splitlines()
+        elif "metric" not in obj:    # bare {name: value} map
+            return {str(k): float(v if not isinstance(v, (list, tuple))
+                                  else v[0])
+                    for k, v in obj.items()
+                    if isinstance(v, (int, float, list, tuple))}
+    metrics: Dict[str, float] = {}
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(entry, dict):
+            continue
+        if entry.get("metric") == "bench_summary":
+            for name, value in entry.get("metrics", {}).items():
+                if isinstance(value, (list, tuple)):
+                    value = value[0]
+                metrics[str(name)] = float(value)
+        elif "metric" in entry and isinstance(entry.get("value"),
+                                              (int, float)):
+            metrics[str(entry["metric"])] = float(entry["value"])
+    if not metrics:
+        raise SystemExit(f"{path}: no bench metrics found (expected bench.py"
+                         " JSON lines, a BENCH_rNN.json capture, or a bare"
+                         " metric map)")
+    return metrics
+
+
+def compare(old: Dict[str, float], new: Dict[str, float]) -> List[Dict]:
+    """Per-metric rows: value pair, signed delta pct, and the direction-aware
+    ``regression_pct`` (how much WORSE the new value is; <= 0 = no worse).
+
+    A baseline metric MISSING from the candidate is the worst possible
+    regression (the bench stopped measuring it - e.g. it crashed mid-run),
+    so it carries ``regression_pct = inf`` and trips any armed gate; a NEW
+    metric absent from the baseline is not a regression.  A zero baseline
+    admits no percentage, but a direction-worse move off zero still gates
+    (``inf``)."""
+    rows = []
+    for name in sorted(set(old) | set(new)):
+        a, b = old.get(name), new.get(name)
+        row: Dict = {"metric": name, "old": a, "new": b,
+                     "lower_is_better": lower_is_better(name)}
+        if a is not None and b is None:
+            row["regression_pct"] = float("inf")
+        elif a is not None and b is not None and a != 0:
+            delta_pct = (b - a) / abs(a) * 100.0
+            row["delta_pct"] = delta_pct
+            row["regression_pct"] = (delta_pct if row["lower_is_better"]
+                                     else -delta_pct)
+        elif a == 0 and b is not None and b != a:
+            row["regression_pct"] = (float("inf")
+                                     if (b > a) == row["lower_is_better"]
+                                     else 0.0)
+        rows.append(row)
+    return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="Print per-metric deltas between two bench result files;"
+                    " optionally fail on regression (CI gate)")
+    parser.add_argument("old", help="baseline bench file")
+    parser.add_argument("new", help="candidate bench file")
+    parser.add_argument("--fail-threshold", type=float, default=None,
+                        metavar="PCT",
+                        help="exit 1 when any shared metric regresses by more"
+                             " than PCT percent (unset = report-only)")
+    parser.add_argument("--metrics", nargs="+", default=None,
+                        help="only compare these metric names")
+    parser.add_argument("--json", action="store_true",
+                        help="print one JSON object instead of a table")
+    args = parser.parse_args(argv)
+
+    old, new = load_metrics(args.old), load_metrics(args.new)
+    if args.metrics:
+        old = {k: v for k, v in old.items() if k in args.metrics}
+        new = {k: v for k, v in new.items() if k in args.metrics}
+    rows = compare(old, new)
+    failures = [r for r in rows
+                if args.fail_threshold is not None
+                and r.get("regression_pct", 0.0) > args.fail_threshold]
+
+    if args.json:
+        print(json.dumps({"rows": rows,
+                          "fail_threshold": args.fail_threshold,
+                          "failures": [r["metric"] for r in failures]}))
+    else:
+        width = max([len(r["metric"]) for r in rows] + [6])
+        print(f"{'metric':<{width}} {'old':>14} {'new':>14} {'delta%':>8}")
+        for r in rows:
+            old_s = f"{r['old']:.2f}" if r["old"] is not None else "-"
+            new_s = f"{r['new']:.2f}" if r["new"] is not None else "-"
+            delta = r.get("delta_pct")
+            delta_s = f"{delta:+7.1f}%" if delta is not None else "       -"
+            note = " (lower is better)" if r["lower_is_better"] else ""
+            flag = "  << REGRESSION" if r in failures else ""
+            print(f"{r['metric']:<{width}} {old_s:>14} {new_s:>14}"
+                  f" {delta_s}{note}{flag}")
+        if args.fail_threshold is not None:
+            print(f"gate: {len(failures)} metric(s) regressed more than"
+                  f" {args.fail_threshold:g}%"
+                  + (f": {', '.join(r['metric'] for r in failures)}"
+                     if failures else ""))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
