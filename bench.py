@@ -107,10 +107,97 @@ def _median(rates):
 #: capture contains every metric even if early lines scroll out
 _EMITTED = []
 
+# -- tunnel-weather gating (VERDICT r5 headline issue) ------------------------
+# Device-path numbers on this box swing with the TPU tunnel's health, not the
+# code.  Two same-session detectors stamp affected metrics "weather":
+# "degraded" so tools/bench_compare.py SKIPS (not fails) gating on them:
+# a dispatch-latency microprobe (a trivial device op charged a network round
+# trip per call = degraded tunnel), and >= 2 adaptive-commit disablement
+# warnings from the loader (its own in-stream probe of the same pathology,
+# jax/loader._commit).
+_WEATHER = {"status": None, "probe_ms": None, "commit_disables": 0}
 
-def _emit(metric, value, unit, baseline, note=None):
+
+def _install_weather_listener():
+    """Count the loader's adaptive-commit disablement warnings (each one is
+    an in-stream detection of degraded dispatch) without touching its log
+    output."""
+    import logging
+
+    class _Counter(logging.Handler):
+        def emit(self, record):
+            try:
+                if "disabling per-batch commit" in record.getMessage():
+                    _WEATHER["commit_disables"] += 1
+            except Exception:  # noqa: BLE001 - must not break logging
+                pass
+
+    logging.getLogger("petastorm_tpu.jax.loader").addHandler(_Counter())
+
+
+_install_weather_listener()
+
+
+def _scan_child_weather(stderr_text):
+    """Fold a train child's adaptive-commit disablement warnings into the
+    weather verdict.  The device-path loaders run in subprocesses, so their
+    in-stream degradation detections land on child stderr, never on the
+    parent's logging - without this scan, weather turning mid-session inside
+    a train config could not flip the verdict and bench_compare would gate
+    on contaminated numbers."""
+    if stderr_text:
+        _WEATHER["commit_disables"] += stderr_text.count(
+            "disabling per-batch commit")
+
+
+def _tunnel_weather() -> str:
+    """'ok' | 'degraded' | 'unknown' for THIS session's device path.
+
+    The dispatch-latency microprobe runs once, lazily, in a CHILD process
+    (the parent must never initialize the device runtime - the train
+    configs' subprocesses own the chip): 10 trivial device_put round trips
+    after one warmup op.  A healthy local runtime completes each in well
+    under a millisecond; a tunneled runtime in degraded weather charges a
+    full network round trip (~115 ms observed, RESULTS.md), so the 50 ms/op
+    threshold separates the regimes with a wide margin either side.  The
+    loader's adaptive-commit disablement warnings (>= 2) flip the verdict
+    to degraded even when the early probe looked healthy - weather can turn
+    mid-session.
+    """
+    if _WEATHER["status"] is None:
+        import subprocess
+
+        code = ("import time, jax\n"
+                "x = jax.numpy.ones((4, 4)); jax.block_until_ready(x @ x)\n"
+                "t0 = time.perf_counter()\n"
+                "for _ in range(10):\n"
+                "    jax.block_until_ready(jax.device_put(1.0))\n"
+                "print((time.perf_counter() - t0) / 10)\n")
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", code], stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True, env=_child_env(),
+                timeout=300)
+            per_op_ms = 1e3 * float(probe.stdout.strip().splitlines()[-1])
+            _WEATHER["probe_ms"] = round(per_op_ms, 2)
+            _WEATHER["status"] = "degraded" if per_op_ms > 50.0 else "ok"
+        except Exception:  # noqa: BLE001 - a dead runtime is its own verdict
+            _WEATHER["status"] = "unknown"
+    if _WEATHER["commit_disables"] >= 2:
+        return "degraded"
+    return _WEATHER["status"]
+
+
+def _emit(metric, value, unit, baseline, note=None, device_path=False):
     line = {"metric": metric, "value": round(value, 2), "unit": unit,
             "vs_baseline": round(value / baseline, 3)}
+    if device_path:
+        weather = _tunnel_weather()
+        if weather == "degraded":
+            # bench_compare skips (not fails) gating on this metric
+            line["weather"] = "degraded"
+            line["weather_probe_ms"] = _WEATHER["probe_ms"]
+            line["weather_commit_disables"] = _WEATHER["commit_disables"]
     if note:
         line["note"] = note
     print(json.dumps(line), flush=True)
@@ -315,7 +402,8 @@ def bench_imagenet(tmp):
                  R2["imagenet_ingest_samples_per_sec"],
                  note=f"decode={'hybrid-device' if placement else 'host'};"
                       " median-of-3 vs round-2 recorded max-of-3"
-                      + _ceiling_note(rate, url))
+                      + _ceiling_note(rate, url),
+                 device_path=True)
 
 
 def bench_imagenet_mixed(tmp):
@@ -379,7 +467,8 @@ def bench_imagenet_mixed(tmp):
         return _emit("imagenet_ingest_mixed_samples_per_sec", host_rate,
                      "samples/sec", R2["imagenet_ingest_samples_per_sec"],
                      note="HOST decode only (no chip/native lib); 2-geometry"
-                          f" jpeg dataset {geoms}, pad target {target}")
+                          f" jpeg dataset {geoms}, pad target {target}",
+                     device_path=True)
     mixed_rate = run({"image": "device-mixed"})
     uniform = next((ln["value"] for ln in _EMITTED
                     if ln["metric"] == "imagenet_ingest_samples_per_sec"),
@@ -395,7 +484,8 @@ def bench_imagenet_mixed(tmp):
              " ratio to the same-session HOST decode of the SAME mixed data"
              f" ({host_rate:.0f} samples/s - the drift-immune anchor);"
              f" uniform-geometry device decode this session:"
-             f" {uniform if uniform is not None else 'n/a'}")
+             f" {uniform if uniform is not None else 'n/a'}",
+        device_path=True)
 
 
 # -- north star: same jpeg dataset through ours vs best-effort tf.data --------
@@ -510,7 +600,8 @@ def bench_north_star(tmp):
                  note=f"ours={_median(ours):.0f} tf.data={_median(tfd):.0f}"
                       f" samples/sec, interleaved median-of-3,"
                       f" decode={'hybrid-device' if placement else 'host'};"
-                      " vs_baseline>=1.0 meets the >=0.9x-of-tf.data target")
+                      " vs_baseline>=1.0 meets the >=0.9x-of-tf.data target",
+                 device_path=True)
 
 
 # -- north star under REAL training: tf.data vs ours, same train loop ---------
@@ -599,8 +690,11 @@ def bench_north_star_train(tmp):
             [sys.executable, script, "--dataset-url", url, "--skip-generate",
              "--workers", "1", "--prefetch", "3", "--decode", "device",
              "--cache", "null", "--input", input_, "--json"] + shape,
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, timeout=900, check=True)
+        # captured (not forwarded): the warnings feed the weather verdict
+        # without polluting the driver's tail capture
+        _scan_child_weather(out.stderr)
         return json.loads(out.stdout.strip().splitlines()[-1])
 
     ours, tfd = [], []
@@ -627,7 +721,8 @@ def bench_north_star_train(tmp):
                       f" fresh-process interleaved A/B x{pairs}, cold cache):"
                       f" ours {om:.0f} samples/s/chip @ {oi:.1f}% input idle"
                       f" vs tf.data {tm:.0f} @ {ti:.1f}%;"
-                      " vs_baseline>=1.0 meets the >=0.9x-of-tf.data target")
+                      " vs_baseline>=1.0 meets the >=0.9x-of-tf.data target",
+                 device_path=True)
 
 
 # -- real-training input stall: ResNet-50 train steps -------------------------
@@ -674,8 +769,11 @@ def bench_train_stall(tmp):
             [sys.executable, script, "--dataset-url", url, "--skip-generate",
              "--workers", "1", "--prefetch", "3", "--decode", "device",
              "--cache", cache, "--scan-steps", str(scan), "--json"] + shape,
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, timeout=900, check=True)
+        # captured (not forwarded): the warnings feed the weather verdict
+        # without polluting the driver's tail capture
+        _scan_child_weather(out.stderr)
         return json.loads(out.stdout.strip().splitlines()[-1])
 
     # nominal dense bf16 peaks by device kind - the FALLBACK denominator
@@ -717,12 +815,13 @@ def bench_train_stall(tmp):
                f" train steps, decode={cold['decode']}, cold cache;"
                f" warm memory cache: {warm['device_idle_pct']:.1f}%."
                " This host has ONE cpu core feeding the chip; a v5e host"
-               " has ~14 cores/chip")
+               " has ~14 cores/chip", device_path=True)
     _emit("imagenet_train_warm_cache_samples_per_sec_per_chip",
           warm["samples_per_sec_per_chip"], "samples/sec/chip", 1230.0,
           note=f"{warm['steps']} real train steps, global_batch="
                f"{warm['global_batch']}, decode={warm['decode']},"
-               " warm memory LRU; vs round-1 recorded 1230")
+               " warm memory LRU; vs round-1 recorded 1230",
+          device_path=True)
     warm_mfu = mfu_pct(warm)
     if warm_mfu is not None:
         peak, peak_src = peak_for(warm)
@@ -734,13 +833,15 @@ def bench_train_stall(tmp):
                    f" {peak:.3g} peak FLOP/s ({peak_src};"
                    f" device_kind {warm.get('device_kind')!r}, nominal"
                    f" {peak_flops.get(warm.get('device_kind', ''), 0):.3g});"
-                   " vs_baseline = fraction of chip peak (host-independent)")
+                   " vs_baseline = fraction of chip peak (host-independent)",
+              device_path=True)
     line = _emit("imagenet_train_samples_per_sec_per_chip",
                  cold["samples_per_sec_per_chip"], "samples/sec/chip",
                  1230.0,  # round-1 RESULTS.md recorded 1230-1340 on this chip
                  note=f"{cold['steps']} real train steps, global_batch="
                       f"{cold['global_batch']}, decode={cold['decode']},"
-                      " cold cache; vs round-1 recorded 1230")
+                      " cold cache; vs round-1 recorded 1230",
+                 device_path=True)
     # warm + lax.scan multi-step LAST, after the cold/warm metrics are safely
     # emitted (a failure here must not discard two completed measurements):
     # 8 train steps per dispatch amortizes the fixed per-call RPC of the
@@ -751,7 +852,7 @@ def bench_train_stall(tmp):
           note=f"{scan8['steps']} real train steps, 8 steps/dispatch via"
                " lax.scan fed by JaxDataLoader(stack_batches=8) - one"
                " (8, B, ...) transfer per dispatch; warm memory LRU;"
-               " vs round-1 recorded 1230")
+               " vs round-1 recorded 1230", device_path=True)
     scan8_mfu = mfu_pct(scan8, flops_from=warm)
     if scan8_mfu is not None:
         peak, peak_src = peak_for(scan8)
@@ -761,7 +862,7 @@ def bench_train_stall(tmp):
                    " FLOP/sample (XLA cost_analysis of the scan=1 compiled"
                    " step - the scan body is identical math) over"
                    f" {peak:.3g} peak FLOP/s ({peak_src});"
-                   " vs_baseline = fraction of chip peak")
+                   " vs_baseline = fraction of chip peak", device_path=True)
     if "input_stall_pct" in scan8:
         _emit("imagenet_train_scan8_input_stall_pct",
               scan8["input_stall_pct"], "%", 100.0,
@@ -770,7 +871,8 @@ def bench_train_stall(tmp):
                    " stacked unit, no input pipeline in the loop), as % of"
                    " wall - valid where consumer_wait is not (scan overlaps"
                    f" it with device work). scan=1 warm comparison:"
-                   f" {warm.get('input_stall_pct', float('nan')):.1f}%")
+                   f" {warm.get('input_stall_pct', float('nan')):.1f}%",
+              device_path=True)
     return line
 
 
@@ -890,7 +992,88 @@ def bench_converter(tmp):
         conv.delete()
     return _emit("converter_rows_per_sec", rate, "rows/sec",
                  R2["converter_rows_per_sec"],
-                 note="median-of-3 vs round-2 recorded max-of-3" + suffix)
+                 note="median-of-3 vs round-2 recorded max-of-3" + suffix,
+                 device_path=True)
+
+
+# -- autotune convergence: cold bad knobs vs same-session hand-tuned ----------
+
+def bench_autotune(tmp):
+    """Closed-loop autotune A/B on the simulated-step stall shape (ISSUE 5
+    acceptance): starting from deliberately bad knobs (workers=1, a
+    1-deep results queue), an autotuned run must converge toward the
+    same-session hand-tuned optimum (>= 80% of it), and turning autotune ON
+    over the already-hand-tuned knobs must never cost more than 10% (the
+    no-regression guard).  Interleaved rounds, median-of-3, same-session
+    hand-tuned anchor - the RESULTS.md drift-hygiene recipe.  Host-only
+    (reader + thread pool plane; the prefetch knob is exercised by the
+    loader tests, not here - this config must run chip or no chip)."""
+    import numpy as np
+
+    from petastorm_tpu.autotune import AutotunePolicy
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.schema import Field, Schema
+
+    url = os.path.join(tmp, "autotune_png")
+    if not os.path.exists(url):
+        rng = np.random.default_rng(11)
+        schema = Schema("Tune", [
+            Field("label", np.int64, (), ScalarCodec()),
+            Field("image", np.uint8, (96, 96, 3), CompressedImageCodec("png")),
+        ])
+        rows = [{"label": i,
+                 "image": rng.integers(0, 255, (96, 96, 3), dtype=np.uint8)}
+                for i in range(256)]
+        write_dataset(url, schema, rows, row_group_size_rows=8)
+
+    STEP_S = 0.004   # simulated per-batch consumer step (the stall shape)
+    DURATION_S = 6.0
+    # fast-converging policy: the proof is that the LOOP finds the optimum,
+    # not that the production pacing (seconds-scale settle) would in 6s
+    policy = AutotunePolicy(warmup_s=0.4, settle_s=0.4, tick_s=0.05,
+                            eval_points=2, cooldown_s=0.3, max_workers=8)
+
+    def run(workers, results_queue, autotune):
+        rows = 0
+        with make_batch_reader(
+                url, reader_pool_type="thread", workers_count=workers,
+                results_queue_size=results_queue, num_epochs=None,
+                shuffle_row_groups=False,
+                autotune=policy if autotune else False,
+                sample_interval_s=0.2 if autotune else None) as r:
+            t0 = time.perf_counter()
+            for b in r.iter_batches():
+                rows += b.num_rows
+                time.sleep(STEP_S)
+                if time.perf_counter() - t0 >= DURATION_S:
+                    break
+            wall = time.perf_counter() - t0
+        return rows / wall
+
+    # hand-tuned = this box's recorded optimum shape (RESULTS.md: worker
+    # count peaks at 2 on the 1-core host), default results bound
+    bad_auto, hand_off, hand_auto = [], [], []
+    for _ in range(3):  # interleaved so host drift hits all three equally
+        hand_off.append(run(2, 10, autotune=False))
+        bad_auto.append(run(1, 1, autotune=True))
+        hand_auto.append(run(2, 10, autotune=True))
+    anchor = max(_median(hand_off), 1e-6)
+    _emit("autotune_cold_vs_handtuned_ratio", _median(bad_auto) / anchor,
+          "x", 0.8,
+          note="cold bad knobs (workers=1, results_queue=1) + autotune vs"
+               f" same-session hand-tuned (workers=2) over {DURATION_S:.0f}s"
+               f" with a {1e3 * STEP_S:.0f}ms simulated step, interleaved"
+               f" median-of-3; hand-tuned anchor {anchor:.0f} rows/s;"
+               " vs_baseline>=1.0 meets the >=80%-of-hand-tuned target"
+               " (convergence time included in the window)")
+    return _emit("autotune_on_vs_off_ratio", _median(hand_auto) / anchor,
+                 "x", 0.9,
+                 note="autotune ON over already-hand-tuned knobs vs the"
+                      " identical autotune-OFF run (same session,"
+                      " interleaved); vs_baseline>=1.0 meets the >=90%"
+                      " no-regression guard")
 
 
 # -- config 5: ngram windows --------------------------------------------------
@@ -949,7 +1132,7 @@ def main() -> None:
         for fn in (bench_train_stall, bench_north_star_train,
                    bench_cold_floor, bench_mnist, bench_imagenet,
                    bench_imagenet_mixed, bench_converter, bench_ngram,
-                   bench_remote_latency, bench_north_star):
+                   bench_remote_latency, bench_north_star, bench_autotune):
             try:
                 fn(tmp)
             except Exception:  # noqa: BLE001 - reported, never fatal
@@ -957,11 +1140,16 @@ def main() -> None:
                                   traceback.format_exc(limit=3)}), flush=True)
         # penultimate summary: replay every metric in ONE line directly before
         # the headline, so any tail window of the driver's capture holds all
-        # numbers even if early lines scrolled out (BENCH_r03 truncation)
+        # numbers even if early lines scrolled out (BENCH_r03 truncation);
+        # weather-flagged metrics ride along so bench_compare can skip them
+        # even when only the summary survives the capture window
         print(json.dumps({"metric": "bench_summary",
                           "metrics": {ln["metric"]: [ln["value"],
                                                      ln["vs_baseline"]]
-                                      for ln in _EMITTED}}), flush=True)
+                                      for ln in _EMITTED},
+                          "weather_degraded": [ln["metric"] for ln in _EMITTED
+                                               if ln.get("weather")
+                                               == "degraded"]}), flush=True)
         bench_hello_world(tmp)  # headline LAST: the driver parses the last line
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

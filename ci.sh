@@ -167,6 +167,57 @@ print(f"metrics endpoint smoke OK (port {port}, {len(body.splitlines())}"
       " final snapshot attached)")
 PY
 
+echo "== autotune smoke (closed-loop knob tuning during a chaos read) =="
+# a short worker-bound chaos read with autotune armed (fast-paced policy -
+# the production pacing is seconds-scale, see docs/operations.md
+# "Autotuning") must deliver the exact row multiset, record >= 1 tuning
+# decision, and expose the decision trail in diagnostics + autotune.*
+# counters - the self-tuning contract of ISSUE 5
+JAX_PLATFORMS=cpu timeout -k 10 120 python - <<'PY'
+import tempfile
+import time
+import numpy as np
+from petastorm_tpu.autotune import AutotunePolicy
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.chaos import ChaosSpec
+from petastorm_tpu.transform import TransformSpec
+
+tmp = tempfile.mkdtemp(prefix="petastorm_tpu_autotune_smoke_")
+schema = Schema("AutotuneSmoke", [Field("x", np.int64)])
+write_dataset(tmp, schema, [{"x": i} for i in range(400)],
+              row_group_size_rows=4)
+
+def slow(cols):
+    time.sleep(0.01)
+    return cols
+
+tele = Telemetry()
+chaos = ChaosSpec(kill_ordinals=(4,))
+policy = AutotunePolicy(warmup_s=0.2, settle_s=0.2, tick_s=0.05,
+                        eval_points=2, cooldown_s=0.1)
+with make_batch_reader(tmp, reader_pool_type="thread", workers_count=1,
+                       shuffle_row_groups=False, num_epochs=2, chaos=chaos,
+                       transform_spec=TransformSpec(slow), telemetry=tele,
+                       autotune=policy, sample_interval_s=0.1) as reader:
+    assert reader.autotune is not None, "autotune did not arm"
+    rows = sorted(x for b in reader.iter_batches() for x in b.columns["x"])
+    diag = reader.diagnostics
+assert rows == sorted(list(range(400)) * 2), len(rows)
+at = diag["autotune"]
+assert at["moves_applied"] >= 1, at
+assert at["decisions"], at
+assert diag["requeued_items"] >= 1, diag
+counters = tele.snapshot()["counters"]
+assert counters["autotune.moves_applied"] == at["moves_applied"]
+print("autotune smoke OK"
+      f" ({at['moves_applied']} move(s) applied, {at['moves_kept']} kept,"
+      f" {at['moves_reverted']} reverted; final knobs {at['knobs']};"
+      f" {len(rows)} rows delivered exactly once under a worker kill)")
+PY
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

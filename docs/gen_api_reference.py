@@ -90,7 +90,10 @@ API = [
                                          "load_flight_records"]),
     ("petastorm_tpu.telemetry.export", ["MetricsExportServer",
                                         "render_prometheus", "write_jsonl"]),
+    ("petastorm_tpu.autotune", ["AutotunePolicy", "AutotuneController",
+                                "resolve_autotune"]),
     ("petastorm_tpu.tools.diagnose", ["run_diagnosis",
+                                      "render_autotune_verdict",
                                       "render_liveness_verdict",
                                       "render_watch_frame"]),
     ("petastorm_tpu.test_util.chaos", ["ChaosSpec", "ChaosWorker",

@@ -94,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="on a terminal reader failure, dump the flight"
                              " record (sampled series + trace tail) to PATH"
                              " as JSONL; auto-enables telemetry")
+    parser.add_argument("--autotune", action="store_true",
+                        help="run the closed-loop knob tuner during the"
+                             " measurement: workers / results-queue bound /"
+                             " prefetch adapt to the live metrics sampler"
+                             " (petastorm_tpu.autotune; decisions ride"
+                             " telemetry as autotune.*)")
     return parser
 
 
@@ -130,7 +136,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             chaos=chaos, on_error=args.on_error,
             item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after,
             metrics_port=args.metrics_port,
-            flight_record_path=args.flight_record)
+            flight_record_path=args.flight_record,
+            autotune=args.autotune)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(
@@ -141,7 +148,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             telemetry=telemetry, chaos=chaos, on_error=args.on_error,
             item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after,
             metrics_port=args.metrics_port,
-            flight_record_path=args.flight_record)
+            flight_record_path=args.flight_record,
+            autotune=args.autotune)
 
     if telemetry is not None and args.trace_out and not args.isolated:
         telemetry.export_chrome_trace(args.trace_out)

@@ -92,7 +92,8 @@ def reader_throughput(dataset_url: str,
                       item_deadline_s: Optional[float] = None,
                       hedge_after_s=None,
                       metrics_port: Optional[int] = None,
-                      flight_record_path: Optional[str] = None) -> BenchmarkResult:
+                      flight_record_path: Optional[str] = None,
+                      autotune=False) -> BenchmarkResult:
     """Measure raw reader throughput in samples/sec.
 
     ``read_method='row'`` counts one sample per ``next()`` (make_reader);
@@ -101,6 +102,8 @@ def reader_throughput(dataset_url: str,
     snapshot rides back on ``BenchmarkResult.metrics``.
     ``chaos``/``on_error``: measure throughput under injected faults
     (test_util.chaos) - degradation becomes a number, not an anecdote.
+    ``autotune``: run the closed-loop knob tuner during the measurement
+    (petastorm_tpu.autotune; True or an AutotunePolicy).
     Reference: ``reader_throughput`` (benchmark/throughput.py:113-174).
     """
     from petastorm_tpu.reader import make_batch_reader, make_reader
@@ -120,7 +123,8 @@ def reader_throughput(dataset_url: str,
                  item_deadline_s=item_deadline_s,
                  hedge_after_s=hedge_after_s,
                  metrics_port=metrics_port,
-                 flight_record_path=flight_record_path) as reader:
+                 flight_record_path=flight_record_path,
+                 autotune=autotune or None) as reader:
         if reader.metrics_server is not None:
             # stderr so --json stdout stays one parseable line; without this
             # an ephemeral --metrics-port 0 endpoint would be unreachable
@@ -164,7 +168,8 @@ def jax_loader_throughput(dataset_url: str,
                           item_deadline_s: Optional[float] = None,
                           hedge_after_s=None,
                           metrics_port: Optional[int] = None,
-                          flight_record_path: Optional[str] = None) -> BenchmarkResult:
+                          flight_record_path: Optional[str] = None,
+                          autotune=False) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
@@ -194,7 +199,8 @@ def jax_loader_throughput(dataset_url: str,
                           if device_decode_fields else None),
         telemetry=tele, chaos=chaos, on_error=on_error,
         item_deadline_s=item_deadline_s, hedge_after_s=hedge_after_s,
-        metrics_port=metrics_port, flight_record_path=flight_record_path)
+        metrics_port=metrics_port, flight_record_path=flight_record_path,
+        autotune=autotune or None)
     if reader.metrics_server is not None:
         # same stderr contract as reader_throughput: the ephemeral bound
         # port must be reachable by the user

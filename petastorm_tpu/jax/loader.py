@@ -334,6 +334,39 @@ class JaxDataLoader:
         #: batch), not the schema
         self._emitted_layout: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {}
 
+        # closed-loop autotuning (petastorm_tpu.autotune): an autotuned
+        # reader's controller gains this loader's prefetch depth as a knob
+        controller = getattr(reader, "autotune", None)
+        if controller is not None and hasattr(controller, "attach_loader"):
+            controller.attach_loader(self)
+
+    # -- runtime-adjustable prefetch (docs/operations.md "Autotuning") --------
+
+    @property
+    def prefetch(self) -> int:
+        """Current per-stage producer queue bound (both the host-assembly
+        and the device-transfer queues; runtime-adjustable via
+        :meth:`set_prefetch`)."""
+        return self._out.maxsize
+
+    def set_prefetch(self, depth: int) -> int:
+        """Resize both producer-stage queue bounds in place.
+
+        Widening wakes any producer blocked on a full queue immediately;
+        narrowing never drops queued batches - puts simply block until the
+        consumer drains below the new bound.  This is the autotune
+        controller's prefetch knob, and is safe to call directly while the
+        loader runs.  Returns the new depth.
+        """
+        depth = max(1, int(depth))
+        for q in (self._host_q, self._out):
+            # stdlib queue.Queue: maxsize is only read under the mutex, and
+            # not_full shares that mutex - mutate and wake waiters atomically
+            with q.not_full:
+                q.maxsize = depth
+                q.not_full.notify_all()
+        return depth
+
     # -- shape/sharding bookkeeping ------------------------------------------
 
     def _mixed_target(self, name: str) -> Tuple[int, ...]:

@@ -103,6 +103,78 @@ class VentilationCancelled(Exception):
     item was NOT enqueued.  Internal control flow, never user-visible."""
 
 
+class _ResizableSemaphore:
+    """Counting semaphore whose bound can change while waiters are blocked.
+
+    The executors' queue bounds live in semaphores (see ThreadedExecutor's
+    queue-choice comment); runtime autotuning (petastorm_tpu.autotune) needs
+    those bounds adjustable mid-flight.  ``threading.BoundedSemaphore`` bakes
+    its bound in at construction, so this replaces it with the same acquire/
+    release contract plus ``set_bound``:
+
+    * accounting stays EXACT across a resize: ``in_use`` only moves via
+      acquire/release, so every acquired slot must still be released and a
+      release without a matching acquire still raises (the BoundedSemaphore
+      overdraft guard the pools rely on to catch accounting bugs);
+    * shrinking below the current ``in_use`` never strands or cancels held
+      slots - new acquires simply block until releases bring ``in_use``
+      under the new bound;
+    * growing wakes every blocked waiter so freed capacity is used at once.
+    """
+
+    __slots__ = ("_bound", "_in_use", "_cond")
+
+    def __init__(self, bound: int):
+        if bound < 1:
+            raise PetastormTpuError(f"semaphore bound must be >= 1, got {bound}")
+        self._bound = int(bound)
+        self._in_use = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            if not blocking:
+                if self._in_use < self._bound:
+                    self._in_use += 1
+                    return True
+                return False
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while self._in_use >= self._bound:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            self._in_use += 1
+            return True
+
+    def release(self) -> None:
+        with self._cond:
+            if self._in_use <= 0:
+                raise ValueError("semaphore released more times than acquired")
+            self._in_use -= 1
+            self._cond.notify()
+
+    def set_bound(self, bound: int) -> None:
+        """Change the bound; growth wakes all blocked acquirers."""
+        if bound < 1:
+            raise PetastormTpuError(f"semaphore bound must be >= 1, got {bound}")
+        with self._cond:
+            self._bound = int(bound)
+            self._cond.notify_all()
+
+    @property
+    def bound(self) -> int:
+        return self._bound
+
+    @property
+    def in_use(self) -> int:
+        """Slots currently held (== bound means the queue is full)."""
+        return self._in_use
+
+
 class _Failure:
     """A worker exception crossing back to the consumer (picklable)."""
 
@@ -690,12 +762,31 @@ class ThreadedExecutor(ExecutorBase):
         # workers_count + 2, reader.py:45-47,412, and treats a non-positive
         # results size as unbounded).
         self._in_queue: "queue.Queue[Any]" = queue.Queue()
-        self._in_slots = threading.BoundedSemaphore(in_queue_size or workers_count + 2)
+        # resizable bounds (petastorm_tpu.autotune adjusts them mid-flight);
+        # same exact-accounting contract as the BoundedSemaphores they
+        # replaced - see _ResizableSemaphore
+        self._in_size_explicit = in_queue_size is not None
+        self._in_slots = _ResizableSemaphore(in_queue_size or workers_count + 2)
         self._out_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
-        self._out_slots = threading.BoundedSemaphore(
+        self._out_slots = _ResizableSemaphore(
             results_queue_size if results_queue_size > 0 else 2 ** 30)
         self._stop_event = threading.Event()
         self._threads = []
+        self._worker_factory: Optional[WorkerFactory] = None
+        # dynamic resize (docs/operations.md "Autotuning"): slots told to
+        # retire at their next item boundary, and slots that have retired.
+        # A retiring worker finishes its current item, moves itself from
+        # _retiring to _retired and exits; retired slots are excluded from
+        # fault reaping, liveness accounting and the all-dead check.
+        self._retiring: set = set()
+        self._retired: set = set()
+        self._resize_lock = threading.Lock()
+        # True once resize_workers has been called: the worker count is then
+        # an explicit TARGET the pool maintains (a slot lost to a death or an
+        # abandoned hang is respawned - the thread flavor of the process
+        # pool's kill-and-replace).  Never-resized pools keep the static
+        # degrade-then-raise semantics PR 3 documented and tests pin.
+        self._target_managed = False
         # opt-in worker profiling (reference per-thread cProfile,
         # thread_pool.py:41-49,190-198).  Python 3.12 allows only ONE active
         # profiler process-wide (sys.monitoring), so profiling is SAMPLED: a
@@ -723,6 +814,8 @@ class ThreadedExecutor(ExecutorBase):
     def start(self, worker_factory: WorkerFactory) -> None:
         if self._threads:
             raise PetastormTpuError("Executor already started")
+        # kept for dynamic grow (resize_workers spawns more slots from it)
+        self._worker_factory = worker_factory
         for i in range(self._workers_count):
             fn = worker_factory()
             self._worker_state.append([None, time.monotonic()])
@@ -731,6 +824,114 @@ class ThreadedExecutor(ExecutorBase):
                                  name=f"petastorm-tpu-worker-{i}", daemon=True)
             t.start()
             self._threads.append(t)
+
+    def resize_workers(self, n: int) -> int:
+        """Grow or shrink the live worker plane to ``n`` threads in place
+        (petastorm_tpu.autotune's worker knob; also callable directly).
+
+        Grow spawns fresh worker threads from the factory captured at
+        ``start``.  Shrink RETIRES the highest-index live slots: each marked
+        worker finishes its current item (no item is ever dropped), then
+        exits; its daemon thread, per-slot heartbeat, and the in-flight
+        ledger all settle exactly as for a normal completion, so the
+        semaphore accounting and the per-ordinal ledger stay exact across
+        any resize sequence.  The default input-queue bound tracks
+        ``workers + 2`` (an explicit ``in_queue_size`` is left alone).
+        Returns the new target count.
+        """
+        n = max(1, int(n))
+        with self._resize_lock:
+            self._target_managed = True
+            if not self._threads:  # not started: just update the target
+                self._workers_count = n
+                if not self._in_size_explicit:
+                    self._in_slots.set_bound(n + 2)
+                return n
+            active = self._active_slots()
+            if len(active) < n:
+                for _ in range(n - len(active)):
+                    active.append(self._spawn_slot())
+            elif len(active) > n:
+                for i in sorted(active, reverse=True)[:len(active) - n]:
+                    self._retiring.add(i)
+            self._workers_count = n
+            if not self._in_size_explicit:
+                self._in_slots.set_bound(n + 2)
+            return n
+
+    def _active_slots(self) -> list:
+        """Indexes of slots that are part of the live worker plane."""
+        return [i for i, t in enumerate(self._threads)
+                if i not in self._retired and i not in self._retiring
+                and i not in self._abandoned and t.is_alive()]
+
+    def _spawn_slot(self) -> int:
+        """Start a fresh worker slot, reusing a cleanly-retired slot index
+        when one is free, else appending (hold _resize_lock).  Reuse matters
+        under autotune: perpetual shrink/grow explore probes would otherwise
+        grow ``_threads``/``_worker_state`` without bound, and every fault
+        and deadline sweep walks those lists (the process pool already
+        respawns into retired slots)."""
+        fn = self._worker_factory()
+        for i in sorted(self._retired):
+            # only slots whose thread has fully exited (a retiring worker
+            # marks itself retired just before returning, so a live thread
+            # here is mid-exit - it stays reusable for the next grow)
+            if not self._threads[i].is_alive():
+                self._retired.discard(i)
+                self._worker_state[i] = [None, time.monotonic()]
+                t = threading.Thread(
+                    target=self._worker_loop, args=(fn, i, False),
+                    name=f"petastorm-tpu-worker-{i}", daemon=True)
+                t.start()
+                self._threads[i] = t
+                return i
+        i = len(self._worker_state)
+        # state slot BEFORE the thread list entry: concurrent iterators
+        # index worker_state by thread index, so len(threads) <=
+        # len(worker_state) must always hold
+        self._worker_state.append([None, time.monotonic()])
+        t = threading.Thread(target=self._worker_loop, args=(fn, i, False),
+                             name=f"petastorm-tpu-worker-{i}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return i
+
+    def _heal_to_target(self) -> None:
+        """Respawn lost slots up to the managed target.  Only once
+        resize_workers has put the plane under target management: with a
+        controller (or caller) owning the worker count, a slot written off
+        to a death or a hung-abandonment must not silently shrink the pool
+        below its target - items requeued through the attempt budget need a
+        live worker to land on (a shrunk-to-one pool whose survivor hangs
+        would otherwise end the epoch with an all-abandoned raise)."""
+        if not self._target_managed or not self._threads:
+            return
+        with self._resize_lock:
+            for _ in range(self._workers_count - len(self._active_slots())):
+                self._spawn_slot()
+
+    def _trim_recovered(self, index: int) -> None:
+        """Retire a just-recovered abandoned slot when it overshoots the
+        managed target.  Abandonment on a target-managed pool heals in a
+        replacement immediately; a thread cannot be killed, so if its hang
+        later resolves the plane would hold target+1 live workers - and
+        repeated slow-then-recovering items would grow it monotonically.
+        The recovered slot (not the replacement) is the one retired: it
+        finishes any in-flight item first, so nothing is dropped."""
+        if not self._target_managed:
+            return
+        with self._resize_lock:
+            if len(self._active_slots()) > self._workers_count:
+                self._retiring.add(index)
+
+    def set_results_bound(self, n: int) -> int:
+        """Resize the results-queue bound in place (autotune's queue knob);
+        shrinking below the current depth just blocks producers until the
+        consumer drains under the new bound.  Returns the new bound."""
+        n = max(1, int(n))
+        self._out_slots.set_bound(n)
+        return n
 
     def _worker_loop(self, fn: Callable, index: int = 0,
                      profile_this_worker: bool = False) -> None:
@@ -741,6 +942,20 @@ class ThreadedExecutor(ExecutorBase):
 
             profile = cProfile.Profile()
         while not self._stop_event.is_set():
+            if index in self._retiring:
+                # retire at the item boundary: mark retired BEFORE exiting so
+                # the consumer's reap sweep never mistakes this clean exit
+                # for a worker death (the thread stays alive until return).
+                # The two set moves are atomic under _resize_lock: a resize
+                # landing between them would see this slot in NEITHER set,
+                # count it active, and re-retire it - stranding the slot in
+                # both sets so its next reuse instantly self-retires
+                state[0] = None
+                state[1] = time.monotonic()
+                with self._resize_lock:
+                    self._retiring.discard(index)
+                    self._retired.add(index)
+                break
             try:
                 item = self._in_queue.get(timeout=_POLL_S)
             except queue.Empty:
@@ -836,8 +1051,12 @@ class ThreadedExecutor(ExecutorBase):
         if self._stop_event.is_set():
             return
         for i, t in enumerate(self._threads):
-            if t.is_alive() or i in self._reaped:
+            if t.is_alive() or i in self._reaped or i in self._retired:
                 continue
+            # a dead thread still marked _retiring never reached its retire
+            # bookkeeping: it died INSIDE fn (e.g. a simulated crash), so it
+            # is a genuine death, not a clean retirement
+            self._retiring.discard(i)
             self._reaped.add(i)
             ordinal = self._worker_state[i][0]
             logger.warning("Worker thread %d died while on item %s", i,
@@ -848,12 +1067,18 @@ class ThreadedExecutor(ExecutorBase):
             # cannot race it)
             self._worker_state[i][1] = time.monotonic()
             self._worker_state[i][0] = None
+            # replace BEFORE the (possibly raising) requeue: a target-managed
+            # pool must keep its worker count whether or not the item has
+            # budget left
+            self._heal_to_target()
             self._requeue_lost(ordinal if isinstance(ordinal, int) else None,
                                f"worker thread {i} death")
         self._check_liveness()
-        if ((self._reaped or self._abandoned) and self._threads
+        considered = [(i, t) for i, t in enumerate(self._threads)
+                      if i not in self._retired]
+        if ((self._reaped or self._abandoned) and considered
                 and all(not t.is_alive() or i in self._abandoned
-                        for i, t in enumerate(self._threads))
+                        for i, t in considered)
                 and self._out_queue.empty()):
             # abandoned-as-hung slots count as gone: with every worker dead
             # or written off, queued/requeued items have no one to run them
@@ -884,18 +1109,29 @@ class ThreadedExecutor(ExecutorBase):
         if deadline is None and hedge_s is None:
             return
         now = time.monotonic()
+        # iterate the thread list, not worker_state: a concurrent grow
+        # appends the state slot first, so indexes past len(threads) may
+        # exist transiently.  Retired/retiring slots are no longer part of
+        # the live worker plane (idle-for-hedging or deadline sweeps).
         idle = any(s[0] is None for i, s in enumerate(self._worker_state)
-                   if i not in self._abandoned and self._threads[i].is_alive())
-        for i, s in enumerate(self._worker_state):
+                   if i < len(self._threads) and i not in self._abandoned
+                   and i not in self._retired and i not in self._retiring
+                   and self._threads[i].is_alive())
+        for i, t in enumerate(self._threads):
+            if i in self._retired:
+                continue
+            s = self._worker_state[i]
             ordinal = s[0]
             if ordinal is None:
-                self._abandoned.pop(i, None)  # recovered and went idle
+                if self._abandoned.pop(i, None) is not None:
+                    self._trim_recovered(i)  # recovered and went idle
                 continue
             if self._abandoned.get(i) == ordinal:
                 continue  # already handled this hang
             if i in self._abandoned:
                 del self._abandoned[i]  # recovered onto a new item
-            if not self._threads[i].is_alive():
+                self._trim_recovered(i)
+            if not t.is_alive():
                 continue  # the reap path owns dead workers
             elapsed = max(0.0, now - s[1])
             if deadline is not None and elapsed > deadline:
@@ -907,6 +1143,10 @@ class ThreadedExecutor(ExecutorBase):
                     " item_deadline_s=%.1f; abandoning the slot and"
                     " requeueing the item onto a sibling worker", i, ordinal,
                     elapsed, deadline)
+                # a target-managed pool replaces the written-off slot before
+                # the (possibly raising) requeue - same contract as the
+                # process pool's kill-and-replace
+                self._heal_to_target()
                 self._requeue_lost(
                     ordinal if isinstance(ordinal, int) else None,
                     f"hung worker thread {i} (exceeded item deadline"
@@ -1016,6 +1256,11 @@ class ThreadedExecutor(ExecutorBase):
                 "in_queue_size": self._in_queue.qsize(),
                 "results_queue_size": self._out_queue.qsize(),
                 "workers_count": self._workers_count,
+                # resizable bounds (autotune knobs) + retired-slot count so a
+                # resize trajectory is reconstructible post-mortem
+                "in_queue_bound": self._in_slots.bound,
+                "results_queue_bound": self._out_slots.bound,
+                "workers_retired": len(self._retired),
                 # [(worker index, item ordinal, seconds on it)] for workers
                 # currently inside fn(item) - a stalled pipeline names the
                 # exact worker and work item instead of wedging silently
@@ -1025,8 +1270,124 @@ class ThreadedExecutor(ExecutorBase):
                 "workers_abandoned": sorted(self._abandoned)}
 
 
+class _CrashSafeResultsChannel:
+    """Bounded results transport whose writes happen synchronously in the
+    worker's only thread.
+
+    ``mp.Queue`` delivers through a per-process background *feeder* thread
+    that serializes frames onto a pipe shared by every writer, under a
+    shared write lock.  A worker that dies abruptly (OOM kill, chaos
+    ``os._exit``) while its feeder holds that lock abandons the lock: every
+    surviving worker's feeder then blocks forever, the consumer starves on
+    an apparently non-empty queue (``qsize`` counts buffered puts that will
+    never reach the pipe), and the epoch wedges with a live-but-mute worker
+    plane.  Reproduced as the intermittent chaos-kill hang in
+    tests/test_fault_tolerance.py::test_chaos_e2e_poison_kill_and_weather.
+
+    Here ``put`` sends the frame from the worker's MAIN thread (its only
+    thread) under a cross-process lock.  The abrupt-death styles this pool
+    must survive land inside ``fn`` (chaos ``os._exit``, the simulated-crash
+    hook) or via the liveness SIGKILL sweep, which already refuses to kill
+    a delivering worker - so a death can no longer interleave with a
+    half-written frame or an abandoned write lock.  Backpressure comes from
+    a slot semaphore (acquired by the writer, released by the consumer
+    after ``recv``), matching ``mp.Queue(maxsize)`` semantics;
+    ``bound <= 0`` means unbounded, like ``mp.Queue``.
+
+    Two deliberate residual tradeoffs.  (1) A death the pool does NOT
+    control - a kernel OOM kill or external SIGKILL landing exactly inside
+    ``send`` - can still orphan the write lock and leave a partial frame
+    that blocks the consumer's ``recv`` past its poll timeout; the pool's
+    own kill paths cannot land there, and ``mp.Queue`` wedged under a
+    strictly larger set of death styles.  (2) Sends serialize under the one
+    write lock, so siblings queue behind a large in-flight frame; with the
+    shm transport (the default where the native module builds) frames are
+    small descriptors and the lock is held microseconds.  Per-worker pipes
+    would remove both by construction - the upgrade path if either bites.
+    """
+
+    def __init__(self, ctx, bound: int):
+        self._rx, self._tx = ctx.Pipe(duplex=False)
+        self._wlock = ctx.Lock()
+        self._bound = int(bound)
+        self._slots = ctx.BoundedSemaphore(self._bound) if bound > 0 else None
+
+    def put(self, obj, stop_event, wait_cell=None) -> bool:
+        """Worker-side enqueue; False = dropped (shutdown/closed channel).
+
+        ``wait_cell``: optional ``(shared double array, slot index)`` that
+        accumulates the seconds this worker spent BLOCKED on a full channel
+        (slot-semaphore waits only; an uncontended acquire records nothing).
+        Single-writer per slot; the parent harvests deltas into the
+        ``queue.results_full_wait_s`` counter so the autotune controller's
+        consumer-bound signal works across the process boundary."""
+        if self._slots is not None and not self._slots.acquire(block=False):
+            t0 = time.perf_counter()
+            while not self._slots.acquire(timeout=_POLL_S):
+                if stop_event.is_set():
+                    return False
+            if wait_cell is not None:
+                arr, i = wait_cell
+                arr[i] += time.perf_counter() - t0
+        try:
+            with self._wlock:
+                self._tx.send(obj)
+        except (OSError, ValueError):
+            # consumer gone (read end closed at join); nothing to deliver to
+            if self._slots is not None:
+                try:
+                    self._slots.release()
+                except ValueError:
+                    pass
+            return False
+        return True
+
+    def get(self, timeout: Optional[float] = None):
+        """Parent-side dequeue; raises ``queue.Empty`` on timeout (the
+        ``mp.Queue.get`` contract the poll loops are written against)."""
+        if not self._rx.poll(timeout):
+            raise queue.Empty
+        obj = self._rx.recv()
+        if self._slots is not None:
+            try:
+                self._slots.release()
+            except ValueError:
+                pass
+        return obj
+
+    def qsize(self) -> int:
+        if self._slots is None:
+            raise NotImplementedError("unbounded channel has no depth gauge")
+        # in-flight = bound - free slots (sem_getvalue; absent on macOS,
+        # where this raises NotImplementedError like mp.Queue.qsize)
+        return self._bound - self._slots.get_value()
+
+    def worker_init(self) -> None:
+        """Child-side setup: drop the inherited read end.  Every spawned
+        worker receives a dup of ``_rx`` through the Process args; while any
+        of those dups stays open, the parent's :meth:`close` cannot turn a
+        blocked ``send`` into an EPIPE - the pipe would still have a
+        nominal reader."""
+        try:
+            self._rx.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        """Parent-side teardown: closing the read end makes any sender
+        still blocked in ``send`` fail with EPIPE instead of leaking
+        (requires every worker to have dropped its inherited ``_rx`` dup
+        via :meth:`worker_init`)."""
+        for conn in (self._rx, self._tx):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
 def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
-                         index=0, heartbeats=None):
+                         index=0, heartbeats=None, retire_flags=None,
+                         full_waits=None):
     """Worker-process entrypoint (module-level: must be picklable for spawn).
 
     ``heartbeats``: optional lock-free shared double array, 3 slots per
@@ -1041,7 +1402,28 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
     ordinal, timestamp, ordinal again, retry when the ordinal moved)
     guarantees a sample never pairs a new ordinal with a stale timestamp —
     a torn pair can no longer report a bogus stall (PR 1 caveat, since
-    fixed).
+    fixed).  One residual caveat alongside the wall-clock one: the 8-byte
+    slot writes themselves are plain unsynchronized RawArray stores, and
+    their per-slot atomicity is an x86-64 property (aligned 8-byte stores
+    are single-copy atomic there).  On architectures without that guarantee
+    a reader could in principle observe a HALF-WRITTEN double inside one
+    slot — bounded to one garbage (ordinal, since) sample in a diagnostics
+    sweep (the next sweep re-reads fresh values, and the reading side
+    clamps negative ages), never control-flow corruption, since the kill
+    sweep re-reads post-mortem before acting.
+
+    ``retire_flags``: optional shared byte array, one flag per slot; a
+    nonzero flag tells this worker to exit cleanly at its next item
+    boundary (dynamic pool shrink, ``_ProcessExecutor.resize_workers``).
+    The current item always completes and delivers first.
+
+    ``full_waits``: optional shared double array, one cell per slot,
+    accumulating the seconds this worker spent blocked on a full results
+    channel (single writer per cell, same torn-store caveat as the
+    heartbeats).  The parent folds deltas into the
+    ``queue.results_full_wait_s`` counter on its ``get()`` path, so the
+    autotune controller's consumer-bound signal crosses the process
+    boundary.
 
     The heartbeat doubles as the crash ledger: a worker that dies mid-item
     (OOM kill, segfault) leaves its ordinal in the slot, which is how the
@@ -1050,21 +1432,30 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
     The ``delivering`` slot (-1.0 = no) flips to the ordinal between
     finishing the work function and completing the result enqueue.  The
     liveness kill sweep (``_check_liveness``) refuses to SIGKILL a
-    delivering worker: a kill landing while the queue's feeder holds the
-    shared write lock would orphan the lock and deadlock every other
-    worker's ``out_queue.put`` forever.  The ordinal slot deliberately
-    stays set until AFTER the put, preserving crash attribution for a death
-    mid-delivery (the ledger requeues it; a double delivery dedups).
+    delivering worker: a kill landing inside the channel's ``send`` would
+    orphan the shared write lock and deadlock every other worker's
+    ``out_queue.put`` forever (``_CrashSafeResultsChannel`` keeps every
+    OTHER abrupt-death style off that lock by sending from this thread).
+    The ordinal slot deliberately stays set until AFTER the put, preserving
+    crash attribution for a death mid-delivery (the ledger requeues it; a
+    double delivery dedups).
     """
+    out_queue.worker_init()  # drop the inherited read end (see channel docs)
     try:
         fn = worker_factory()
     except BaseException as exc:  # noqa: BLE001
-        out_queue.put(_Failure(exc))
+        out_queue.put(_Failure(exc), stop_event)
         return
     if hasattr(fn, "stop_event"):  # shm encoder: abort full-arena waits on stop
         fn.stop_event = stop_event
     base = 3 * index
     while not stop_event.is_set():
+        if retire_flags is not None and retire_flags[index]:
+            # retire at the item boundary (pool shrink): ack with 2 BEFORE
+            # exiting so the parent can promote the slot to retired without
+            # having to observe the process death in a fault sweep
+            retire_flags[index] = 2
+            break
         try:
             item = in_queue.get(timeout=_POLL_S)
         except queue.Empty:
@@ -1091,7 +1482,9 @@ def _process_worker_main(worker_factory, in_queue, out_queue, stop_event,
             result = _Failure(exc, ordinal=ordinal, item=item)
         if heartbeats is not None:
             heartbeats[base + 2] = hb_ordinal  # delivering: do not SIGKILL
-        out_queue.put(result)
+        out_queue.put(result, stop_event,
+                      wait_cell=(None if full_waits is None
+                                 else (full_waits, index)))
         if heartbeats is not None:
             heartbeats[base] = -1.0
             heartbeats[base + 1] = time.time()
@@ -1122,7 +1515,8 @@ class _ProcessExecutor(ExecutorBase):
                  stop_on_failure: bool = True,
                  max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
                  item_deadline_s: Optional[float] = None,
-                 hedge_after_s=None):
+                 hedge_after_s=None,
+                 max_workers: Optional[int] = None):
         # telemetry: the PARENT process records ventilation/queue waits;
         # worker-side stage metrics recorded in the spawned processes stay
         # there (PETASTORM_TPU_TELEMETRY is inherited, so each child records
@@ -1135,14 +1529,45 @@ class _ProcessExecutor(ExecutorBase):
 
         self._ctx = mp.get_context("spawn")
         self._workers_count = workers_count
+        # shared-memory slot capacity for dynamic grow (resize_workers): the
+        # heartbeat/retire RawArrays cannot be extended after start, so slots
+        # are pre-allocated up to this ceiling.  ``max_workers`` (autotune's
+        # policy bound) sizes it explicitly; the default leaves generous
+        # headroom without materializing hundreds of unused slots.
+        self._slot_capacity = max(workers_count,
+                                  max_workers if max_workers
+                                  else min(4 * workers_count, 32))
         self._in_queue = self._ctx.Queue(in_queue_size or workers_count + 2)
-        self._out_queue = self._ctx.Queue(results_queue_size)
+        # NOT an mp.Queue: its async feeder thread can wedge every surviving
+        # writer when a worker dies abruptly (see _CrashSafeResultsChannel)
+        self._out_queue = _CrashSafeResultsChannel(self._ctx,
+                                                   results_queue_size)
         self._stop_event = self._ctx.Event()
         self._procs = []
         self._worker_factory = None
         self._reaped: set = set()
+        # dynamic resize (docs/operations.md "Autotuning"): slots flagged to
+        # retire at their next item boundary, and slots whose worker has
+        # exited cleanly after retirement
+        self._retiring: set = set()
+        self._retired: set = set()
+        self._retire_flags = None
+        # RLock: resize_workers calls _promote_retirements (which now locks
+        # itself - the consumer's fault sweep and diagnostics promote too,
+        # and an unlocked promotion racing a locked grow can exile a freshly
+        # respawned worker from fault reaping)
+        self._resize_lock = threading.RLock()
+        # True once resize_workers has been called: the count becomes an
+        # explicit target the pool maintains, so a crashed worker is
+        # respawned into its slot instead of permanently shrinking the plane
+        # (never-resized pools keep the PR 2 degrade-then-raise semantics)
+        self._target_managed = False
         self._arena = None
         self._heartbeats = None
+        # per-slot full-channel wait accumulators (seconds), harvested into
+        # queue.results_full_wait_s as deltas on the parent's get() path
+        self._full_waits = None
+        self._full_wait_harvested = 0.0
         self._shm_size_bytes = shm_size_bytes
         if use_shm is None:  # auto: use the native transport when it builds
             from petastorm_tpu.native import is_available
@@ -1160,13 +1585,21 @@ class _ProcessExecutor(ExecutorBase):
             self._arena = SharedArena.create(self._shm_size_bytes)
             worker_factory = ShmResultEncoder(worker_factory, self._arena.name)
         # kept for hung-worker kill-and-replace respawns (_check_liveness)
+        # and dynamic grow (resize_workers)
         self._worker_factory = worker_factory
         # lock-free heartbeat slots (single-writer per triple; see
-        # _process_worker_main) - powers workers_busy across processes
-        self._heartbeats = self._ctx.RawArray("d", 3 * self._workers_count)
-        for i in range(self._workers_count):
+        # _process_worker_main) - powers workers_busy across processes.
+        # Allocated at slot CAPACITY, not current count: RawArrays cannot
+        # grow, and resize_workers spawns into the spare slots.
+        self._heartbeats = self._ctx.RawArray("d", 3 * self._slot_capacity)
+        self._retire_flags = self._ctx.RawArray("b", self._slot_capacity)
+        # single writer per cell (the slot's worker; same torn-store caveat
+        # as the heartbeats); a respawn into the slot keeps accumulating
+        self._full_waits = self._ctx.RawArray("d", self._slot_capacity)
+        for i in range(self._slot_capacity):
             self._heartbeats[3 * i] = -1.0
             self._heartbeats[3 * i + 2] = -1.0
+        for i in range(self._workers_count):
             self._procs.append(self._spawn_worker(i))
 
     def _spawn_worker(self, index: int):
@@ -1177,10 +1610,76 @@ class _ProcessExecutor(ExecutorBase):
         p = self._ctx.Process(
             target=_process_worker_main,
             args=(self._worker_factory, self._in_queue, self._out_queue,
-                  self._stop_event, index, self._heartbeats),
+                  self._stop_event, index, self._heartbeats,
+                  self._retire_flags, self._full_waits),
             name=f"petastorm-tpu-worker-{index}", daemon=True)
         p.start()
         return p
+
+    @property
+    def max_resize_workers(self) -> int:
+        """Hard ceiling on ``resize_workers`` targets (shared-memory slot
+        capacity, fixed at construction)."""
+        return self._slot_capacity
+
+    def _promote_retirements(self) -> None:
+        """Move retiring slots whose worker ACKED the retire flag (wrote 2
+        at its item boundary, _process_worker_main) to retired.  The ack is
+        written before the process exits, so promotion does not depend on a
+        fault sweep happening to observe the death."""
+        with self._resize_lock:
+            for i in list(self._retiring):
+                if self._retire_flags[i] == 2:
+                    self._retiring.discard(i)
+                    self._retired.add(i)
+                    self._heartbeats[3 * i + 1] = time.time()
+                    self._heartbeats[3 * i] = -1.0
+                    self._heartbeats[3 * i + 2] = -1.0
+
+    def resize_workers(self, n: int) -> int:
+        """Grow or shrink the worker-process plane to ``n`` in place
+        (petastorm_tpu.autotune's worker knob).
+
+        Grow reuses cleanly-retired slots first (clearing their retire
+        flag), then spawns into spare pre-allocated slots, capped at
+        ``max_resize_workers``.  Shrink flags the highest-index live slots
+        to retire: each worker finishes and DELIVERS its current item, then
+        exits at the item boundary, so the per-ordinal ledger and epoch
+        accounting stay exact.  Returns the new target count (clamped to
+        the slot capacity).
+        """
+        n = max(1, min(int(n), self._slot_capacity))
+        with self._resize_lock:
+            self._target_managed = True
+            if not self._procs:  # not started: just update the target
+                self._workers_count = n
+                return n
+            self._promote_retirements()  # acked slots are reusable for grow
+            active = [i for i, p in enumerate(self._procs)
+                      if i not in self._retired and i not in self._retiring
+                      and p.is_alive()]
+            if len(active) < n:
+                for i in sorted(self._retired):
+                    if len(active) >= n:
+                        break
+                    self._retire_flags[i] = 0
+                    self._retired.discard(i)
+                    self._reaped.discard(i)
+                    self._heartbeats[3 * i + 1] = time.time()
+                    self._heartbeats[3 * i] = -1.0
+                    self._heartbeats[3 * i + 2] = -1.0
+                    self._procs[i] = self._spawn_worker(i)
+                    active.append(i)
+                while len(active) < n and len(self._procs) < self._slot_capacity:
+                    i = len(self._procs)
+                    self._procs.append(self._spawn_worker(i))
+                    active.append(i)
+            elif len(active) > n:
+                for i in sorted(active, reverse=True)[:len(active) - n]:
+                    self._retiring.add(i)
+                    self._retire_flags[i] = 1
+            self._workers_count = n
+            return n
 
     def put(self, item: Any, cancel_event=None) -> None:
         if self._stopped:
@@ -1255,8 +1754,28 @@ class _ProcessExecutor(ExecutorBase):
         self._flush_pending_requeues()
         if self._stopped or self._stop_event.is_set():
             return
+        self._promote_retirements()
         for i, p in enumerate(self._procs):
-            if p.is_alive() or i in self._reaped:
+            if p.is_alive() or i in self._reaped or i in self._retired:
+                continue
+            with self._resize_lock:
+                retiring = i in self._retiring
+                if retiring:
+                    # the flagged worker exited: a clean retirement unless
+                    # its heartbeat still names an in-flight item (it died
+                    # INSIDE fn while retiring - a genuine crash, requeue)
+                    self._retiring.discard(i)
+                    self._retired.add(i)
+                    hb_ordinal, _since = self._read_heartbeat(i)
+                    self._heartbeats[3 * i + 1] = time.time()
+                    self._heartbeats[3 * i] = -1.0
+                    self._heartbeats[3 * i + 2] = -1.0
+            if retiring:
+                if hb_ordinal >= 0:
+                    self._requeue_lost(
+                        int(hb_ordinal),
+                        f"worker process {i} death during retirement"
+                        f" (exit code {p.exitcode})")
                 continue
             self._reaped.add(i)
             ordinal = None
@@ -1279,6 +1798,19 @@ class _ProcessExecutor(ExecutorBase):
                 self._heartbeats[3 * i + 1] = time.time()
                 self._heartbeats[3 * i] = -1.0
                 self._heartbeats[3 * i + 2] = -1.0
+            if self._target_managed:
+                # target-managed plane (resize_workers was called): respawn
+                # the slot BEFORE the (possibly raising) requeue so the pool
+                # holds its target whether or not the item has budget left -
+                # but never overshoot it (this death may already be absorbed
+                # by a pending shrink that excluded the dead slot)
+                with self._resize_lock:
+                    active = [j for j, q in enumerate(self._procs)
+                              if j != i and j not in self._retired
+                              and j not in self._retiring and q.is_alive()]
+                    if len(active) < self._workers_count:
+                        self._reaped.discard(i)
+                        self._procs[i] = self._spawn_worker(i)
             self._requeue_lost(
                 ordinal, f"worker process {i} death (exit code {p.exitcode})")
         self._check_liveness()
@@ -1316,21 +1848,22 @@ class _ProcessExecutor(ExecutorBase):
         idle = False
         busy = []
         for i, p in enumerate(self._procs):
-            if not p.is_alive():
+            if not p.is_alive() or i in self._retired:
                 continue
             hb_ordinal, since = self._read_heartbeat(i)
             if hb_ordinal == -1.0:
-                idle = True
+                if i not in self._retiring:  # an exiting slot can't hedge
+                    idle = True
             else:
                 busy.append((i, p, hb_ordinal, max(0.0, now - since)))
         for i, p, hb_ordinal, elapsed in busy:
             ordinal = int(hb_ordinal) if hb_ordinal >= 0 else None
             if self._is_delivering(i):
                 # the worker finished its work function and is mid-enqueue:
-                # SIGKILLing now could orphan the out-queue's shared write
-                # lock (held by the queue's feeder thread) and deadlock
-                # every other worker's put forever.  The result is moments
-                # away; skip this sweep.  (The consumer only runs this sweep
+                # SIGKILLing now could orphan the results channel's shared
+                # write lock (held inside the worker's synchronous send)
+                # and deadlock every other worker's put forever.  The
+                # result is moments away; skip this sweep.  (The consumer only runs this sweep
                 # while starving, so the pipe is drained and the delivery
                 # window is short - not a loophole a truly hung worker can
                 # hide in: a hang wedges INSIDE fn, before the flag flips.)
@@ -1356,10 +1889,18 @@ class _ProcessExecutor(ExecutorBase):
                 self._heartbeats[3 * i + 2] = -1.0
                 self._hung_workers_killed += 1
                 self._m_hung_killed.add(1)
-                # replace BEFORE the (possibly raising) requeue: the pool
-                # must keep its worker count whether or not the item has
-                # budget left
-                self._procs[i] = self._spawn_worker(i)
+                with self._resize_lock:
+                    if i in self._retiring:
+                        # the hung worker was already flagged to retire:
+                        # killing it completes the retirement; do not
+                        # respawn the slot
+                        self._retiring.discard(i)
+                        self._retired.add(i)
+                    else:
+                        # replace BEFORE the (possibly raising) requeue: the
+                        # pool must keep its worker count whether or not the
+                        # item has budget left
+                        self._procs[i] = self._spawn_worker(i)
                 self._requeue_lost(
                     ordinal, f"hung worker process {i} SIGKILLed after"
                     f" exceeding item deadline {deadline:.1f}s",
@@ -1380,7 +1921,9 @@ class _ProcessExecutor(ExecutorBase):
                 self._service_faults()
                 if deadline is not None and time.monotonic() > deadline:
                     raise
-                if self._procs and not any(p.is_alive() for p in self._procs):
+                active = [p for i, p in enumerate(self._procs)
+                          if i not in self._retired]
+                if active and not any(p.is_alive() for p in active):
                     if self._stop_on_failure:
                         self.stop()
                     raise WorkerError("All worker processes died (possible crash/OOM);"
@@ -1408,7 +1951,22 @@ class _ProcessExecutor(ExecutorBase):
                     self._g_in_depth.set(self._in_queue.qsize())
                 except NotImplementedError:
                     pass
+                self._harvest_full_waits()
             return value
+
+    def _harvest_full_waits(self) -> None:
+        """Fold the workers' accumulated blocked-on-full-channel seconds
+        (shared ``_full_waits`` cells, written by ``_process_worker_main``'s
+        ``out_queue.put``) into ``queue.results_full_wait_s`` as deltas, so
+        the consumer-bound signal is visible to the sampler and the autotune
+        controller despite the waits happening in child processes."""
+        if self._full_waits is None:
+            return
+        total = sum(self._full_waits)
+        delta = total - self._full_wait_harvested
+        if delta > 0:
+            self._full_wait_harvested = total
+            self._m_results_full.add(delta)
 
     def stop(self) -> None:
         self._stopped = True
@@ -1417,12 +1975,16 @@ class _ProcessExecutor(ExecutorBase):
     def join(self) -> None:
         if not self._stopped:
             raise PetastormTpuError("call stop() before join()")
+        # close the results channel FIRST: a worker parked in a blocking
+        # send (consumer abandoned mid-epoch with a large frame in flight)
+        # gets EPIPE immediately and exits at its next stop_event check,
+        # instead of burning the full 5s join timeout per worker
+        self._out_queue.close()
         for p in self._procs:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
-        for q in (self._in_queue, self._out_queue):
-            q.cancel_join_thread()
+        self._in_queue.cancel_join_thread()
         if self._arena is not None:
             # consumer-side batches may still hold zero-copy views; close()
             # defers the unmap until they are collected
@@ -1430,8 +1992,13 @@ class _ProcessExecutor(ExecutorBase):
 
     @property
     def diagnostics(self) -> dict:
+        if self._retire_flags is not None:
+            self._promote_retirements()  # count acked shrinks sweep-free
         diag = {**super().diagnostics, "workers_count": self._workers_count,
-                "workers_alive": sum(p.is_alive() for p in self._procs),
+                "workers_alive": sum(p.is_alive()
+                                     for i, p in enumerate(self._procs)
+                                     if i not in self._retired),
+                "workers_retired": len(self._retired),
                 "shm_transport": self._arena is not None}
         try:  # mp.Queue.qsize raises NotImplementedError on some platforms
             diag["in_queue_size"] = self._in_queue.qsize()
@@ -1441,7 +2008,9 @@ class _ProcessExecutor(ExecutorBase):
         if self._heartbeats is not None:
             now = time.time()
             busy = []
-            for i in range(self._workers_count):
+            for i in range(len(self._procs)):
+                if i in self._retired:
+                    continue
                 # double-read-validated pair: a torn read can no longer pair
                 # a new ordinal with a stale timestamp (bogus stall)
                 ordinal, since = self._read_heartbeat(i)
@@ -1480,7 +2049,8 @@ def make_executor(kind: str = "thread", workers_count: int = 3,
                   max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
                   item_deadline_s: Optional[float] = None,
                   hedge_after_s=None,
-                  stall_warn_s: Optional[float] = None) -> ExecutorBase:
+                  stall_warn_s: Optional[float] = None,
+                  max_workers: Optional[int] = None) -> ExecutorBase:
     """'thread' | 'process' | 'serial' (reference: reader_pool_type, reader.py:139-150).
 
     ``stop_on_failure=False`` keeps the pool alive when a worker failure is
@@ -1492,6 +2062,9 @@ def make_executor(kind: str = "thread", workers_count: int = 3,
     ``stall_warn_s`` reaches the serial pool's per-item watchdog (the one
     flavor whose mid-item stalls the reader-side loop cannot observe);
     thread/process pools take their stall thresholds from the reader.
+    ``max_workers`` sizes the process pool's pre-allocated resize slot
+    capacity (``resize_workers`` / petastorm_tpu.autotune can grow the pool
+    up to it); thread pools grow without a pre-allocated ceiling.
     """
     if kind == "thread":
         return ThreadedExecutor(workers_count, results_queue_size,
@@ -1506,7 +2079,8 @@ def make_executor(kind: str = "thread", workers_count: int = 3,
                                 stop_on_failure=stop_on_failure,
                                 max_requeue_attempts=max_requeue_attempts,
                                 item_deadline_s=item_deadline_s,
-                                hedge_after_s=hedge_after_s)
+                                hedge_after_s=hedge_after_s,
+                                max_workers=max_workers)
     if kind in ("serial", "dummy"):
         return SerialExecutor(telemetry=telemetry,
                               stop_on_failure=stop_on_failure,

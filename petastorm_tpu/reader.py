@@ -104,6 +104,7 @@ def make_reader(dataset_url: str,
                 metrics_port: Optional[int] = None,
                 flight_record_path: Optional[str] = None,
                 sample_interval_s: Optional[float] = None,
+                autotune=None,
                 chaos=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -186,6 +187,18 @@ def make_reader(dataset_url: str,
     alone only TUNES the cadence of telemetry that is otherwise enabled
     (a process-wide interval export must not silently switch recording on).
 
+    ``autotune``: closed-loop pipeline autotuning (petastorm_tpu.autotune,
+    docs/operations.md "Autotuning").  ``True`` (or an ``AutotunePolicy``)
+    runs a background controller over the live metrics sampler that grows/
+    shrinks the worker pool, resizes the results-queue bound and - once a
+    ``JaxDataLoader`` wraps this reader - its prefetch depth, judging each
+    move by delivered samples/s and reverting regressions.
+    ``workers_count='auto'`` now implies it (static core-count seed +
+    runtime loop; pass ``autotune=False`` for the old static-only 'auto').
+    Auto-enables telemetry + the sampler; inoperative on the serial pool.
+    Every decision is visible as ``autotune.*`` counters/gauges, trace
+    events, and ``Reader.diagnostics['autotune']``.
+
     ``chaos``: deterministic fault injection for tests/benchmarks
     (``petastorm_tpu.test_util.chaos.ChaosSpec``); never set in production.
     """
@@ -207,7 +220,8 @@ def make_reader(dataset_url: str,
                              stall_abort_s=stall_abort_s,
                              metrics_port=metrics_port,
                              flight_record_path=flight_record_path,
-                             sample_interval_s=sample_interval_s)
+                             sample_interval_s=sample_interval_s,
+                             autotune=autotune)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -269,6 +283,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       metrics_port: Optional[int] = None,
                       flight_record_path: Optional[str] = None,
                       sample_interval_s: Optional[float] = None,
+                      autotune=None,
                       chaos=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
@@ -277,7 +292,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
     column arrays per decoded rowgroup.  ``io_retries``/``telemetry``/
     ``on_error``/``item_deadline_s``/``hedge_after_s``/``stall_warn_s``/
     ``stall_abort_s``/``metrics_port``/``flight_record_path``/
-    ``sample_interval_s``/``chaos``: see ``make_reader``.
+    ``sample_interval_s``/``autotune``/``chaos``: see ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -297,7 +312,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              stall_abort_s=stall_abort_s,
                              metrics_port=metrics_port,
                              flight_record_path=flight_record_path,
-                             sample_interval_s=sample_interval_s)
+                             sample_interval_s=sample_interval_s,
+                             autotune=autotune)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -318,8 +334,13 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       stall_abort_s: Optional[float] = None,
                       metrics_port: Optional[int] = None,
                       flight_record_path: Optional[str] = None,
-                      sample_interval_s: Optional[float] = None) -> "Reader":
+                      sample_interval_s: Optional[float] = None,
+                      autotune=None) -> "Reader":
+    from petastorm_tpu.autotune import resolve_autotune
+
     telemetry = _resolve_telemetry(telemetry)
+    autotune_policy = resolve_autotune(autotune, workers_count,
+                                       reader_pool_type)
     if not flight_record_path:
         flight_record_path = (
             os.environ.get("PETASTORM_TPU_FLIGHT_RECORD", "").strip() or None)
@@ -332,9 +353,11 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                 logger.warning("Ignoring non-integer"
                                " PETASTORM_TPU_METRICS_PORT=%r", raw_port)
     if (flight_record_path or metrics_port is not None
+            or autotune_policy is not None
             or (sample_interval_s is not None and sample_interval_s > 0)) \
             and not telemetry.enabled:
-        # the continuous-observability knobs need a live recorder; a private
+        # the continuous-observability knobs (and the autotune loop, which
+        # decides from the sampler's series) need a live recorder; a private
         # one keeps them usable without opting the whole process in
         from petastorm_tpu.telemetry import Telemetry
 
@@ -520,7 +543,10 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         hedge_after_s=hedge_after_s,
         # the serial pool's per-item watchdog is the only observer of a
         # mid-item stall there; it must honor the first-class kwarg too
-        stall_warn_s=stall_warn_s)
+        stall_warn_s=stall_warn_s,
+        # process pools pre-allocate resize slots up to the autotune ceiling
+        max_workers=(autotune_policy.max_workers
+                     if autotune_policy is not None else None))
     start_item = 0
     if resume_from is not None and "elastic" not in resume_from:
         if "elastic_rebased" in resume_from:
@@ -544,7 +570,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                     error_policy=error_policy, stall_warn_s=stall_warn_s,
                     stall_abort_s=stall_abort_s, metrics_port=metrics_port,
                     flight_record_path=flight_record_path,
-                    sample_interval_s=sample_interval_s)
+                    sample_interval_s=sample_interval_s,
+                    autotune_policy=autotune_policy)
     reader.circuit_breaker = circuit_breaker
     #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
     reader.device_decode_fields = device_fields
@@ -652,7 +679,8 @@ class Reader:
                  stall_abort_s: Optional[float] = None,
                  metrics_port: Optional[int] = None,
                  flight_record_path: Optional[str] = None,
-                 sample_interval_s: Optional[float] = None):
+                 sample_interval_s: Optional[float] = None,
+                 autotune_policy=None):
         #: petastorm_tpu.telemetry recorder shared by the whole pipeline
         #: (no-op unless enabled); ``reader.telemetry.pipeline_report()``
         #: renders the stage-utilization bottleneck summary
@@ -695,6 +723,20 @@ class Reader:
         #: shared storage circuit breaker (petastorm_tpu.retry), set by
         #: make_reader when io_retries arms one; None otherwise
         self.circuit_breaker = None
+        from petastorm_tpu.pool import SerialExecutor
+        if isinstance(executor, SerialExecutor) and self._stall_abort_s > 0:
+            # the serial pool runs work inline inside get(), so the
+            # reader-side stall loop (which only observes BETWEEN get calls)
+            # can never fire for a wedged transform there; the serial pool's
+            # own watchdog covers stall_warn_s, but abort has no observer
+            # (docs/operations.md "Liveness & stragglers")
+            logger.warning(
+                "stall_abort_s=%.0f is inoperative with"
+                " reader_pool_type='serial': work runs inline inside the"
+                " consumer's get(), so a wedged work item blocks the stall"
+                " loop itself (the serial watchdog still WARNS via"
+                " stall_warn_s). Use the thread or process pool when stall"
+                " abort matters.", self._stall_abort_s)
         self.last_row_consumed = False
         #: set by make_reader after construction (decode_placement='device')
         self.device_decode_fields: list = []
@@ -724,6 +766,10 @@ class Reader:
         #: localhost-only Prometheus endpoint (None unless ``metrics_port``);
         #: the bound port is ``reader.metrics_server.port``
         self.metrics_server = None
+        #: closed-loop autotune controller (petastorm_tpu.autotune; None
+        #: unless ``make_reader(autotune=...)`` / ``workers_count='auto'``
+        #: armed it); JaxDataLoader attaches its prefetch knob to it
+        self.autotune = None
         self._flight_record_path = flight_record_path
         self._flight_record: Optional[dict] = None
         self._final_snapshot: Optional[dict] = None
@@ -763,6 +809,21 @@ class Reader:
                                           telemetry=self.telemetry)
             self._expected_items = self._ventilator.total_items
             self._ventilator.start()
+            if autotune_policy is not None:
+                if self.sampler is None:
+                    # the controller decides from sampled series; without a
+                    # sampler it would be flying blind - refuse loudly
+                    logger.warning(
+                        "autotune is inert: sampling is disabled"
+                        " (sample_interval_s <= 0); the pipeline runs with"
+                        " its static knobs")
+                else:
+                    from petastorm_tpu.autotune import AutotuneController
+
+                    self.autotune = AutotuneController(
+                        self._executor, self.sampler, self.telemetry,
+                        policy=autotune_policy)
+                    self.autotune.start()
         except BaseException:
             # the reader never came to life (incl. a metrics-port bind
             # failure): release the observability layer - the sampler
@@ -1142,6 +1203,14 @@ class Reader:
         its counters just because nobody held the ``Telemetry`` object.
         """
         self._stopped = True
+        if self.autotune is not None:
+            # controller before executor: a tuning tick landing mid-close
+            # must not resize a stopped pool (a process-pool grow would
+            # spawn a worker nobody joins)
+            try:
+                self.autotune.stop()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.debug("autotune stop failed", exc_info=True)
         self._ventilator.stop()
         self._executor.stop()
         self._close_observability()
@@ -1153,6 +1222,13 @@ class Reader:
         if self._observability_closed:
             return
         self._observability_closed = True
+        if self.autotune is not None:
+            # controller before sampler: a tuning thread must not decide
+            # from a stopped sampler's stale series
+            try:
+                self.autotune.stop()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.debug("autotune stop failed", exc_info=True)
         if self.sampler is not None:
             try:  # flush the trailing partial interval into the series
                 self.sampler.sample_now()
@@ -1210,6 +1286,9 @@ class Reader:
                 "quarantined_rowgroups": list(self._quarantine[-20:])}
         if self.circuit_breaker is not None:
             diag["circuit_breaker"] = self.circuit_breaker.snapshot()
+        if self.autotune is not None:
+            # knob values + bounded decision log (what the tuner did and why)
+            diag["autotune"] = self.autotune.diagnostics
         if self._flight_record is not None:
             # the sampled series + trace tail leading into a terminal failure
             diag["flight_recorder"] = self._flight_record

@@ -122,6 +122,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="on a terminal failure, dump the flight record"
                              " (sampled series + trace tail) to PATH as"
                              " JSONL")
+    parser.add_argument("--autotune", action="store_true",
+                        help="run the closed-loop knob tuner during the read"
+                             " (petastorm_tpu.autotune): workers /"
+                             " results-queue bound adapt to the live metrics"
+                             " sampler; the report lists every decision and"
+                             " --watch frames show the autotune.* counters")
     return parser
 
 
@@ -136,6 +142,7 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                   metrics_port: Optional[int] = None,
                   flight_record_path: Optional[str] = None,
                   sample_interval_s: Optional[float] = None,
+                  autotune=False,
                   on_reader=None) -> dict:
     """Read ``dataset_url`` with telemetry enabled; returns a result dict
     with ``rows``, ``batches``, ``snapshot``, ``report``,
@@ -166,7 +173,8 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                  hedge_after_s=hedge_after_s,
                  metrics_port=metrics_port,
                  flight_record_path=flight_record_path,
-                 sample_interval_s=sample_interval_s) as reader:
+                 sample_interval_s=sample_interval_s,
+                 autotune=autotune or None) as reader:
         if on_reader is not None:
             on_reader(reader)
 
@@ -228,12 +236,15 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
             "dominant_stage": dominant_stage(snapshot),
             "quarantined_rowgroups": quarantined,
             "liveness": liveness,
+            # knob values + decision log when --autotune tuned the run
+            "autotune": final_diag.get("autotune"),
             "metrics_port": bound_port,
             "telemetry": tele}
 
 
-#: watch-frame fault counters worth a line the moment they move
-_WATCH_FAULT_PREFIXES = ("errors.", "liveness.", "io.retries")
+#: watch-frame fault counters worth a line the moment they move (autotune
+#: moves ride along so a watched run shows the tuner acting live)
+_WATCH_FAULT_PREFIXES = ("errors.", "liveness.", "io.retries", "autotune.")
 
 #: short watch labels per queue-wait counter; the counter LIST itself comes
 #: from report._QUEUE_WAITS (one source of truth - a new queue-wait counter
@@ -349,6 +360,7 @@ def _watch(args, url: str, chaos) -> int:
                 metrics_port=args.metrics_port,
                 flight_record_path=args.flight_record,
                 sample_interval_s=args.interval,
+                autotune=args.autotune,
                 on_reader=lambda r: reader_box.update(reader=r))
         except BaseException as exc:  # noqa: BLE001 - reported on main thread
             box["error"] = exc
@@ -424,7 +436,26 @@ def _watch(args, url: str, chaos) -> int:
               f" read {result['rows']} rows")
         print(result["report"])
         print(render_liveness_verdict(result["liveness"]))
+        if result.get("autotune"):
+            print(render_autotune_verdict(result["autotune"]))
     return 0
+
+
+def render_autotune_verdict(autotune: dict) -> str:
+    """Compact summary of what the tuner did: final knob values plus the
+    per-decision trail (knob, move, rates, kept/reverted)."""
+    knobs = "  ".join(f"{k}={v}" for k, v in
+                      sorted(autotune.get("knobs", {}).items()))
+    lines = [f"autotune: {autotune.get('moves_applied', 0)} move(s),"
+             f" {autotune.get('moves_kept', 0)} kept,"
+             f" {autotune.get('moves_reverted', 0)} reverted;"
+             f" final knobs: {knobs or '(none)'}"]
+    for d in autotune.get("decisions", []):
+        rate = (f"{d['measured_rate']:.0f}/s"
+                if d.get("measured_rate") is not None else "?")
+        lines.append(f"  {d['action']} {d['knob']} {d['from']}->{d['to']}"
+                     f" ({d['reason']}): {d['outcome']} @ {rate}")
+    return "\n".join(lines)
 
 
 def render_liveness_verdict(liveness: dict) -> str:
@@ -501,7 +532,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                duration_s=args.duration,
                                metrics_port=args.metrics_port,
                                flight_record_path=args.flight_record,
-                               sample_interval_s=args.interval)
+                               sample_interval_s=args.interval,
+                               autotune=args.autotune)
         if args.trace_out:
             result["telemetry"].export_chrome_trace(args.trace_out)
         if args.json:
@@ -511,6 +543,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                               "quarantined_rowgroups":
                                   result["quarantined_rowgroups"],
                               "liveness": result["liveness"],
+                              "autotune": result["autotune"],
                               "snapshot": result["snapshot"]}))
         else:
             what = "synthetic dataset" if tmpdir else url
@@ -520,6 +553,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   + f" from {what}")
             print(result["report"])
             print(render_liveness_verdict(result["liveness"]))
+            if result.get("autotune"):
+                print(render_autotune_verdict(result["autotune"]))
             for entry in result["quarantined_rowgroups"]:
                 print(f"quarantined: {entry['path']}#{entry['row_group']}"
                       f" (work item {entry['ordinal']}, {entry['kind']}"

@@ -421,6 +421,60 @@ def test_chaos_e2e_poison_kill_and_weather(tmp_path, pool):
     assert state["position"] == 10 and state["ordinal_exact"]
 
 
+def test_crash_safe_results_channel_semantics():
+    """The process pool's results transport: bounded put/get with the
+    ``queue.Empty`` timeout contract, slot accounting across get, and a
+    closed channel turning sends into clean drops instead of hangs."""
+    import multiprocessing as mp
+    import queue as stdlib_queue
+
+    from petastorm_tpu.pool import _CrashSafeResultsChannel
+
+    ctx = mp.get_context("spawn")
+    stop = ctx.Event()
+    ch = _CrashSafeResultsChannel(ctx, bound=2)
+    assert ch.put("a", stop) and ch.put("b", stop)
+    assert ch.qsize() == 2
+    # full at bound: the writer parks on the slot semaphore until the
+    # consumer drains or stop is raised - here stop turns it into a drop
+    stop.set()
+    assert not ch.put("c", stop)
+    stop.clear()
+    assert ch.get(timeout=1) == "a"
+    assert ch.qsize() == 1
+    assert ch.get(timeout=1) == "b"
+    with pytest.raises(stdlib_queue.Empty):
+        ch.get(timeout=0.05)
+    ch.close()
+    assert not ch.put("d", stop)  # closed channel: dropped, not wedged
+
+
+def test_chaos_kill_storm_never_wedges_results_plane(tmp_path):
+    """Regression (pre-existing flaky hang, fixed by
+    ``_CrashSafeResultsChannel``): with mp.Queue results, a worker dying by
+    ``os._exit`` moments after buffering a result could be killed while its
+    queue FEEDER thread held the shared pipe write lock - the abandoned
+    lock then wedged every surviving worker's put and the epoch hung with
+    an idle, live worker plane (observed ~1-in-4 sessions under load).
+    Worker puts are now synchronous in the worker's only thread, so every
+    kill in this storm lands outside the write lock by construction; the
+    epoch must complete with exact accounting every time."""
+    url = _write(tmp_path)
+    chaos = ChaosSpec(kill_ordinals=(2, 5, 8))  # one dice roll per kill
+    t0 = time.monotonic()
+    # 4 workers: each kill permanently retires one (a never-resized pool
+    # keeps the degrade-then-raise contract), so one survivor remains to
+    # drain the requeues
+    with make_batch_reader(url, reader_pool_type="process", workers_count=4,
+                           shuffle_row_groups=False, chaos=chaos,
+                           on_error="skip") as r:
+        rows = [x for b in r.iter_batches() for x in b.columns["x"]]
+        diag = r.diagnostics
+    assert time.monotonic() - t0 < 90, "kill storm wedged the results plane"
+    assert sorted(rows) == list(range(N_ROWS))  # all requeues delivered
+    assert diag["requeued_items"] == 3
+
+
 def test_all_process_workers_die_surfaces_not_hangs(tmp_path):
     """Satellite: every process worker killed mid-read -> the consumer gets
     the WorkerError with the crash/OOM hint (pool "all died" path), never a

@@ -45,11 +45,19 @@ def lower_is_better(name: str) -> bool:
     return any(m in name for m in LOWER_IS_BETTER_MARKERS)
 
 
-def load_metrics(path: str) -> Dict[str, float]:
-    """Extract ``{metric: value}`` from a bench artifact (see module doc)."""
+def load_metrics(path: str, with_flags: bool = False):
+    """Extract ``{metric: value}`` from a bench artifact (see module doc).
+
+    ``with_flags=True`` returns ``(metrics, weather_flagged)`` where the
+    second element is the set of metric names the capture stamped
+    ``"weather": "degraded"`` (per-line, or via the summary line's
+    ``weather_degraded`` list) - device-path numbers taken while the
+    tunnel/runtime weather probe said the session was degraded.  The gate
+    SKIPS those (a degraded session measures the weather, not the code)."""
     with open(path) as f:
         text = f.read()
     lines = text.splitlines()
+    flagged = set()
     try:
         obj = json.loads(text)
     except ValueError:
@@ -58,10 +66,11 @@ def load_metrics(path: str) -> Dict[str, float]:
         if "tail" in obj:            # driver-captured BENCH_rNN.json
             lines = str(obj["tail"]).splitlines()
         elif "metric" not in obj:    # bare {name: value} map
-            return {str(k): float(v if not isinstance(v, (list, tuple))
-                                  else v[0])
-                    for k, v in obj.items()
-                    if isinstance(v, (int, float, list, tuple))}
+            metrics = {str(k): float(v if not isinstance(v, (list, tuple))
+                                     else v[0])
+                       for k, v in obj.items()
+                       if isinstance(v, (int, float, list, tuple))}
+            return (metrics, flagged) if with_flags else metrics
     metrics: Dict[str, float] = {}
     for line in lines:
         line = line.strip()
@@ -78,14 +87,17 @@ def load_metrics(path: str) -> Dict[str, float]:
                 if isinstance(value, (list, tuple)):
                     value = value[0]
                 metrics[str(name)] = float(value)
+            flagged.update(str(n) for n in entry.get("weather_degraded", []))
         elif "metric" in entry and isinstance(entry.get("value"),
                                               (int, float)):
             metrics[str(entry["metric"])] = float(entry["value"])
+            if entry.get("weather") == "degraded":
+                flagged.add(str(entry["metric"]))
     if not metrics:
         raise SystemExit(f"{path}: no bench metrics found (expected bench.py"
                          " JSON lines, a BENCH_rNN.json capture, or a bare"
                          " metric map)")
-    return metrics
+    return (metrics, flagged) if with_flags else metrics
 
 
 def compare(old: Dict[str, float], new: Dict[str, float]) -> List[Dict]:
@@ -135,19 +147,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print one JSON object instead of a table")
     args = parser.parse_args(argv)
 
-    old, new = load_metrics(args.old), load_metrics(args.new)
+    old, old_flags = load_metrics(args.old, with_flags=True)
+    new, new_flags = load_metrics(args.new, with_flags=True)
+    weather_flagged = old_flags | new_flags
     if args.metrics:
         old = {k: v for k, v in old.items() if k in args.metrics}
         new = {k: v for k, v in new.items() if k in args.metrics}
     rows = compare(old, new)
+    for r in rows:
+        if r["metric"] in weather_flagged:
+            r["weather"] = "degraded"
+    # weather-flagged metrics report but never gate: a capture taken while
+    # the tunnel/runtime weather probe said "degraded" measures the weather,
+    # not the code (VERDICT r5) - skipping beats a false regression alarm
     failures = [r for r in rows
                 if args.fail_threshold is not None
+                and r["metric"] not in weather_flagged
                 and r.get("regression_pct", 0.0) > args.fail_threshold]
+    skipped = [r for r in rows
+               if args.fail_threshold is not None
+               and r["metric"] in weather_flagged
+               and r.get("regression_pct", 0.0) > args.fail_threshold]
 
     if args.json:
         print(json.dumps({"rows": rows,
                           "fail_threshold": args.fail_threshold,
-                          "failures": [r["metric"] for r in failures]}))
+                          "failures": [r["metric"] for r in failures],
+                          "weather_skipped": [r["metric"] for r in skipped]}))
     else:
         width = max([len(r["metric"]) for r in rows] + [6])
         print(f"{'metric':<{width}} {'old':>14} {'new':>14} {'delta%':>8}")
@@ -157,6 +183,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             delta = r.get("delta_pct")
             delta_s = f"{delta:+7.1f}%" if delta is not None else "       -"
             note = " (lower is better)" if r["lower_is_better"] else ""
+            if r.get("weather"):
+                note += " [degraded weather - gate skipped]"
             flag = "  << REGRESSION" if r in failures else ""
             print(f"{r['metric']:<{width}} {old_s:>14} {new_s:>14}"
                   f" {delta_s}{note}{flag}")
@@ -164,7 +192,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"gate: {len(failures)} metric(s) regressed more than"
                   f" {args.fail_threshold:g}%"
                   + (f": {', '.join(r['metric'] for r in failures)}"
-                     if failures else ""))
+                     if failures else "")
+                  + (f"; {len(skipped)} weather-flagged metric(s) skipped:"
+                     f" {', '.join(r['metric'] for r in skipped)}"
+                     if skipped else ""))
     return 1 if failures else 0
 
 
