@@ -363,6 +363,39 @@ def _ensure_imagenet(tmp):
     return url
 
 
+def _multicore_decode_baseline(url):
+    """The HONEST decoder baseline (PAPERS.md: single-thread JPEG decoder
+    benchmarks mis-evaluate ML data loaders): a thread pool across every
+    usable core running cv2.imdecode + BGR->RGB over the SAME stored jpeg
+    bytes this config ingests - no framework, no IO (bytes pre-loaded), no
+    transfer.  Any loader number must be judged against THIS ceiling, not a
+    one-core decode loop; it is also a same-session anchor immune to host
+    drift."""
+    import concurrent.futures as cf
+
+    import cv2
+    import numpy as np
+    import pyarrow.dataset as pads
+
+    bufs = [c.as_py() for c in
+            pads.dataset(url, format="parquet").to_table(
+                columns=["image"]).column("image").combine_chunks()]
+    threads = os.cpu_count() or 1
+
+    def decode(buf):
+        img = cv2.imdecode(np.frombuffer(buf, np.uint8), cv2.IMREAD_COLOR)
+        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+
+    with cf.ThreadPoolExecutor(threads) as pool:
+        list(pool.map(decode, bufs))  # warmup (thread spawn, cv2 init)
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            list(pool.map(decode, bufs))
+            rates.append(len(bufs) / (time.perf_counter() - t0))
+    return _median(rates), threads
+
+
 def bench_imagenet(tmp):
     _require_device_runtime()
     from petastorm_tpu.jax import JaxDataLoader
@@ -376,8 +409,18 @@ def bench_imagenet(tmp):
     placement = ({"image": "device"} if native_image.available()
                  and jax.default_backend() != "cpu" else None)
 
+    baseline_rate, baseline_threads = _multicore_decode_baseline(url)
+    _emit("imagenet_decode_multicore_baseline_samples_per_sec", baseline_rate,
+          "samples/sec", R2["imagenet_ingest_samples_per_sec"],
+          note=f"thread-pooled cv2 decode of the SAME jpeg bytes across"
+               f" {baseline_threads} cores, no IO/framework/transfer - the"
+               " honest decode ceiling the ingest number is judged against"
+               " (replaces the single-threaded strawman; PAPERS.md)")
+
     # steady-state measurement: warm the pipeline (jit compile, file cache,
-    # queue fill), then time a fixed batch count mid-stream
+    # queue fill), then time a fixed batch count mid-stream.  decode_threads
+    # defaults to 'auto', so the single-worker reader decodes multi-core
+    # (the pipeline must be as multi-core as the baseline to compare fairly)
     with make_batch_reader(url, num_epochs=None, workers_count=1,
                            shuffle_row_groups=False,
                            decode_placement=placement) as r:
@@ -401,7 +444,10 @@ def bench_imagenet(tmp):
     return _emit("imagenet_ingest_samples_per_sec", rate, "samples/sec",
                  R2["imagenet_ingest_samples_per_sec"],
                  note=f"decode={'hybrid-device' if placement else 'host'};"
-                      " median-of-3 vs round-2 recorded max-of-3"
+                      " median-of-3 vs round-2 recorded max-of-3;"
+                      f" {100 * rate / baseline_rate:.0f}% of the"
+                      f" same-session {baseline_threads}-core decode"
+                      f" baseline ({baseline_rate:.0f}/s, drift-immune)"
                       + _ceiling_note(rate, url),
                  device_path=True)
 

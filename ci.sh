@@ -167,6 +167,56 @@ print(f"metrics endpoint smoke OK (port {port}, {len(body.splitlines())}"
       " final snapshot attached)")
 PY
 
+echo "== decode smoke (batched multi-core decode + io window under chaos) =="
+# a short image read with a hard worker kill must COMPLETE exactly (requeue),
+# take the batched native decode path (decode.batch_* series emitted), and
+# read each remote rowgroup in ONE ranged read (io.reads_per_rowgroup) -
+# the batch-fused decode contract of ISSUE 6.  The native lib was force-built
+# in step 1, so a silent cv2 fallback here is a CI failure, not a slow pass.
+JAX_PLATFORMS=cpu timeout -k 10 120 python - <<'PY'
+import tempfile
+import numpy as np
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.chaos import ChaosSpec
+from petastorm_tpu.test_util.latency_fs import latent_filesystem
+from petastorm_tpu.test_util.synthetic import synthetic_rgb_image
+
+tmp = tempfile.mkdtemp(prefix="petastorm_tpu_decode_smoke_")
+schema = Schema("DecodeSmoke", [
+    Field("label", np.int64, (), ScalarCodec()),
+    Field("image", np.uint8, (48, 48, 3), CompressedImageCodec("jpeg", quality=90)),
+])
+write_dataset(tmp, schema,
+              [{"label": i, "image": synthetic_rgb_image(i, 48, 48)}
+               for i in range(48)], row_group_size_rows=8)
+fs, _ = latent_filesystem(latency_s=0.0)  # remote-shaped fs: window path arms
+tele = Telemetry()
+chaos = ChaosSpec(kill_ordinals=(2,))
+with make_batch_reader(tmp, reader_pool_type="thread", workers_count=2,
+                       shuffle_row_groups=False, filesystem=fs, chaos=chaos,
+                       telemetry=tele) as reader:
+    labels = sorted(int(x) for b in reader.iter_batches()
+                    for x in b.columns["label"])
+    diag = reader.diagnostics
+assert labels == list(range(48)), len(labels)
+assert diag["requeued_items"] >= 1, diag
+assert diag["native"]["image_decode"], diag["native"]
+counters = tele.snapshot()["counters"]
+assert counters.get("decode.batch_calls", 0) >= 6, counters
+assert counters["decode.batch_images"] >= 48, counters
+assert counters["io.rowgroups_read"] >= 6, counters
+ratio = counters["io.read_calls"] / counters["io.rowgroups_read"]
+assert ratio <= 1.01, f"read amplification {ratio:.2f} reads/rowgroup"
+print("decode smoke OK"
+      f" ({int(counters['decode.batch_images'])} images via"
+      f" {int(counters['decode.batch_calls'])} batched native calls,"
+      f" {ratio:.2f} reads/rowgroup, kill requeued, {len(labels)} rows)")
+PY
+
 echo "== autotune smoke (closed-loop knob tuning during a chaos read) =="
 # a short worker-bound chaos read with autotune armed (fast-paced policy -
 # the production pacing is seconds-scale, see docs/operations.md

@@ -283,7 +283,7 @@ class AutotuneController:
         self._m_kept = telemetry.counter("autotune.moves_kept")
         self._m_reverted = telemetry.counter("autotune.moves_reverted")
         self._gauges = {}
-        for name in ("workers", "results_queue", "prefetch"):
+        for name in ("workers", "results_queue", "prefetch", "decode_split"):
             self._gauges[name] = telemetry.gauge(f"autotune.{name}")
         self._stamp_gauges()
 
@@ -307,6 +307,24 @@ class AutotuneController:
             get=lambda: int(loader.prefetch),
             set_=loader.set_prefetch,
             lo=p.min_prefetch, hi=p.max_prefetch)
+        self._stamp_gauges()
+
+    def attach_decode_split(self, get: Callable[[], int],
+                            set_: Callable[[int], int]) -> None:
+        """Register the live host<->device decode split as a knob (called by
+        make_reader when a ``decode_placement='auto'`` field exists).
+
+        Binary: 0 = full decode on host workers, 1 = entropy-only on host +
+        dequant/IDCT on the device.  A starved consumer (worker plane is the
+        bottleneck) pushes toward the device - each rowgroup then costs the
+        workers only the entropy half; a consumer-bound pipeline pulls the
+        work back onto the (idle) workers.  Judged and reverted on delivered
+        throughput exactly like every other knob; the
+        ``autotune.decode_split`` gauge rides the sampled frames, so flight
+        records and ``--watch`` carry the split trajectory.
+        """
+        self._knobs["decode_split"] = _Knob(
+            "decode_split", get=get, set_=set_, lo=0, hi=1)
         self._stamp_gauges()
 
     def _stamp_gauges(self) -> None:
@@ -486,15 +504,21 @@ class AutotuneController:
         p = self.policy
         if starved >= p.starved_threshold and starved >= blocked:
             reason = f"consumer starved {starved:.0%} of wall"
+            # decode_split last: widening the plane is the cheaper, reversible
+            # first move; shipping the decode to the device only gets tried
+            # once the structural knobs are blocked or at their bounds
             candidates = [("workers", +1, reason),
                           ("prefetch", +1, reason),
-                          ("results_queue", +1, reason)]
+                          ("results_queue", +1, reason),
+                          ("decode_split", +1, reason)]
         elif blocked >= p.blocked_threshold:
-            # the consumer can't keep up: free CPU for it (fewer workers)
-            # or let the workers run ahead (wider results bound)
+            # the consumer can't keep up: free CPU for it (fewer workers),
+            # let the workers run ahead (wider results bound), or pull the
+            # decode back onto the idle worker plane (split toward host)
             reason = f"workers blocked on full results {blocked:.0%} of wall"
             candidates = [("workers", -1, reason),
-                          ("results_queue", +1, reason)]
+                          ("results_queue", +1, reason),
+                          ("decode_split", -1, reason)]
         elif p.explore:
             # no queue-wait signal: probe around the current point - some
             # optima (GIL contention, memory pressure) never show up as

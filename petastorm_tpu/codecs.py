@@ -26,7 +26,9 @@ Design differences (TPU-first):
 
 from __future__ import annotations
 
+import contextlib
 import io
+import threading
 from abc import ABC, abstractmethod
 from typing import Any, Dict, Optional, Tuple, Type
 
@@ -58,6 +60,58 @@ def _decode_threads() -> int:
                 "Ignoring malformed PETASTORM_TPU_DECODE_THREADS=%r; using 1", raw)
             _DECODE_THREADS = 1
     return _DECODE_THREADS
+
+
+# -- per-call decode options (set by the worker plane, read by codecs) --------
+
+_DECODE_CTX = threading.local()
+
+
+class DecodeOptions:
+    """Options the rowgroup worker threads down to ``decode_column`` without
+    widening every codec's signature:
+
+    * ``nthreads`` - internal fan-out of the native batched decode (the
+      worker sizes it to its share of the host's cores; overrides the
+      ``PETASTORM_TPU_DECODE_THREADS`` default);
+    * ``roi`` - ``(crop_ys, crop_xs, crop_h, crop_w)`` partial decode for
+      image columns (``make_reader(decode_roi=...)``): only the kept window
+      is decoded (native path) or sliced (fallback path) - output rows are
+      ``(crop_h, crop_w[, C])``;
+    * ``batch_slots`` - allow allocating the decode output from the active
+      shm :class:`~petastorm_tpu.native.transport.SlotAllocator` so process
+      pools ship it with zero further copies (the worker enables this only
+      when no cache would retain the arena-backed array).
+    """
+
+    __slots__ = ("nthreads", "roi", "batch_slots")
+
+    def __init__(self, nthreads: Optional[int] = None,
+                 roi: Optional[Tuple] = None, batch_slots: bool = False):
+        self.nthreads = nthreads
+        self.roi = roi
+        self.batch_slots = batch_slots
+
+
+@contextlib.contextmanager
+def decode_options(nthreads: Optional[int] = None,
+                   roi: Optional[Tuple] = None, batch_slots: bool = False):
+    """Install :class:`DecodeOptions` for decode calls on this thread."""
+    prev = getattr(_DECODE_CTX, "opts", None)
+    _DECODE_CTX.opts = DecodeOptions(nthreads=nthreads, roi=roi,
+                                     batch_slots=batch_slots)
+    try:
+        yield
+    finally:
+        _DECODE_CTX.opts = prev
+
+
+def _current_opts() -> DecodeOptions:
+    opts = getattr(_DECODE_CTX, "opts", None)
+    return opts if opts is not None else _DEFAULT_OPTS
+
+
+_DEFAULT_OPTS = DecodeOptions()
 
 
 def register_codec(cls: Type["Codec"]) -> Type["Codec"]:
@@ -149,6 +203,27 @@ class Codec(ABC):
     def __repr__(self):
         params = {k: v for k, v in self.to_json().items() if k != "codec"}
         return f"{type(self).__name__}({', '.join(f'{k}={v!r}' for k, v in params.items())})"
+
+
+def _slice_roi(decoded: np.ndarray, roi: Tuple) -> np.ndarray:
+    """Fallback ROI: crop a fully-decoded stacked column to the ROI windows
+    (same result as the native partial decode, minus the savings)."""
+    ys, xs, crop_h, crop_w = roi
+    n = len(decoded)
+    ys = np.broadcast_to(np.asarray(ys, dtype=np.int64), (n,))
+    xs = np.broadcast_to(np.asarray(xs, dtype=np.int64), (n,))
+    if decoded.dtype == object:
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            # nullable image columns decode None cells through this branch;
+            # the null passes through uncropped rather than crashing
+            out[i] = (None if decoded[i] is None else np.ascontiguousarray(
+                decoded[i][ys[i]:ys[i] + crop_h, xs[i]:xs[i] + crop_w]))
+        return out
+    out = np.empty((n, crop_h, crop_w) + decoded.shape[3:], decoded.dtype)
+    for i in range(n):
+        out[i] = decoded[i, ys[i]:ys[i] + crop_h, xs[i]:xs[i] + crop_w]
+    return out
 
 
 def _stack_cells(field, cells) -> np.ndarray:
@@ -512,21 +587,55 @@ class CompressedImageCodec(Codec):
         return np.ascontiguousarray(img.astype(field.dtype, copy=False))
 
     def decode_column(self, field, column: pa.Array) -> np.ndarray:
-        # Hot path: batched native decode (libpng/libjpeg, GIL released) into a
-        # preallocated contiguous array - no per-cell Python at all.  Applies to
-        # fixed-shape uint8 images; everything else falls back to per-cell decode.
+        # Hot path: batched multi-core native decode (libpng/libjpeg, GIL
+        # released) into a preallocated contiguous array - no per-cell Python
+        # at all.  The output array comes from the active shm SlotAllocator
+        # when the worker armed one (process pools then ship the batch slot
+        # itself: decode-into-slot, zero further copies); with a decode ROI
+        # only the kept window is decoded.  Applies to fixed-shape uint8
+        # images; everything else falls back to per-cell decode.
+        opts = _current_opts()
+        roi = opts.roi
         if (field.is_fixed_shape and field.dtype == np.dtype("uint8")
                 and column.null_count == 0
                 and (len(field.shape) == 2
                      or (len(field.shape) == 3 and field.shape[2] in (1, 3)))):
             from petastorm_tpu.native import image as native_image
 
-            if native_image.available():
-                out = np.empty((len(column),) + field.shape, dtype=np.uint8)
+            if native_image.available_or_warn():
+                if roi is not None:
+                    ys, xs, crop_h, crop_w = roi
+                    shape = (len(column), crop_h, crop_w) + field.shape[2:]
+                    native_roi = (ys, xs)
+                    full_shape = field.shape[:2]
+                else:
+                    shape = (len(column),) + field.shape
+                    native_roi = None
+                    full_shape = None
+                out = self._alloc_output(shape, opts)
+                nthreads = (opts.nthreads if opts.nthreads is not None
+                            else _decode_threads())
                 if native_image.decode_column_native(column, out,
-                                                     nthreads=_decode_threads()):
+                                                     nthreads=nthreads,
+                                                     roi=native_roi,
+                                                     full_shape=full_shape):
                     return out
-        return super().decode_column(field, column)
+        decoded = super().decode_column(field, column)
+        if roi is not None:
+            decoded = _slice_roi(decoded, roi)
+        return decoded
+
+    @staticmethod
+    def _alloc_output(shape, opts: DecodeOptions) -> np.ndarray:
+        if opts.batch_slots:
+            from petastorm_tpu.native.transport import current_slot_allocator
+
+            allocator = current_slot_allocator()
+            if allocator is not None:
+                out = allocator.alloc(shape, np.uint8)
+                if out is not None:
+                    return out
+        return np.empty(shape, dtype=np.uint8)
 
     def raw_column(self, column: pa.Array) -> np.ndarray:
         """Undecoded streams as an object array of bytes (for on-device decode)."""

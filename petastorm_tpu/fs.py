@@ -24,10 +24,42 @@ fs_utils.py:42-196).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional, Sequence, Tuple, Union
 from urllib.parse import urlparse
 
 import pyarrow.fs as pafs
+
+#: serializes memory:// open+read pairs (the underlying MemoryFile has ONE
+#: process-global seek position; see _IsolatedOpenHandler)
+_ISOLATED_OPEN_LOCK = threading.Lock()
+
+
+class _IsolatedOpenHandler(pafs.FSSpecHandler):
+    """FSSpecHandler subclass giving each ``open_input_*`` an INDEPENDENT
+    stream (a BytesIO snapshot of the file), for fsspec filesystems whose
+    opens share one file object/seek position (the memory:// singleton).
+    Everything else behaves exactly like FSSpecHandler."""
+
+    def __init__(self, inner: "pafs.FSSpecHandler"):
+        super().__init__(inner.fs)
+
+    def _snapshot(self, path):
+        import io
+
+        import pyarrow as pa
+
+        with _ISOLATED_OPEN_LOCK:
+            f = self.fs.open(path, "rb")
+            f.seek(0)
+            data = f.read()
+        return pa.PythonFile(io.BytesIO(data), mode="r")
+
+    def open_input_file(self, path):
+        return self._snapshot(path)
+
+    def open_input_stream(self, path):
+        return self._snapshot(path)
 
 from petastorm_tpu.errors import PetastormTpuError
 
@@ -86,7 +118,17 @@ def get_filesystem_and_path(url: str,
         import fsspec
 
         fs = fsspec.filesystem(parsed.scheme, **(storage_options or {}))
-        return pafs.PyFileSystem(pafs.FSSpecHandler(fs)), parsed.netloc + parsed.path
+        handler = pafs.FSSpecHandler(fs)
+        if parsed.scheme == "memory":
+            # fsspec's memory filesystem hands EVERY concurrent open the
+            # same MemoryFile object - a shared seek position, so two pool
+            # workers reading one parquet file corrupt each other's reads
+            # (footer reads land mid-file: "magic bytes not found").  Real
+            # object stores open independent streams; give memory:// the
+            # same semantics by serving each open an independent BytesIO
+            # view of the bytes (test-sized data by definition).
+            handler = _IsolatedOpenHandler(handler)
+        return pafs.PyFileSystem(handler), parsed.netloc + parsed.path
     except Exception as fsspec_error:
         raise PetastormTpuError(
             f"Cannot resolve filesystem for {url!r}: pyarrow said"

@@ -51,13 +51,21 @@ from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.native.image import COEF_COLUMN_SEP as _COEF_SEP
 from petastorm_tpu.parallel.mesh import local_data_slice
 from petastorm_tpu.shuffle import (NoopShufflingBuffer, RandomShufflingBuffer,
-                                   iter_batched)
+                                   iter_batched, iter_batched_multi)
 from petastorm_tpu.telemetry import NULL_CONTEXT as _NULL_CONTEXT
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
 
 _QUEUE_POLL_S = 0.1
+#: default straggler-release threshold (straggler_release_s='auto' with a
+#: decorrelation floor): long enough that a healthy pipeline never trips it,
+#: short enough that one hung/slow rowgroup does not idle the device
+_DEFAULT_STRAGGLER_RELEASE_S = 2.0
+#: 'auto' transfer-commit probe: a readiness sync costing more than this per
+#: trivial op means the runtime charges a round trip per sync (tunneled
+#: runtimes: ~115 ms observed) - async chaining then pipelines strictly better
+_COMMIT_PROBE_THRESHOLD_S = 0.02
 
 
 class _Done:
@@ -67,6 +75,66 @@ class _Done:
 class _Error:
     def __init__(self, exc: BaseException):
         self.exc = exc
+
+
+class _TimedSource:
+    """Runs a prepared-batch generator on its own thread so the assembly
+    pump can poll it WITH A TIMEOUT (straggler release needs to notice "no
+    raw batch for T seconds" while the reader call is still blocked).
+
+    ``get(timeout)`` returns the next batch, raises ``queue.Empty`` on
+    timeout, ``StopIteration`` at end of stream, or re-raises the
+    generator's failure.  The thread honors the loader's stop event on both
+    ends of its bounded queue.
+    """
+
+    _DONE = object()
+
+    def __init__(self, gen, stop_event: threading.Event):
+        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._stop = stop_event
+        self._thread = threading.Thread(target=self._run, args=(gen,),
+                                        daemon=True,
+                                        name="petastorm-tpu-jax-fetch")
+        self._thread.start()
+
+    def _run(self, gen) -> None:
+        try:
+            for item in gen:
+                if self._stop.is_set():
+                    return
+                self._put(item)
+            self._put(self._DONE)
+        except BaseException as exc:  # noqa: BLE001 - forwarded to the pump
+            self._put(_Error(exc))
+
+    def _put(self, value) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(value, timeout=_QUEUE_POLL_S)
+                return
+            except queue.Full:
+                continue
+
+    def get(self, timeout: Optional[float]):
+        while True:
+            try:
+                value = self._q.get(
+                    timeout=timeout if timeout is not None else _QUEUE_POLL_S)
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration from None
+                if timeout is not None:
+                    raise
+                continue
+            if value is self._DONE:
+                raise StopIteration
+            if isinstance(value, _Error):
+                raise value.exc
+            return value
+
+    def join(self, timeout: float = 2.0) -> None:
+        self._thread.join(timeout=timeout)
 
 
 class JaxDataLoader:
@@ -97,6 +165,29 @@ class JaxDataLoader:
     the valid mask is ``(K, batch)``, and ``drain()``/``state_dict()`` count
     whole stacks.  Incompatible with ``device_shuffle_capacity`` and
     multi-bucket ``pad_shapes``.
+
+    ``straggler_release_s`` (MinatoLoader-style, default ``'auto'``): when
+    no raw batch arrives for this long while the shuffle buffer already
+    holds a full batch that only its decorrelation floor
+    (``min_after_retrieve``) is withholding, the floor is bypassed and the
+    batch emitted - one slow-decoding rowgroup stops gating batch assembly,
+    and its rows ride a later batch.  ``'auto'`` = 2 s whenever a floor
+    exists; ``None`` disables.  Counted in ``loader.straggler_releases``
+    telemetry and ``diagnostics['straggler_releases']``.
+
+    ``transfer_commit`` (default ``'auto'``): whether the transfer thread
+    blocks until each batch lands on device.  ``'auto'`` probes the
+    runtime's readiness-sync cost once and starts in ASYNC-CHAINED mode
+    (no per-batch commit) on runtimes that charge a network round trip per
+    sync (r05 measured ~220 ms per 4.8 MB commit on a tunneled runtime);
+    ``True``/``False`` pin it.  The adaptive mid-run disable stays armed as
+    the backstop in 'auto' and True modes.
+
+    Readers with ``decode_placement={'field': 'auto'}`` (the live
+    host<->device decode split) are handled transparently: pixel-form and
+    coefficient-form rowgroups assemble in separate buffers, so a split
+    flip never mixes wire forms within one delivered batch.  Incompatible
+    with ``stack_batches > 1``.
     """
 
     def __init__(self,
@@ -121,6 +212,8 @@ class JaxDataLoader:
                  device_shuffle_seed: Optional[int] = None,
                  valid_mask_field: Optional[str] = None,
                  stack_batches: int = 1,
+                 straggler_release_s: Union[None, float, str] = "auto",
+                 transfer_commit: Union[bool, str] = "auto",
                  telemetry=None):
         self._reader = reader
         #: pipeline telemetry (petastorm_tpu.telemetry): defaults to the
@@ -175,6 +268,42 @@ class JaxDataLoader:
         #: decoded per geometry bucket, padded to a static target
         self._mixed_decode = frozenset(
             getattr(reader, "device_decode_mixed", ()) or ())
+        #: subset under the LIVE host<->device decode split
+        #: (decode_placement='auto'): a raw batch carries EITHER the pixel
+        #: column or coefficient planes; assembly keeps the two forms in
+        #: separate buffers (iter_batched_multi) so a split flip never mixes
+        #: column sets within one delivered batch
+        self._split_decode = frozenset(
+            getattr(reader, "device_decode_split", ()) or ())
+        #: MinatoLoader-style straggler release: when no raw batch arrives
+        #: for this long while a full batch sits behind the shuffle buffer's
+        #: decorrelation floor, the floor is bypassed and the batch emitted
+        #: (the slow rowgroup's rows ride a later batch).  'auto' = 2 s when
+        #: a floor exists, else off; None disables.
+        if straggler_release_s == "auto":
+            self._straggler_s: Optional[float] = (
+                _DEFAULT_STRAGGLER_RELEASE_S
+                if shuffling_queue_capacity and (
+                    min_after_retrieve is None or min_after_retrieve > 0)
+                else None)
+        else:
+            self._straggler_s = (float(straggler_release_s)
+                                 if straggler_release_s else None)
+        self._m_straggler = self._telemetry.counter(
+            "loader.straggler_releases")
+        #: transfer-commit policy (see _commit): 'auto' probes the runtime's
+        #: readiness-sync cost once and starts with async-chained transfers
+        #: (no per-batch commit) when a sync costs a network round trip -
+        #: r05 measured ~220 ms per 4.8 MB commit on the tunneled runtime;
+        #: True/False pin it (True keeps the adaptive breach backstop)
+        # identity, not equality: `0 in (True, False, 'auto')` is True via
+        # 0 == False, but the `is False` check below would then keep commits
+        # ON for transfer_commit=0 - the opposite of what was asked
+        if not any(transfer_commit is v for v in (True, False, "auto")):
+            raise PetastormTpuError(
+                f"transfer_commit must be True, False or 'auto';"
+                f" got {transfer_commit!r}")
+        self._commit_mode = transfer_commit
         #: geometries seen per mixed field (diagnostics; tests assert the
         #: decode compile count stays bounded by this set's size)
         self._mixed_geometries: Dict[str, set] = {}
@@ -243,6 +372,14 @@ class JaxDataLoader:
         #: analog of the reference's GPU-tensor BatchedDataLoader buffers,
         #: petastorm/pytorch_shuffling_buffer.py) - composes with the host
         #: shuffling buffer below, which mixes rows before batch assembly
+        if self._stack > 1 and self._split_decode:
+            raise PetastormTpuError(
+                f"stack_batches={self._stack} cannot be combined with the"
+                f" live decode split (decode_placement='auto' fields"
+                f" {sorted(self._split_decode)}): the K stacked batches could"
+                " straddle a split flip and mix wire forms. Pin the split"
+                " with decode_placement='host'/'device' for scan-feed"
+                " delivery.")
         if self._stack > 1:
             bucketed = [n for n, b in self._pad_shapes.items() if len(b) > 1]
             if bucketed:
@@ -312,6 +449,9 @@ class JaxDataLoader:
         #: (the live device-idle signal; see also the throughput CLI's
         #: --simulated-step-ms for an offline measurement)
         self._consumer_wait_s = 0.0
+        #: batches emitted past the shuffle decorrelation floor because the
+        #: source straggled (see straggler_release_s)
+        self._straggler_releases = 0
         #: when set, a jax.profiler trace (device + host ingest activity,
         #: viewable in TensorBoard/Perfetto) brackets the loader's lifetime
         self._trace_dir = trace_dir
@@ -319,8 +459,13 @@ class JaxDataLoader:
         #: producer has queued its _Done/_Error end-of-stream marker
         self._sentinel_pending = False
         #: adaptive transfer commit (see _commit): flips False permanently
-        #: when the runtime's readiness sync is pathologically expensive
-        self._commit_transfers = True
+        #: when the runtime's readiness sync is pathologically expensive;
+        #: transfer_commit='auto' additionally probes the sync cost up front
+        #: (async-chained transfer is then the DEFAULT on round-trip
+        #: runtimes, not a mid-run discovery), False starts disabled
+        self._commit_transfers = self._commit_mode is not False
+        self._commit_probed = self._commit_mode != "auto"
+        self._commit_probe_ms: Optional[float] = None
         self._commit_count = 0       # commits observed (first is warmup)
         self._commit_breaches = 0    # CONSECUTIVE over-threshold commits
         #: per-(field, trailing-shape) cache of (sharding, local slice) - static
@@ -516,6 +661,11 @@ class JaxDataLoader:
         cols: Dict[str, np.ndarray] = {}
         for name in self._fields + self._host_fields:
             if name in self._device_decode:
+                if name in self._split_decode and name in batch.columns:
+                    # live split, HOST form: the worker shipped decoded
+                    # pixels under the plain name - deliver like any field
+                    cols[name] = batch.columns[name]
+                    continue
                 # the worker shipped the field as derived coefficient-plane
                 # columns ('<name>#...'); pass them through batch assembly
                 for key, col in batch.columns.items():
@@ -535,8 +685,46 @@ class JaxDataLoader:
             return self._pad_values.get(name, 0)
         return self._pad_values
 
+    def _form_route(self, batch: ColumnBatch) -> tuple:
+        """Assembly-partition key: which live-split fields arrived in HOST
+        (pixel) form.  Constant () without split fields; around a split flip
+        the two forms land in separate buffers and never concatenate."""
+        if not self._split_decode:
+            return ()
+        return tuple(n for n in sorted(self._split_decode)
+                     if n in batch.columns)
+
+    def _on_straggler_release(self) -> None:
+        self._straggler_releases += 1
+        self._m_straggler.add(1)
+        if self._straggler_releases == 1:
+            # loud the first time: on a UNIFORMLY slow source (cold remote
+            # reads slower than the threshold) every fetch gap releases, so
+            # the decorrelation floor is effectively bypassed for the run -
+            # a shuffle-quality tradeoff the operator must be able to see
+            logger.warning(
+                "straggler release: emitted a buffered batch past the"
+                " shuffle decorrelation floor (no raw batch for %.1fs)."
+                " Occasional releases are the point (a slow rowgroup must"
+                " not gate assembly); FREQUENT ones mean the source is"
+                " uniformly slower than straggler_release_s and the"
+                " min_after_retrieve floor is being bypassed - raise the"
+                " threshold or fix the source (watch"
+                " loader.straggler_releases)", self._straggler_s)
+        else:
+            logger.debug("straggler release #%d (no raw batch for %.1fs)",
+                         self._straggler_releases, self._straggler_s)
+
     def _assemble(self) -> None:
-        """Stage 1: reader batches -> host-assembled local batches."""
+        """Stage 1: reader batches -> host-assembled local batches.
+
+        Plain readers pump through :func:`iter_batched` exactly as before.
+        Two features route through :func:`iter_batched_multi` instead: the
+        live decode split (per-form assembly buffers) and straggler release
+        (a fetch thread polls the reader with a timeout so a slow-decoding
+        rowgroup stops gating emission of already-buffered full batches).
+        """
+        fetcher = None
         try:
             local_bs = self._local_rows
             tele = self._telemetry
@@ -554,7 +742,21 @@ class JaxDataLoader:
                         out = self._prepare(raw)
                     yield out
 
-            for out in iter_batched(prepared(), self._make_buffer(), local_bs):
+            if self._split_decode or self._straggler_s is not None:
+                if self._straggler_s is not None:
+                    fetcher = _TimedSource(prepared(), self._stop_event)
+                    next_fn = fetcher.get
+                else:
+                    gen = prepared()
+                    next_fn = lambda _timeout: next(gen)  # noqa: E731
+                batches = iter_batched_multi(
+                    next_fn, self._form_route, self._make_buffer, local_bs,
+                    straggler_release_s=self._straggler_s,
+                    on_straggler_release=self._on_straggler_release)
+            else:
+                batches = iter_batched(prepared(), self._make_buffer(),
+                                       local_bs)
+            for out in batches:
                 if self._stop_event.is_set():
                     break
                 if out.num_rows < local_bs and self._drop_last:
@@ -563,6 +765,9 @@ class JaxDataLoader:
             self._host_push(_Done())
         except BaseException as exc:  # noqa: BLE001 - forwarded downstream
             self._host_push(_Error(exc))
+        finally:
+            if fetcher is not None:
+                fetcher.join()
 
     def _transfer(self) -> None:
         """Stage 2: host batches -> device dispatch -> consumer queue.
@@ -653,9 +858,14 @@ class JaxDataLoader:
         runtime valid-mask collision (the schema collision is caught at
         construction; a transform can still mint the name), and zero-pad
         partial rows to ``pad_to`` (a mesh's static local batch / a stack's
-        static per-step shape).  Returns ``(cols, valid_rows)``."""
+        static per-step shape).  Returns ``(cols, valid_rows)``.
+
+        A live-split field (decode_placement='auto') in HOST form is present
+        under its plain name and stages like any pixel field; in device form
+        its coefficient planes are handled by the device-decode path."""
         cols = {n: host_batch.columns[n] for n in self._fields
-                if n not in self._device_decode}
+                if n not in self._device_decode
+                or (n in self._split_decode and n in host_batch.columns)}
         if self._transform_fn is not None:
             cols = self._transform_fn(cols)
             if self._valid_mask is not None and self._valid_mask in cols:
@@ -688,7 +898,11 @@ class JaxDataLoader:
         with transfer_stage:
             device_batch = {}
             for name in self._device_decode:
-                if name in self._fields:
+                if name in self._fields and not (
+                        name in self._split_decode
+                        and name in host_batch.columns):
+                    # (a live-split field in host form is already in `cols`
+                    # as pixels and stages below like any other field)
                     decode = (self._decode_mixed_on_device
                               if name in self._mixed_decode
                               else self._decode_on_device)
@@ -814,15 +1028,24 @@ class JaxDataLoader:
         queues behind the next batch's dispatch (serialized device RPC
         channels would otherwise surface that contention as input stall).
 
-        ADAPTIVE: some tunneled/proxy runtimes charge a full network round
-        trip per readiness sync (~115 ms observed on this build's tunnel in
-        degraded weather - 30x a normal dispatch), which would cap delivery
-        at ~9 batches/s.  When a commit costs far more than the data volume
-        can explain, committing is permanently disabled for this loader:
-        async dispatch chains device-side, so consumers pay waits only at
-        genuine use points, which pipelines strictly better on such
-        runtimes.  Correctness is unaffected either way.
+        DEFAULT (transfer_commit='auto'): the readiness-sync cost is probed
+        ONCE before the first commit - one warm trivial op timed three times
+        - and when a sync alone costs a network round trip (r05 measured
+        ~220 ms per 4.8 MB commit; the probe threshold is 20 ms for a
+        nanosecond-scale op), async-chained transfer becomes the default
+        from batch 1 instead of a mid-run discovery after two breaches.
+
+        ADAPTIVE backstop: some tunneled/proxy runtimes degrade mid-session
+        (~115 ms per sync observed on this build's tunnel in degraded
+        weather - 30x a normal dispatch), which would cap delivery at ~9
+        batches/s.  When a commit costs far more than the data volume can
+        explain, committing is permanently disabled for this loader: async
+        dispatch chains device-side, so consumers pay waits only at genuine
+        use points, which pipelines strictly better on such runtimes.
+        Correctness is unaffected either way.
         """
+        if not self._commit_probed:
+            self._probe_commit_cost()
         if not self._commit_transfers:
             return
         t0 = time.perf_counter()
@@ -851,6 +1074,34 @@ class JaxDataLoader:
                     " over)", took * 1e3, nbytes / 1e6)
         else:
             self._commit_breaches = 0
+
+    def _probe_commit_cost(self) -> None:
+        """transfer_commit='auto': measure a trivial readiness sync (min of
+        3 after one warmup) in the transfer thread, before the first batch
+        commits.  A runtime charging a round trip per sync starts in
+        async-chained mode immediately; the per-batch adaptive breach logic
+        stays armed either way as the backstop."""
+        self._commit_probed = True
+        try:
+            jax.block_until_ready(jax.device_put(1.0))  # warmup/backend init
+            costs = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(jax.device_put(1.0))
+                costs.append(time.perf_counter() - t0)
+            cost = min(costs)
+        except Exception:  # noqa: BLE001 - a probe failure must not break ingest
+            logger.debug("transfer-commit probe failed; keeping commits on",
+                         exc_info=True)
+            return
+        self._commit_probe_ms = cost * 1e3
+        if cost > _COMMIT_PROBE_THRESHOLD_S:
+            self._commit_transfers = False
+            logger.info(
+                "readiness sync costs %.0f ms for a trivial op - this runtime"
+                " charges a round trip per sync; defaulting to async-chained"
+                " transfer (no per-batch commit). transfer_commit=True"
+                " overrides.", cost * 1e3)
 
     def _decode_stack(self, name: str, group) -> jax.Array:
         """Stack-mode variant of ``_decode_on_device``: the K batches'
@@ -1224,6 +1475,12 @@ class JaxDataLoader:
                "host_queue_depth": self._host_q.qsize(),
                "delivered_batches": self._delivered_batches,
                "consumer_wait_s": self._consumer_wait_s,
+               # batches released past the shuffle floor because the source
+               # straggled (straggler_release_s), and the transfer-commit
+               # verdict (False = async-chained; probe cost when measured)
+               "straggler_releases": self._straggler_releases,
+               "transfer_commit": self._commit_transfers,
+               "transfer_commit_probe_ms": self._commit_probe_ms,
                "finished": self._finished,
                # producer threads that missed the stop() join budget (each
                # {thread, stage}); non-empty = the shutdown was not clean

@@ -14,11 +14,62 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import threading
 from typing import Optional
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+#: per-THREAD decode statistics (monotonic).  The worker plane folds deltas
+#: into telemetry counters (``decode.batch_*``) after each rowgroup, so
+#: callers here stay telemetry-free.  Thread-local: a pool worker folding
+#: the delta around its own decode must not absorb a sibling thread's
+#: concurrent increments (that double-counts the shared registry).
+_STATS_TLS = threading.local()
+_STAT_KEYS = ("batch_calls", "batch_images", "roi_calls", "roi_images",
+              "coef_batch_calls", "coef_batch_images")
+
+
+def _tls_stats() -> dict:
+    stats = getattr(_STATS_TLS, "stats", None)
+    if stats is None:
+        stats = _STATS_TLS.stats = {k: 0 for k in _STAT_KEYS}
+    return stats
+
+
+def _count(**deltas) -> None:
+    stats = _tls_stats()
+    for name, d in deltas.items():
+        stats[name] += d
+
+
+def decode_stats() -> dict:
+    """Snapshot of THIS thread's cumulative native-decode counters."""
+    return dict(_tls_stats())
+
+
+_warned_unavailable = False
+
+#: the one-command build this module falls back from when missing
+BUILD_COMMAND = ("python -c \"from petastorm_tpu.native import build;"
+                 " print(build.build('image_decode'))\"")
+
+
+def _warn_unavailable() -> None:
+    """One-time WARNING when a decode hot path falls back to per-cell
+    cv2/PIL because the native library is absent - previously a silent
+    ~N-times-slower degradation."""
+    global _warned_unavailable
+    if _warned_unavailable:
+        return
+    _warned_unavailable = True
+    logger.warning(
+        "native image decode library is unavailable - image columns fall"
+        " back to the per-cell cv2/PIL decode path (GIL-bound, several"
+        " times slower on image-heavy reads). Build it once with: %s",
+        BUILD_COMMAND)
+
 
 def _configure(lib: ctypes.CDLL) -> None:
     lib.pst_decode_image_batch.restype = ctypes.c_int
@@ -35,6 +86,19 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.pst_decode_image.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.pst_decode_image_batch_roi.restype = ctypes.c_int
+    lib.pst_decode_image_batch_roi.argtypes = [
+        ctypes.c_void_p,  # srcs
+        ctypes.c_void_p,  # lens
+        ctypes.c_int,     # n
+        ctypes.c_void_p,  # out
+        ctypes.c_uint64,  # stride
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # full h, w, c
+        ctypes.c_void_p,  # crop_ys (int32)
+        ctypes.c_void_p,  # crop_xs (int32)
+        ctypes.c_int, ctypes.c_int,  # crop_h, crop_w
+        ctypes.c_int,     # nthreads
     ]
     lib.pst_jpeg_coef_layout.restype = ctypes.c_int
     lib.pst_jpeg_coef_layout.argtypes = [
@@ -67,6 +131,17 @@ def available() -> bool:
     return _load() is not None
 
 
+def available_or_warn() -> bool:
+    """Like :func:`available`, but a miss emits the one-time fallback WARNING
+    naming the build command - for decode hot paths, where silence hid a
+    several-times-slower degradation (use plain ``available()`` in
+    validation/capability checks)."""
+    if _load() is not None:
+        return True
+    _warn_unavailable()
+    return False
+
+
 def _column_pointers(column) -> Optional[tuple]:
     """(ptrs uint64 array, lens uint64 array) for a binary arrow array, zero-copy."""
     import pyarrow as pa
@@ -92,15 +167,28 @@ def _column_pointers(column) -> Optional[tuple]:
     return ptrs, lens
 
 
-def decode_column_native(column, out: np.ndarray, nthreads: int = 1) -> bool:
+def decode_column_native(column, out: np.ndarray, nthreads: int = 1,
+                         roi: Optional[tuple] = None,
+                         full_shape: Optional[tuple] = None) -> bool:
     """Decode a binary arrow column of PNG/JPEG streams into ``out``.
 
     ``out`` must be contiguous uint8 of shape (n, h, w, c) or (n, h, w).
+    ``nthreads > 1`` fans the batch out over the library's internal thread
+    pool (the whole call releases the GIL either way).
+
+    ROI (partial) decode: with ``roi=(crop_ys, crop_xs)`` (per-image int
+    offsets, scalars broadcast) and ``full_shape=(H, W)`` (the stored image
+    geometry), each image decodes only the ``out``-shaped window anchored at
+    its offset - rows below the crop are never entropy-decoded, and the
+    result is byte-identical to slicing a full decode (crops need not be
+    8x8-block aligned).
+
     Returns False (without touching ``out``'s validity) when the native path
     doesn't apply; raises on an actual decode failure.
     """
     lib = _load()
     if lib is None:
+        _warn_unavailable()
         return False
     if out.dtype != np.uint8 or not out.flags.c_contiguous:
         return False
@@ -121,15 +209,32 @@ def decode_column_native(column, out: np.ndarray, nthreads: int = 1) -> bool:
         return False
     if n == 0:
         return True
-    rc = lib.pst_decode_image_batch(
-        ptrs.ctypes.data, lens.ctypes.data, n,
-        out.ctypes.data, np.uint64(out.strides[0]), h, w, c, nthreads)
+    if roi is not None:
+        full_h, full_w = full_shape
+        ys = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(roi[0], dtype=np.int32), (n,)))
+        xs = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(roi[1], dtype=np.int32), (n,)))
+        rc = lib.pst_decode_image_batch_roi(
+            ptrs.ctypes.data, lens.ctypes.data, n,
+            out.ctypes.data, np.uint64(out.strides[0]), full_h, full_w, c,
+            ys.ctypes.data, xs.ctypes.data, h, w, nthreads)
+        if rc == 0:
+            _count(roi_calls=1, roi_images=n)
+    else:
+        rc = lib.pst_decode_image_batch(
+            ptrs.ctypes.data, lens.ctypes.data, n,
+            out.ctypes.data, np.uint64(out.strides[0]), h, w, c, nthreads)
+        if rc == 0:
+            _count(batch_calls=1, batch_images=n)
     if rc != 0:
         from petastorm_tpu.errors import CodecError
 
         raise CodecError(
             f"native image decode failed at cell {rc - 1} (expected shape "
-            f"({h}, {w}, {c}) uint8; corrupt stream or shape mismatch)")
+            f"({h}, {w}, {c}) uint8"
+            + (f" cropped from {full_shape}" if roi is not None else "")
+            + "; corrupt stream, crop outside image, or shape mismatch)")
     return True
 
 
@@ -421,4 +526,5 @@ def read_jpeg_coefficients_column(column, nthreads: int = 1):
         raise CodecError(
             f"JPEG coefficient batch failed at cell {rc - 1} (corrupt stream"
             f" or geometry differs from {layout})")
+    _count(coef_batch_calls=1, coef_batch_images=n)
     return planes, qtabs, layout

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -39,11 +40,25 @@ def _align(n: int) -> int:
 
 @dataclasses.dataclass
 class ShmBatchRef:
-    """Queue-picklable descriptor of a batch whose raw columns live in shm."""
-    offset: int
+    """Queue-picklable descriptor of a batch whose raw columns live in shm.
+
+    Two kinds of shm-resident columns:
+
+    * ``("shm", ...)`` entries live packed inside ONE block at ``offset``
+      (producer copied them in, ``encode_batch``);
+    * ``("slot", dtype, shape, offset, nbytes)`` entries were decoded
+      DIRECTLY into their own arena block by the worker (batch-slot decode,
+      :class:`SlotAllocator`) - no producer-side copy ever happened.  Each
+      slot block gets its own consumer-side lease and is freed independently.
+
+    ``offset`` is None when every shm column is a slot (nothing was packed).
+    """
+    offset: Optional[int]
     total_bytes: int
     num_rows: int
-    #: name -> ("shm", dtype_str, shape, rel_offset) | ("inline", ndarray/list)
+    #: name -> ("shm", dtype_str, shape, rel_offset)
+    #:       | ("slot", dtype_str, shape, abs_offset, nbytes)
+    #:       | ("inline", ndarray/list)
     columns: Dict[str, Tuple]
     #: ventilation ordinal carried across the shm hop so the Reader's
     #: exact-contiguous-prefix resume cursor survives the process-pool
@@ -72,23 +87,151 @@ class _Lease:
             pass
 
 
+# -- batch-slot decode: codec output allocated straight in the arena ---------
+
+_SLOT_TLS = threading.local()
+
+
+def current_slot_allocator() -> Optional["SlotAllocator"]:
+    """The :class:`SlotAllocator` active on this thread (set by the process
+    pool's shm encoder around the worker function), or None.  Codecs that can
+    decode into a caller-provided buffer use it to place their output
+    DIRECTLY in a shared-memory batch slot, eliminating the decode->arena
+    copy hop that ``encode_batch`` otherwise pays per batch."""
+    return getattr(_SLOT_TLS, "allocator", None)
+
+
+class SlotAllocator:
+    """Arena-backed output allocator for decode-into-batch-slot.
+
+    Lifecycle (all on the worker's single thread):
+
+    1. the shm encoder installs one allocator per work item;
+    2. a codec asks :meth:`alloc` for its batch-shaped output array - the
+       array is a writable numpy view over a fresh arena block (None when the
+       arena is full or the size is unreasonable: the codec then np.empty's
+       and the normal copy path applies, so this is an optimization, never a
+       correctness dependency);
+    3. ``encode_batch`` CLAIMS columns whose array identity matches a live
+       slot - they ship as ("slot", ...) refs with zero further copies;
+    4. :meth:`finalize` frees every unclaimed slot (transform replaced the
+       array, encode fell back to queue pickling) - after detaching any
+       unclaimed slot array still referenced by an outgoing fallback batch,
+       because a freed block can be reallocated by another worker while the
+       queue is still pickling the stale view.
+    """
+
+    def __init__(self, arena: SharedArena):
+        self._arena = arena
+        #: offset -> (nbytes, array); strong refs keep identity valid
+        self._slots: Dict[int, Tuple[int, np.ndarray]] = {}
+        self._claimed: set = set()
+
+    def alloc(self, shape: Tuple[int, ...], dtype) -> Optional[np.ndarray]:
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes <= 0 or nbytes > self._arena.size // 2:
+            return None
+        offset = self._arena.alloc(_align(nbytes))
+        if offset is None:
+            return None  # arena full right now: caller uses plain memory
+        count = nbytes // dtype.itemsize
+        arr = np.frombuffer(self._arena.view(offset, nbytes), dtype=dtype,
+                            count=count).reshape(shape)
+        self._slots[offset] = (nbytes, arr)
+        return arr
+
+    def claim(self, col: np.ndarray) -> Optional[Tuple[int, int]]:
+        """(offset, nbytes) when ``col`` IS a live slot array (identity, not
+        equality), marking it shipped - its block is then freed by the
+        consumer's lease, not by :meth:`finalize`."""
+        for offset, (nbytes, arr) in self._slots.items():
+            if arr is col and offset not in self._claimed:
+                self._claimed.add(offset)
+                return offset, nbytes
+        return None
+
+    def rollback_claims(self) -> None:
+        """Un-claim everything (an encode that claimed slots then fell back
+        to queue pickling ships no block refs - finalize must reclaim)."""
+        self._claimed.clear()
+
+    def finalize(self, result: Any) -> Any:
+        """Free unclaimed slots; detach anything in a fallback ``result``
+        that still ALIASES one (identity or a view - ``np.shares_memory``)
+        by replacing it with an in-process copy first, because a freed block
+        can be reallocated by another worker while the queue is still
+        pickling the stale view.  Returns the (possibly rewritten) result.
+        Idempotent."""
+        unclaimed = [(off, arr) for off, (_, arr) in self._slots.items()
+                     if off not in self._claimed]
+        if unclaimed and isinstance(result, ColumnBatch):
+            hit = {}
+            for name, col in result.columns.items():
+                if (isinstance(col, np.ndarray) and col.dtype != object
+                        and any(np.shares_memory(col, arr)
+                                for _, arr in unclaimed)):
+                    hit[name] = col.copy()
+            if hit:
+                result = dataclasses.replace(
+                    result, columns={**result.columns, **hit})
+        for offset, _arr in unclaimed:
+            try:
+                self._arena.free(offset)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                logger.debug("slot free failed", exc_info=True)
+        self._slots = {}
+        return result
+
+
+class _slot_scope:
+    """Context manager installing a :class:`SlotAllocator` on this thread."""
+
+    def __init__(self, allocator: Optional[SlotAllocator]):
+        self._allocator = allocator
+
+    def __enter__(self):
+        self._prev = getattr(_SLOT_TLS, "allocator", None)
+        _SLOT_TLS.allocator = self._allocator
+        return self._allocator
+
+    def __exit__(self, *exc):
+        _SLOT_TLS.allocator = self._prev
+
+
 def encode_batch(arena: SharedArena, batch: Any,
-                 stop_check=None, max_wait_s: float = 10.0) -> Any:
+                 stop_check=None, max_wait_s: float = 10.0,
+                 slots: Optional[SlotAllocator] = None) -> Any:
     """Encode a batch for the queue; raw columns go through the arena.
 
-    Returns a ShmBatchRef, or the original value when it is not a ColumnBatch
-    or nothing can use shm (the fallback keeps behavior identical, just
-    slower).  Blocks while the arena is full, up to ``max_wait_s`` (then falls
-    back to queue pickling so a stalled consumer can never deadlock workers);
-    ``stop_check()`` (optional) aborts the wait early.
+    Columns the worker already decoded INTO arena slots (``slots``,
+    :class:`SlotAllocator`) are claimed in place - zero copies; everything
+    else raw is packed into one freshly-allocated block (one copy, as
+    before).  Returns a ShmBatchRef, or the original value when it is not a
+    ColumnBatch or nothing can use shm (the fallback keeps behavior
+    identical, just slower).  Blocks while the arena is full, up to
+    ``max_wait_s`` (then falls back to queue pickling so a stalled consumer
+    can never deadlock workers); ``stop_check()`` (optional) aborts the wait
+    early.  Fallback returns never reference live slots - the caller's
+    ``slots.finalize`` detaches them.
     """
     if not isinstance(batch, ColumnBatch):
         return batch
     shm_cols = {}
     meta: Dict[str, Tuple] = {}
     total = 0
+    n_slots = 0
     for name, col in batch.columns.items():
         if isinstance(col, np.ndarray) and col.dtype != object and col.nbytes > 0:
+            if slots is not None:
+                claimed = slots.claim(col)
+                if claimed is not None:
+                    # decoded straight into its own arena block by the worker
+                    # (batch-slot decode): ship the block, copy nothing
+                    meta[name] = ("slot", str(col.dtype), col.shape,
+                                  claimed[0], claimed[1])
+                    n_slots += 1
+                    continue
             # np.copyto below handles strided sources directly - no
             # ascontiguousarray (that would be a second full copy)
             meta[name] = ("shm", str(col.dtype), col.shape, total)
@@ -96,45 +239,58 @@ def encode_batch(arena: SharedArena, batch: Any,
             total += _align(col.nbytes)
         else:
             meta[name] = ("inline", col)
-    if not shm_cols:
+    def _fallback(value):
+        # no block refs ship: any claims made in the scan above must be
+        # released so finalize reclaims (and detaches) those slots
+        if slots is not None:
+            slots.rollback_claims()
+        return value
+
+    if not shm_cols and not n_slots:
         return batch
     if total > arena.size // 2:
         # a single batch this large would serialize the whole pipeline behind
         # one block; ship it the slow way instead of deadlocking the arena
         logger.warning("batch of %d bytes exceeds half the shm arena (%d);"
                        " falling back to queue pickling", total, arena.size)
-        return batch
+        return _fallback(batch)
 
-    offset = arena.alloc(total)
-    deadline = time.monotonic() + max_wait_s
-    while offset is None:
-        if stop_check is not None and stop_check():
-            return batch
-        if time.monotonic() > deadline:
-            logger.warning("shm arena full for %.0fs; shipping batch via queue"
-                           " pickling", max_wait_s)
-            return batch
-        time.sleep(_ALLOC_RETRY_S)
+    offset = None
+    if shm_cols:
         offset = arena.alloc(total)
+        deadline = time.monotonic() + max_wait_s
+        while offset is None:
+            if stop_check is not None and stop_check():
+                return _fallback(batch)
+            if time.monotonic() > deadline:
+                logger.warning("shm arena full for %.0fs; shipping batch via"
+                               " queue pickling", max_wait_s)
+                return _fallback(batch)
+            time.sleep(_ALLOC_RETRY_S)
+            offset = arena.alloc(total)
 
-    view = arena.view(offset, total)
-    for name, col in shm_cols.items():
-        _, _, _, rel = meta[name]
-        dst = np.frombuffer(view, dtype=col.dtype, count=col.size,
-                            offset=rel).reshape(col.shape)
-        np.copyto(dst, col)
-    del dst, view  # drop buffer exports so a later arena.close() can unmap
+        view = arena.view(offset, total)
+        for name, col in shm_cols.items():
+            _, _, _, rel = meta[name]
+            dst = np.frombuffer(view, dtype=col.dtype, count=col.size,
+                                offset=rel).reshape(col.shape)
+            np.copyto(dst, col)
+        del dst, view  # drop buffer exports so a later arena.close() can unmap
     return ShmBatchRef(offset=offset, total_bytes=total, num_rows=batch.num_rows,
                        columns=meta, ordinal=batch.ordinal)
 
 
 def decode_batch(arena: SharedArena, ref: Any) -> Any:
     """Rebuild a ColumnBatch; shm columns are zero-copy views into the arena.
-    Non-ShmBatchRef values (fallback batches, arbitrary worker results) pass
-    through unchanged."""
+
+    Packed columns share the main block's lease; slot columns (decoded in
+    place by the worker) each own their block's lease - every block is freed
+    when the last array over it dies.  Non-ShmBatchRef values (fallback
+    batches, arbitrary worker results) pass through unchanged."""
     if not isinstance(ref, ShmBatchRef):
         return ref
-    lease = _Lease(arena, ref.offset, ref.total_bytes)
+    lease = (_Lease(arena, ref.offset, ref.total_bytes)
+             if ref.offset is not None else None)
     cols: Dict[str, np.ndarray] = {}
     for name, entry in ref.columns.items():
         if entry[0] == "shm":
@@ -143,14 +299,36 @@ def decode_batch(arena: SharedArena, ref: Any) -> Any:
             count = int(np.prod(shape, dtype=np.int64)) if shape else 1
             cols[name] = np.frombuffer(lease, dtype=dtype, count=count,
                                        offset=rel).reshape(shape)
+        elif entry[0] == "slot":
+            _, dtype_str, shape, abs_off, nbytes = entry
+            dtype = np.dtype(dtype_str)
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            slot_lease = _Lease(arena, abs_off, nbytes)
+            cols[name] = np.frombuffer(slot_lease, dtype=dtype,
+                                       count=count).reshape(shape)
         else:
             cols[name] = entry[1]
     return ColumnBatch(cols, ref.num_rows, ordinal=ref.ordinal)
 
 
+def slot_column_count(ref: Any) -> int:
+    """Number of ("slot", ...) columns in an encoded batch ref (0 for
+    anything else) - the parent-side observability hook for the zero-copy
+    decode path (``decode.batch_slots`` counter)."""
+    if not isinstance(ref, ShmBatchRef):
+        return 0
+    return sum(1 for entry in ref.columns.values() if entry[0] == "slot")
+
+
 class _ShmEncodingFn:
     """The worker's process function; ``stop_event`` is bound by the worker
-    main loop so a shutdown aborts any wait on a full arena immediately."""
+    main loop so a shutdown aborts any wait on a full arena immediately.
+
+    Installs a fresh :class:`SlotAllocator` per item so codecs under the
+    worker function can decode straight into arena batch slots
+    (``current_slot_allocator``); ``encode_batch`` then claims those columns
+    copy-free and ``finalize`` reclaims whatever went unused.
+    """
 
     def __init__(self, fn, arena: SharedArena):
         self._fn = fn
@@ -161,8 +339,18 @@ class _ShmEncodingFn:
         return self.stop_event is not None and self.stop_event.is_set()
 
     def __call__(self, item):
-        return encode_batch(self._arena, self._fn(item),
-                            stop_check=self._stopped)
+        allocator = SlotAllocator(self._arena)
+        try:
+            with _slot_scope(allocator):
+                result = self._fn(item)
+            out = encode_batch(self._arena, result, stop_check=self._stopped,
+                               slots=allocator)
+            return allocator.finalize(out)
+        except BaseException:
+            # the work function failed after possibly allocating slots: free
+            # them, or every failed item leaks arena space until close
+            allocator.finalize(None)
+            raise
 
 
 class ShmResultEncoder:

@@ -1936,8 +1936,16 @@ class _ProcessExecutor(ExecutorBase):
                               if isinstance(result, _Ok) else (None, result))
             settled = self._settle(ordinal)
             if self._arena is not None:
-                from petastorm_tpu.native.transport import decode_batch
+                from petastorm_tpu.native.transport import (decode_batch,
+                                                            slot_column_count)
 
+                if self._telemetry.enabled:
+                    # parent-side proof of the zero-copy decode path: columns
+                    # the worker decoded DIRECTLY into arena batch slots
+                    # (child-process counters never reach this registry)
+                    slots = slot_column_count(value)
+                    if slots:
+                        self._telemetry.counter("decode.batch_slots").add(slots)
                 # decode duplicates too: the encoded descriptor pins arena
                 # slots that only the decoded view's lifetime releases
                 value = decode_batch(self._arena, value)

@@ -93,6 +93,8 @@ def make_reader(dataset_url: str,
                 resume_from: Optional[dict] = None,
                 verify_checksums: bool = False,
                 decode_placement: Optional[Dict[str, str]] = None,
+                decode_threads: Union[int, str] = "auto",
+                decode_roi: Optional[Dict[str, tuple]] = None,
                 ngram=None,
                 io_retries="auto",
                 telemetry=None,
@@ -120,6 +122,31 @@ def make_reader(dataset_url: str,
     the dataset (one XLA compile); ``'device-mixed'`` supports mixed
     geometries/subsamplings via per-geometry bucketed decode (compiles
     bounded by the number of distinct geometries; single-device loaders).
+
+    ``decode_placement={'field': 'auto'}`` makes the host<->device split a
+    LIVE knob (docs/operations.md "Decode tuning"): workers consult a shared
+    cell per rowgroup and ship either full host-decoded pixels or
+    entropy-only coefficient planes; ``Reader.set_decode_split()`` moves it,
+    and an armed autotune controller drives it from the queue-wait signals
+    (the ``autotune.decode_split`` gauge carries the trajectory).  'auto'
+    otherwise validates exactly like 'device' and also requires the
+    JaxDataLoader.
+
+    ``decode_threads``: internal fan-out of the native batched image decode
+    inside EACH worker (the batch splits across a C++ thread pool with the
+    GIL released).  ``'auto'`` (default) sizes it to this host's usable
+    cores divided by the worker count, so a single-worker reader still
+    decodes multi-core; an int pins it (1 restores the old per-worker
+    single-thread decode).
+
+    ``decode_roi``: partial image decode for augment-crop pipelines - decode
+    only the pixels the crop keeps.  ``{'image': (y, x, h, w)}`` decodes a
+    fixed window, ``('center', h, w)`` centers it, ``('random', h, w)``
+    draws per-image offsets (deterministic per rowgroup, so requeue/resume
+    re-reads decode identical crops).  Rows below the crop are never
+    entropy-decoded; the delivered column (and the reader's output schema)
+    has shape ``(h, w[, C])``.  Output is byte-identical to slicing a full
+    decode.
 
     ``io_retries``: transient remote-IO policy (petastorm_tpu.retry).
     ``'auto'`` = bounded retry-with-backoff on remote filesystems (GCS/S3/
@@ -212,6 +239,8 @@ def make_reader(dataset_url: str,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
+                             decode_threads=decode_threads,
+                             decode_roi=decode_roi,
                              io_retries=io_retries, telemetry=telemetry,
                              on_error=on_error, chaos=chaos,
                              item_deadline_s=item_deadline_s,
@@ -272,6 +301,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       resume_from: Optional[dict] = None,
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
+                      decode_threads: Union[int, str] = "auto",
+                      decode_roi: Optional[Dict[str, tuple]] = None,
                       ngram=None,
                       io_retries="auto",
                       telemetry=None,
@@ -304,6 +335,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
+                             decode_threads=decode_threads,
+                             decode_roi=decode_roi,
                              io_retries=io_retries, telemetry=telemetry,
                              on_error=on_error, chaos=chaos,
                              item_deadline_s=item_deadline_s,
@@ -326,6 +359,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       resume_from: Optional[dict] = None, ngram=None,
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
+                      decode_threads="auto",
+                      decode_roi: Optional[Dict[str, tuple]] = None,
                       io_retries="auto", telemetry=None,
                       on_error="raise", chaos=None,
                       item_deadline_s: Optional[float] = None,
@@ -421,6 +456,12 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
 
     full_schema = infer_or_load_schema(info)
     view = full_schema.view(schema_fields) if schema_fields is not None else full_schema
+    if decode_roi:
+        _validate_decode_roi(decode_roi, full_schema,
+                             [f.name for f in view], decode_placement, ngram)
+        # the delivered columns are crop-shaped; the WORKER keeps the full
+        # schema (it needs the stored geometry to place the crops)
+        view = _apply_roi_schema(view, decode_roi)
     output_schema = (transform_schema(view, transform_spec)
                      if transform_spec is not None else view)
     ngram_schema = None
@@ -496,9 +537,21 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     fs_factory = FilesystemFactory(dataset_url if isinstance(dataset_url, str)
                                    else dataset_url[0], storage_options,
                                    filesystem=filesystem)
-    device_fields, mixed_fields = _validate_decode_placement(
+    device_fields, mixed_fields, split_fields = _validate_decode_placement(
         decode_placement, full_schema, read_fields, transform_spec,
         ngram, worker_predicate)
+    decode_split_cell = None
+    if split_fields:
+        # the live host<->device decode split: one shared int cell every
+        # worker consults per rowgroup (0 = host pixels, 1 = device planes).
+        # A spawn-context RawValue crosses the process-pool boundary through
+        # Process args (same mechanism as the heartbeat arrays); thread and
+        # serial pools just share the object.  Starts on the device side -
+        # the hybrid split is the measured win when a chip is present - and
+        # the autotune loop (or set_decode_split) moves it from there.
+        import multiprocessing as _mp
+
+        decode_split_cell = _mp.get_context("spawn").Value("i", 1, lock=False)
     from petastorm_tpu.retry import make_circuit_breaker, resolve_retry_policy
 
     retry_policy = resolve_retry_policy(io_retries, info.filesystem)
@@ -507,6 +560,25 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     # a storage outage fails fast with CircuitOpenError instead of every
     # worker independently burning its full retry budget
     circuit_breaker = make_circuit_breaker(retry_policy)
+    try:
+        # usable cores (cgroup/affinity-aware), shared by both 'auto'
+        # resolutions below
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    if workers_count == "auto":
+        # resolved here (it used to happen just before make_executor) so
+        # decode_threads='auto' below can size against the real pool width:
+        # one core left for the consumer, capped at the reference's default
+        # pool size of 10
+        workers_count = max(1, min(10, cores - 1))
+    if decode_threads == "auto":
+        # each worker's share of the usable cores: a 1-worker reader decodes
+        # with every core, a saturated pool keeps 1 thread per worker (the
+        # pool is then the parallelism) - multi-core decode end to end either
+        # way (PAPERS.md: single-threaded decode baselines mis-evaluate
+        # loaders; so would a single-threaded decode plane)
+        decode_threads = max(1, cores // max(1, int(workers_count)))
     worker = RowGroupDecoderWorker(fs_factory, full_schema, read_fields,
                                    predicate=worker_predicate,
                                    transform=transform_spec, cache=cache,
@@ -516,20 +588,16 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    mixed_raw_fields=mixed_fields,
                                    retry_policy=retry_policy,
                                    circuit_breaker=circuit_breaker,
-                                   telemetry=telemetry)
+                                   telemetry=telemetry,
+                                   decode_threads=int(decode_threads),
+                                   decode_roi=decode_roi,
+                                   split_fields=split_fields,
+                                   decode_split=decode_split_cell)
     if chaos is not None and chaos.affects_worker():
         from petastorm_tpu.test_util.chaos import ChaosWorker
 
         worker = ChaosWorker(worker, chaos)
 
-    if workers_count == "auto":
-        # size to the usable cores (cgroup/affinity-aware), one left for the
-        # consumer, capped at the reference's default pool size of 10
-        try:
-            cores = len(os.sched_getaffinity(0))
-        except AttributeError:
-            cores = os.cpu_count() or 1
-        workers_count = max(1, min(10, cores - 1))
     executor = make_executor(
         reader_pool_type, workers_count, results_queue_size,
         telemetry=telemetry,
@@ -577,12 +645,107 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     reader.device_decode_fields = device_fields
     #: subset using the mixed-geometry object wire format ('device-mixed')
     reader.device_decode_mixed = mixed_fields
+    #: subset under the LIVE host<->device split (decode_placement='auto'):
+    #: their batches carry EITHER pixels or coefficient planes, per the
+    #: split cell's value when the rowgroup decoded
+    reader.device_decode_split = split_fields
+    reader._decode_split_cell = decode_split_cell
+    if decode_split_cell is not None and reader.autotune is not None:
+        # the split becomes a live autotune knob: starved consumers push
+        # decode work off the host (toward device), consumer-bound pipelines
+        # pull it back; decisions ride autotune.* counters and the
+        # autotune.decode_split gauge (flight-recorder knob trajectory)
+        reader.autotune.attach_decode_split(
+            get=lambda: int(decode_split_cell.value),
+            set_=reader.set_decode_split)
     return reader
+
+
+_ROI_MODES = ("center", "random")
+
+
+def _normalize_roi_spec(name: str, spec) -> tuple:
+    """Validate/normalize one decode_roi entry; returns the spec tuple."""
+    spec = tuple(spec)
+    if len(spec) == 3 and spec[0] in _ROI_MODES:
+        mode, h, w = spec
+        if not (isinstance(h, int) and isinstance(w, int) and h > 0 and w > 0):
+            raise PetastormTpuError(
+                f"decode_roi[{name!r}]: ({mode!r}, h, w) needs positive int"
+                f" crop dims; got {spec}")
+        return spec
+    if len(spec) == 4 and all(isinstance(v, int) for v in spec):
+        y, x, h, w = spec
+        if y < 0 or x < 0 or h < 1 or w < 1:
+            raise PetastormTpuError(
+                f"decode_roi[{name!r}]: (y, x, h, w) needs y, x >= 0 and"
+                f" h, w >= 1; got {spec}")
+        return spec
+    raise PetastormTpuError(
+        f"decode_roi[{name!r}] must be (y, x, h, w), ('center', h, w) or"
+        f" ('random', h, w); got {spec!r}")
+
+
+def _validate_decode_roi(decode_roi, schema, read_fields, decode_placement,
+                         ngram) -> None:
+    from petastorm_tpu.codecs import CompressedImageCodec
+
+    if ngram is not None:
+        raise PetastormTpuError("decode_roi is not supported with ngram"
+                                " readers")
+    for name, spec in decode_roi.items():
+        spec = _normalize_roi_spec(name, spec)
+        if name not in schema:
+            raise PetastormTpuError(f"decode_roi field {name!r} not in schema"
+                                    f" {[f.name for f in schema]}")
+        if name not in read_fields:
+            raise PetastormTpuError(
+                f"decode_roi field {name!r} is not being read (excluded by"
+                " schema_fields)")
+        if decode_placement and decode_placement.get(name, "host") != "host":
+            raise PetastormTpuError(
+                f"decode_roi field {name!r} cannot also use decode_placement="
+                f"{decode_placement[name]!r}: coefficient planes carry the"
+                " full image (crop on-device instead, ops/augment.py)")
+        field = schema[name]
+        if not (field.is_fixed_shape and field.dtype == np.dtype("uint8")
+                and isinstance(field.codec, CompressedImageCodec)
+                and len(field.shape) in (2, 3)):
+            raise PetastormTpuError(
+                f"decode_roi field {name!r} must be a fixed-shape uint8"
+                f" CompressedImageCodec image; got {field.codec!r} shape"
+                f" {field.shape} dtype {field.dtype}")
+        full_h, full_w = field.shape[:2]
+        crop_h, crop_w = (spec[1], spec[2]) if spec[0] in _ROI_MODES \
+            else (spec[2], spec[3])
+        y0 = 0 if spec[0] in _ROI_MODES else spec[0]
+        x0 = 0 if spec[0] in _ROI_MODES else spec[1]
+        if y0 + crop_h > full_h or x0 + crop_w > full_w:
+            raise PetastormTpuError(
+                f"decode_roi[{name!r}] crop {spec} exceeds the stored image"
+                f" geometry ({full_h}, {full_w})")
+
+
+def _apply_roi_schema(schema: Schema, decode_roi) -> Schema:
+    """Crop-shaped view of ``schema``: decode_roi fields' leading (H, W)
+    become the crop dims (what the delivered columns actually are)."""
+    import dataclasses as _dc
+
+    fields = []
+    for f in schema:
+        spec = decode_roi.get(f.name)
+        if spec is not None:
+            crop_h, crop_w = (spec[1], spec[2]) if spec[0] in _ROI_MODES \
+                else (spec[2], spec[3])
+            f = _dc.replace(f, shape=(crop_h, crop_w) + tuple(f.shape[2:]))
+        fields.append(f)
+    return Schema(schema.name, fields)
 
 
 def _validate_decode_placement(decode_placement, schema, read_fields,
                                transform_spec, ngram, predicate=None) -> tuple:
-    """Check a decode_placement mapping; returns (device fields, mixed subset).
+    """Check a decode_placement mapping; returns (device fields, mixed
+    subset, live-split subset).
 
     Device placement = the pool worker runs only libjpeg's entropy decode and
     ships coefficient planes; the jax loader runs the FLOP-heavy rest
@@ -596,17 +759,18 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
     distinct geometries; see JaxDataLoader for the pad-target contract).
     """
     if not decode_placement:
-        return [], frozenset()
+        return [], frozenset(), frozenset()
     from petastorm_tpu.codecs import CompressedImageCodec
     from petastorm_tpu.native import image as native_image
 
     device_fields = []
     mixed_fields = set()
+    split_fields = set()
     for name, place in decode_placement.items():
-        if place not in ("host", "device", "device-mixed"):
+        if place not in ("host", "device", "device-mixed", "auto"):
             raise PetastormTpuError(
-                f"decode_placement[{name!r}] must be 'host', 'device' or"
-                f" 'device-mixed', got {place!r}")
+                f"decode_placement[{name!r}] must be 'host', 'device',"
+                f" 'device-mixed' or 'auto', got {place!r}")
         if name not in schema:
             raise PetastormTpuError(f"decode_placement field {name!r} not in"
                                     f" schema {[f.name for f in schema]}")
@@ -629,7 +793,7 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
                     codec, CompressedImageCodec) else "")
                 + ". PNG's deflate stream cannot be entropy-split for on-chip"
                 " decode - store images as jpeg for device decode.")
-        if place == "device" and not field.is_fixed_shape:
+        if place in ("device", "auto") and not field.is_fixed_shape:
             raise PetastormTpuError(
                 f"decode_placement='device' field {name!r} needs a fixed shape"
                 f" (got {field.shape}): XLA compiles per geometry. For"
@@ -661,7 +825,9 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
         device_fields.append(name)
         if place == "device-mixed":
             mixed_fields.add(name)
-    return device_fields, frozenset(mixed_fields)
+        elif place == "auto":
+            split_fields.add(name)
+    return device_fields, frozenset(mixed_fields), frozenset(split_fields)
 
 
 class Reader:
@@ -742,6 +908,10 @@ class Reader:
         self.device_decode_fields: list = []
         #: subset using the mixed-geometry wire format ('device-mixed')
         self.device_decode_mixed: frozenset = frozenset()
+        #: subset under the LIVE host<->device decode split ('auto')
+        self.device_decode_split: frozenset = frozenset()
+        #: shared split cell (set by make_reader when 'auto' fields exist)
+        self._decode_split_cell = None
 
         self._start_item = start_item
         self._consumed_items = 0
@@ -1215,6 +1385,48 @@ class Reader:
         self._executor.stop()
         self._close_observability()
 
+    # -- live host<->device decode split (decode_placement='auto') ------------
+
+    @property
+    def decode_split(self) -> Optional[str]:
+        """'host' | 'device' for the live-split fields, or None when no
+        field uses ``decode_placement='auto'``."""
+        if self._decode_split_cell is None:
+            return None
+        return "device" if int(self._decode_split_cell.value) else "host"
+
+    def set_decode_split(self, mode) -> int:
+        """Move the live host<->device decode split (docs/operations.md
+        "Decode tuning").
+
+        ``mode``: ``'host'``/``0`` = workers ship fully-decoded pixels
+        (libjpeg on host), ``'device'``/``1`` = workers ship entropy-decoded
+        coefficient planes and the JaxDataLoader runs dequant+IDCT on-chip.
+        Takes effect per ROWGROUP: rowgroups already decoded keep their form
+        (the loader assembles the two forms separately, so in-flight batches
+        stay correct).  This is the autotune controller's ``decode_split``
+        knob; safe to call directly while the reader runs.  Returns the new
+        value (0/1).
+        """
+        if self._decode_split_cell is None:
+            raise PetastormTpuError(
+                "set_decode_split needs a decode_placement='auto' field"
+                " (no live-split field on this reader)")
+        if mode in ("host", 0, False):
+            value = 0
+        elif mode in ("device", 1, True):
+            value = 1
+        else:
+            raise PetastormTpuError(
+                f"decode split mode must be 'host'/0 or 'device'/1,"
+                f" got {mode!r}")
+        self._decode_split_cell.value = value
+        if self.telemetry.enabled:
+            self.telemetry.gauge("decode.split").set(value)
+            self.telemetry.counter(
+                f"decode.split_to_{'device' if value else 'host'}").add(1)
+        return value
+
     def _close_observability(self) -> None:
         """Latch the final snapshot and stop the sampler + metrics endpoint;
         idempotent (every close path and the constructor-failure path funnel
@@ -1284,6 +1496,16 @@ class Reader:
                 # line into the full ledger (quarantined_rowgroups property
                 # has it all; the count above is always exact)
                 "quarantined_rowgroups": list(self._quarantine[-20:])}
+        # native-plane availability: a silent fallback to the slow per-cell
+        # decode (missing .so) must be visible here, not just in one log line
+        from petastorm_tpu.native import image as _native_image
+        from petastorm_tpu.native import is_available as _shm_available
+
+        diag["native"] = {"image_decode": _native_image.available(),
+                          "shm_arena": _shm_available(),
+                          "build_command": _native_image.BUILD_COMMAND}
+        if self._decode_split_cell is not None:
+            diag["decode_split"] = self.decode_split
         if self.circuit_breaker is not None:
             diag["circuit_breaker"] = self.circuit_breaker.snapshot()
         if self.autotune is not None:

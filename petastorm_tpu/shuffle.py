@@ -66,15 +66,106 @@ def iter_batched(source, buffer: "ShufflingBufferBase", batch_size: int):
                    if take < pending.num_rows else None)
 
 
+def iter_batched_multi(next_fn, route_fn, buffer_factory, batch_size: int,
+                       straggler_release_s=None, on_straggler_release=None):
+    """:func:`iter_batched` generalized two ways for the jax loader:
+
+    * **form partitioning** - ``route_fn(batch)`` keys each source batch into
+      its own shuffling buffer, and batches only ever assemble WITHIN a key.
+      The live host<->device decode split needs this: around a split flip,
+      pixel-form and coefficient-form rowgroups coexist in flight, and their
+      column sets must never concatenate.  A constant route is exactly
+      ``iter_batched``.
+    * **straggler release** (MinatoLoader-style, PAPERS.md) - ``next_fn`` is
+      called with ``straggler_release_s`` as a timeout; when the source times
+      out (raises ``queue.Empty``) while a buffer already holds a full batch
+      that only the shuffle decorrelation floor (``min_after_retrieve``) is
+      withholding, the floor is bypassed and the batch released.  A slow
+      rowgroup then stops gating batch assembly; its rows ride a later batch
+      when they arrive.  ``None`` disables (``next_fn`` is then called with
+      ``None`` = block).
+
+    ``next_fn(timeout)`` returns the next batch, raises ``StopIteration`` at
+    end of stream, or raises ``queue.Empty`` on timeout.  Buffer invariants
+    (bounded adds, floor-gated retrieval, tail drain after finish) match
+    :func:`iter_batched`.
+    """
+    import queue as _queue
+
+    states: dict = {}  # route key -> {"buffer": ..., "pending": ...}
+
+    def _state(key):
+        st = states.get(key)
+        if st is None:
+            st = states[key] = {"buffer": buffer_factory(), "pending": None}
+        return st
+
+    exhausted = False
+    while True:
+        progressed = True
+        while progressed:
+            progressed = False
+            for st in states.values():
+                buf = st["buffer"]
+                while buf.can_retrieve(batch_size):
+                    yield buf.retrieve(batch_size)
+                    progressed = True
+                pending = st["pending"]
+                if pending is None:
+                    continue
+                room = buf.free_space
+                if room <= 0:
+                    if buf.can_retrieve(batch_size):
+                        continue  # next sweep retrieves, making room
+                    raise PetastormTpuError(
+                        "Shuffling buffer deadlock: capacity cannot hold"
+                        " min_after_retrieve + one batch; raise the buffer"
+                        " capacity or lower min_after_retrieve/batch_size")
+                take = int(min(room, pending.num_rows))
+                buf.add(pending.slice_rows(0, take))
+                st["pending"] = (pending.slice_rows(take, pending.num_rows)
+                                 if take < pending.num_rows else None)
+                progressed = True
+        if exhausted:
+            for st in states.values():
+                st["buffer"].finish()
+            for st in states.values():
+                buf = st["buffer"]
+                while buf.can_retrieve(batch_size):
+                    yield buf.retrieve(batch_size)
+            return
+        try:
+            nxt = next_fn(straggler_release_s)
+        except StopIteration:
+            exhausted = True
+            continue
+        except _queue.Empty:
+            # source straggling: release any full batch that only the
+            # decorrelation floor is holding back (force bypasses it)
+            for st in states.values():
+                buf = st["buffer"]
+                if (buf.size >= batch_size
+                        and not buf.can_retrieve(batch_size)):
+                    if on_straggler_release is not None:
+                        on_straggler_release()
+                    yield buf.retrieve(batch_size, force=True)
+            continue
+        if nxt.num_rows == 0:
+            continue
+        _state(route_fn(nxt))["pending"] = nxt
+
+
 class ShufflingBufferBase:
     def add(self, batch: ColumnBatch) -> None:
         """Accept one columnar batch into the buffer (caller checked
         ``can_add``)."""
         raise NotImplementedError
 
-    def retrieve(self, n: int) -> ColumnBatch:
+    def retrieve(self, n: int, force: bool = False) -> ColumnBatch:
         """Remove and return exactly ``n`` rows (caller checked
-        ``can_retrieve(n)``)."""
+        ``can_retrieve(n)``).  ``force=True`` bypasses the decorrelation
+        floor (straggler release: a slow source must not gate assembly when
+        a full batch is already buffered)."""
         raise NotImplementedError
 
     def finish(self) -> None:
@@ -117,7 +208,7 @@ class NoopShufflingBuffer(ShufflingBufferBase):
             self._batches.append(batch)
             self._size += batch.num_rows
 
-    def retrieve(self, n: int) -> ColumnBatch:
+    def retrieve(self, n: int, force: bool = False) -> ColumnBatch:
         out = []
         need = n
         while need > 0 and self._batches:
@@ -211,8 +302,8 @@ class RandomShufflingBuffer(ShufflingBufferBase):
             buf[self._size:self._size + n] = col
         self._size += n
 
-    def retrieve(self, n: int) -> ColumnBatch:
-        if not self.can_retrieve(n):
+    def retrieve(self, n: int, force: bool = False) -> ColumnBatch:
+        if not force and not self.can_retrieve(n):
             raise PetastormTpuError("retrieve() refused: below decorrelation floor")
         n = min(n, self._size)
         pick = self._rng.choice(self._size, size=n, replace=False)

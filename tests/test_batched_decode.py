@@ -1,0 +1,723 @@
+"""Batch-fused multi-core decode into shm batch slots + live decode split.
+
+Covers the ISSUE 6 tentpole and satellites:
+
+* batched native decode: exact-pixel equality vs the per-image path, and
+  thread-pool determinism (nthreads > 1 == nthreads 1);
+* ROI/partial decode correctness at block-UNALIGNED crops (native level and
+  reader level, fixed/center/random modes, deterministic random crops);
+* decode-into-slot (shm arena batch slots): allocator claim/finalize/detach
+  semantics, zero-copy delivery (arena-gated), and the chaos
+  kill/requeue concurrency stress over the image decode plane;
+* the live host<->device decode split (decode_placement='auto'): exact row
+  multiset across a mid-read flip, both pool flavors, and the autotune
+  decode_split knob's decision semantics;
+* loader straggler release (MinatoLoader-style) and the async-chained
+  transfer-commit default;
+* io.reads_per_rowgroup telemetry + single-span rowgroup prefetch;
+* the native-unavailable one-time warning and Reader.diagnostics surfacing.
+"""
+
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.codecs import (CompressedImageCodec, ScalarCodec,
+                                  decode_options)
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.native import image as native_image
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.synthetic import synthetic_rgb_image
+
+pytestmark = pytest.mark.skipif(not native_image.available(),
+                                reason="native image library unavailable")
+
+
+def _jpeg_field(shape=(64, 64, 3), quality=90):
+    return Field("image", np.uint8, shape,
+                 CompressedImageCodec("jpeg", quality=quality))
+
+
+def _image_dataset(tmp_path, n_rows=64, rows_per_rg=8, hw=(64, 64),
+                   codec="jpeg"):
+    url = str(tmp_path / f"imgs_{codec}")
+    schema = Schema("Imgs", [
+        Field("label", np.int64, (), ScalarCodec()),
+        Field("image", np.uint8, hw + (3,),
+              CompressedImageCodec(codec, quality=90)),
+    ])
+    rows = [{"label": i, "image": synthetic_rgb_image(i, *hw)}
+            for i in range(n_rows)]
+    write_dataset(url, schema, rows, row_group_size_rows=rows_per_rg)
+    return url
+
+
+def _by_label(reader):
+    out = {}
+    for b in reader.iter_batches():
+        for lab, img in zip(b.columns["label"], b.columns["image"]):
+            out[int(lab)] = np.asarray(img)
+    return out
+
+
+# -- batched native decode: equality + multi-core determinism -----------------
+
+@pytest.mark.parametrize("codec", ["png", "jpeg"])
+def test_batched_decode_matches_per_image_path(codec):
+    c = CompressedImageCodec(codec, quality=90)
+    field = Field("image", np.uint8, (47, 61, 3), c)
+    bufs = [c.encode(field, synthetic_rgb_image(i, 47, 61)) for i in range(9)]
+    col = pa.array(bufs, type=pa.binary())
+    batched = c.decode_column(field, col)          # native batched path
+    per_image = np.stack([c.decode(field, b) for b in bufs])  # per-cell path
+    assert batched.shape == (9, 47, 61, 3)
+    assert (batched == per_image).all()
+
+
+@pytest.mark.parametrize("codec", ["png", "jpeg"])
+def test_batched_decode_multithread_matches_single(codec):
+    c = CompressedImageCodec(codec, quality=90)
+    field = Field("image", np.uint8, (64, 64, 3), c)
+    bufs = [c.encode(field, synthetic_rgb_image(i, 64, 64))
+            for i in range(17)]
+    col = pa.array(bufs, type=pa.binary())
+    with decode_options(nthreads=1):
+        one = c.decode_column(field, col)
+    with decode_options(nthreads=4):
+        four = c.decode_column(field, col)
+    assert (one == four).all()
+
+
+def test_coef_batch_multithread_matches_single():
+    c = CompressedImageCodec("jpeg", quality=90)
+    field = _jpeg_field()
+    bufs = [c.encode(field, synthetic_rgb_image(i, 64, 64))
+            for i in range(11)]
+    p1, q1, l1 = native_image.read_jpeg_coefficients_column(bufs, nthreads=1)
+    p4, q4, l4 = native_image.read_jpeg_coefficients_column(bufs, nthreads=4)
+    assert l1 == l4
+    assert (q1 == q4).all()
+    for a, b in zip(p1, p4):
+        assert (a == b).all()
+
+
+def test_decode_counters_emitted(tmp_path):
+    url = _image_dataset(tmp_path, n_rows=32, rows_per_rg=8)
+    tele = Telemetry()
+    with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
+                           telemetry=tele) as r:
+        n = sum(b.num_rows for b in r.iter_batches())
+    assert n == 32
+    counters = tele.snapshot()["counters"]
+    assert counters["decode.batch_calls"] == 4    # one per rowgroup
+    assert counters["decode.batch_images"] == 32
+
+
+# -- ROI (partial) decode -----------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["png", "jpeg"])
+def test_roi_decode_block_unaligned_exact(codec):
+    """Crops at offsets that are NOT multiples of 8 (jpeg MCU) must be
+    byte-identical to slicing a full decode."""
+    c = CompressedImageCodec(codec, quality=90)
+    field = Field("image", np.uint8, (97, 113, 3), c)
+    bufs = [c.encode(field, synthetic_rgb_image(i, 97, 113))
+            for i in range(6)]
+    col = pa.array(bufs, type=pa.binary())
+    full = c.decode_column(field, col)
+    y, x, h, w = 13, 7, 41, 53  # all block-unaligned
+    with decode_options(roi=(y, x, h, w), nthreads=2):
+        crop = c.decode_column(field, col)
+    assert crop.shape == (6, 41, 53, 3)
+    assert (crop == full[:, y:y + h, x:x + w]).all()
+
+
+def test_roi_decode_per_image_offsets():
+    c = CompressedImageCodec("jpeg", quality=90)
+    field = _jpeg_field()
+    bufs = [c.encode(field, synthetic_rgb_image(i, 64, 64)) for i in range(5)]
+    col = pa.array(bufs, type=pa.binary())
+    full = c.decode_column(field, col)
+    ys = np.array([0, 3, 9, 21, 31], np.int32)
+    xs = np.array([1, 0, 17, 5, 23], np.int32)
+    with decode_options(roi=(ys, xs, 33, 41)):
+        crop = c.decode_column(field, col)
+    for i in range(5):
+        assert (crop[i] == full[i, ys[i]:ys[i] + 33, xs[i]:xs[i] + 41]).all()
+
+
+def test_roi_reader_center_crop(tmp_path):
+    url = _image_dataset(tmp_path, n_rows=32, rows_per_rg=8)
+    with make_batch_reader(url, shuffle_row_groups=False) as r:
+        full = _by_label(r)
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           decode_roi={"image": ("center", 33, 41)}) as r:
+        assert r.output_schema["image"].shape == (33, 41, 3)
+        crop = _by_label(r)
+    y0, x0 = (64 - 33) // 2, (64 - 41) // 2
+    for lab, img in crop.items():
+        assert (img == full[lab][y0:y0 + 33, x0:x0 + 41]).all()
+
+
+def test_roi_reader_random_is_deterministic(tmp_path):
+    """'random' crops are seeded per (rowgroup, slice): two reads - and
+    therefore a requeue re-read after a crash - decode identical crops."""
+    url = _image_dataset(tmp_path, n_rows=32, rows_per_rg=8)
+
+    def read():
+        with make_batch_reader(url, shuffle_row_groups=False,
+                               decode_roi={"image": ("random", 30, 30)}) as r:
+            return _by_label(r)
+
+    a, b = read(), read()
+    assert set(a) == set(b) == set(range(32))
+    for lab in a:
+        assert (a[lab] == b[lab]).all()
+    # and the crops are actually random, not all identical windows
+    with make_batch_reader(url, shuffle_row_groups=False) as r:
+        full = _by_label(r)
+    offsets = set()
+    for lab, img in a.items():
+        found = None
+        for y in range(64 - 30 + 1):
+            for x in range(64 - 30 + 1):
+                if (img == full[lab][y:y + 30, x:x + 30]).all():
+                    found = (y, x)
+                    break
+            if found:
+                break
+        assert found is not None, f"label {lab}: crop not a window of full"
+        offsets.add(found)
+    assert len(offsets) > 3, f"random crops degenerate: {offsets}"
+
+
+def test_roi_validation_errors(tmp_path):
+    url = _image_dataset(tmp_path, n_rows=8, rows_per_rg=8)
+    with pytest.raises(PetastormTpuError, match="exceeds the stored"):
+        make_batch_reader(url, decode_roi={"image": (40, 40, 33, 41)})
+    with pytest.raises(PetastormTpuError, match="must be"):
+        make_batch_reader(url, decode_roi={"image": ("diag", 8, 8)})
+    with pytest.raises(PetastormTpuError, match="not in schema"):
+        make_batch_reader(url, decode_roi={"nope": (0, 0, 8, 8)})
+    with pytest.raises(PetastormTpuError, match="decode_placement"):
+        make_batch_reader(url, decode_roi={"image": (0, 0, 8, 8)},
+                          decode_placement={"image": "device"})
+
+
+# -- decode-into-slot (shm arena batch slots) ---------------------------------
+
+class _FakeArena:
+    """In-process stand-in for SharedArena: enough surface for the
+    allocator/encode side (alloc/view/free over one bytearray)."""
+
+    def __init__(self, size=1 << 22):
+        self._buf = bytearray(size)
+        self.size = size
+        self._next = 0
+        self.freed = []
+        self._closed = False
+
+    def alloc(self, size):
+        if self._next + size > self.size:
+            return None
+        off = self._next
+        self._next += size
+        return off
+
+    def view(self, offset, size):
+        return memoryview(self._buf)[offset:offset + size]
+
+    def free(self, offset):
+        self.freed.append(offset)
+
+
+def test_slot_allocator_claim_and_release():
+    from petastorm_tpu.native.transport import (ShmBatchRef, SlotAllocator,
+                                                encode_batch)
+
+    arena = _FakeArena()
+    alloc = SlotAllocator(arena)
+    img = alloc.alloc((4, 8, 8, 3), np.uint8)
+    assert img is not None and img.shape == (4, 8, 8, 3)
+    img[:] = 7
+    orphan = alloc.alloc((16,), np.uint8)   # never reaches the batch
+    assert orphan is not None
+    batch = ColumnBatch({"image": img,
+                         "label": np.arange(4, dtype=np.int64)}, 4)
+    ref = encode_batch(arena, batch, slots=alloc)
+    assert isinstance(ref, ShmBatchRef)
+    entry = ref.columns["image"]
+    assert entry[0] == "slot", entry          # claimed in place: no copy
+    assert ref.columns["label"][0] == "shm"   # packed block path
+    out = alloc.finalize(ref)
+    assert out is ref
+    # the orphan slot was freed, the claimed one was NOT (consumer frees it)
+    assert len(arena.freed) == 1
+    assert entry[3] not in arena.freed
+
+
+def test_slot_allocator_detaches_fallback_batches():
+    """A batch that falls back to queue pickling must not reference live
+    slots (the block is freed and could be reused mid-pickle)."""
+    from petastorm_tpu.native.transport import SlotAllocator, encode_batch
+
+    arena = _FakeArena(size=1 << 14)
+    alloc = SlotAllocator(arena)
+    img = alloc.alloc((4, 8, 8, 3), np.uint8)
+    img[:] = 5
+    # a batch too large for the arena forces the queue-pickling fallback
+    big = np.zeros((4, 10000), np.uint8)
+    batch = ColumnBatch({"image": img, "big": big}, 4)
+    ref = encode_batch(arena, batch, slots=alloc)
+    out = alloc.finalize(ref)
+    assert isinstance(out, ColumnBatch)        # fallback, not a ref
+    assert len(arena.freed) == 1               # slot reclaimed
+    assert (np.asarray(out.columns["image"]) == 5).all()  # detached copy
+    assert not np.shares_memory(out.columns["image"], img)
+
+
+def test_slot_allocator_detaches_views_of_slots():
+    """A transform may return a VIEW of a slot array; finalize must detect
+    the aliasing (not just identity) before freeing the block."""
+    from petastorm_tpu.native.transport import SlotAllocator, encode_batch
+
+    arena = _FakeArena()
+    alloc = SlotAllocator(arena)
+    img = alloc.alloc((8, 4, 4, 3), np.uint8)
+    img[:] = 9
+    view = img[::2]                            # identity broken: not claimable
+    big = np.zeros((4, 1 << 23), np.uint8)     # forces full fallback
+    batch = ColumnBatch({"image": view, "big": big}, 4)
+    ref = encode_batch(arena, batch, slots=alloc)
+    out = alloc.finalize(ref)
+    assert isinstance(out, ColumnBatch)
+    assert len(arena.freed) == 1
+    assert (np.asarray(out.columns["image"]) == 9).all()
+    assert not np.shares_memory(out.columns["image"], img)
+
+
+@pytest.mark.skipif(
+    not __import__("petastorm_tpu.native", fromlist=["is_available"]
+                   ).is_available(),
+    reason="shm arena plane unavailable (needs native lib + python >= 3.12)")
+def test_slot_decode_e2e_zero_copy(tmp_path):
+    """Acceptance: batched decode writes into shm batch slots - the column
+    the consumer sees IS the arena block the worker decoded into (no
+    intermediate allocation, no producer-side copy), proven by the
+    parent-side decode.batch_slots counter and the delivered array's lease
+    base."""
+    from petastorm_tpu.native.transport import _Lease
+
+    url = _image_dataset(tmp_path, n_rows=32, rows_per_rg=8)
+    tele = Telemetry()
+    with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
+                           reader_pool_type="process", workers_count=2,
+                           telemetry=tele) as r:
+        leased = 0
+        labels = []
+        for b in r.iter_batches():
+            labels += [int(x) for x in b.columns["label"]]
+            base = b.columns["image"]
+            while getattr(base, "base", None) is not None:
+                base = base.base
+            if isinstance(base, _Lease):
+                leased += 1
+    assert sorted(labels) == list(range(32))
+    counters = tele.snapshot()["counters"]
+    assert counters.get("decode.batch_slots", 0) >= 1, counters
+    assert leased >= 1
+
+
+def test_chaos_kill_requeue_over_image_decode(tmp_path):
+    """Concurrency stress for the decode plane: a hard worker kill mid-read
+    requeues its rowgroup; the re-decoded (slot or fallback) image rows
+    arrive exactly once and pixel-identical."""
+    from petastorm_tpu.test_util.chaos import ChaosSpec
+
+    url = _image_dataset(tmp_path, n_rows=48, rows_per_rg=8)
+    with make_batch_reader(url, shuffle_row_groups=False) as r:
+        expect = _by_label(r)
+    chaos = ChaosSpec(kill_ordinals=(2,))
+    with make_batch_reader(url, shuffle_row_groups=False, chaos=chaos,
+                           reader_pool_type="process", workers_count=2) as r:
+        got = _by_label(r)
+        diag = r.diagnostics
+    assert diag["requeued_items"] >= 1, diag
+    assert set(got) == set(expect)
+    for lab in expect:
+        assert (got[lab] == expect[lab]).all()
+
+
+# -- live host<->device decode split ------------------------------------------
+
+def test_decode_split_live_flip_exact_rows(tmp_path):
+    from petastorm_tpu.jax import JaxDataLoader
+
+    url = _image_dataset(tmp_path, n_rows=96, rows_per_rg=8)
+    with make_batch_reader(url, shuffle_row_groups=False, num_epochs=2,
+                           workers_count=2,
+                           decode_placement={"image": "auto"}) as r:
+        assert r.decode_split == "device"
+        labels = []
+        with JaxDataLoader(r, batch_size=16, drop_last=False) as loader:
+            for k, b in enumerate(loader):
+                labels += [int(x) for x in np.asarray(b["label"])]
+                assert b["image"].shape[1:] == (64, 64, 3)
+                if k == 2:
+                    r.set_decode_split("host")
+        assert r.decode_split == "host"
+        assert r.diagnostics["decode_split"] == "host"
+    assert sorted(labels) == sorted(list(range(96)) * 2)
+
+
+def test_decode_split_pixels_match_between_forms(tmp_path):
+    """Host-form delivery must produce the same pixels a plain host read
+    does, and device-form within the device-decode tolerance."""
+    from petastorm_tpu.jax import JaxDataLoader
+
+    url = _image_dataset(tmp_path, n_rows=32, rows_per_rg=8)
+    with make_batch_reader(url, shuffle_row_groups=False) as r:
+        expect = _by_label(r)
+
+    def read(mode):
+        out = {}
+        with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
+                               decode_placement={"image": "auto"}) as r:
+            r.set_decode_split(mode)
+            with JaxDataLoader(r, batch_size=8) as loader:
+                for b in loader:
+                    for lab, img in zip(np.asarray(b["label"]),
+                                        np.asarray(b["image"])):
+                        out[int(lab)] = img
+        return out
+
+    host = read("host")
+    for lab in expect:
+        assert (host[lab] == expect[lab]).all()
+    device = read("device")
+    for lab in expect:
+        diff = np.abs(device[lab].astype(int) - expect[lab].astype(int))
+        assert diff.max() <= 6 and diff.mean() < 1.0  # ops/jpeg tolerance
+
+
+def test_decode_split_requires_auto_field(tmp_path):
+    url = _image_dataset(tmp_path, n_rows=8, rows_per_rg=8)
+    with make_batch_reader(url, shuffle_row_groups=False) as r:
+        assert r.decode_split is None
+        with pytest.raises(PetastormTpuError, match="decode_placement"):
+            r.set_decode_split("host")
+
+
+def test_decode_split_rejected_with_stack_batches(tmp_path):
+    from petastorm_tpu.jax import JaxDataLoader
+
+    url = _image_dataset(tmp_path, n_rows=32, rows_per_rg=8)
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           decode_placement={"image": "auto"}) as r:
+        with pytest.raises(PetastormTpuError, match="stack_batches"):
+            JaxDataLoader(r, batch_size=8, stack_batches=2)
+        r.stop()
+        r.join()
+
+
+def test_autotune_decode_split_knob_decisions():
+    """Deterministic controller semantics: with the structural knobs at
+    their bounds, a starved signal moves the split toward the device, a
+    consumer-bound signal moves it back toward the host, and the gauge
+    tracks it."""
+    from tests.test_autotune import FakeSampler, _point
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    from petastorm_tpu.autotune import AutotuneController, AutotunePolicy
+    from petastorm_tpu.pool import ThreadedExecutor
+
+    tele = Telemetry()
+    sampler = FakeSampler()
+    # workers already at the policy max; results queue pinned wide (above
+    # max_results_queue -> not tuned); no loader attached -> decode_split is
+    # the only admissible candidate
+    ex = ThreadedExecutor(workers_count=2, results_queue_size=500)
+    policy = AutotunePolicy(min_workers=2, max_workers=2, max_results_queue=16,
+                            settle_s=1.0, eval_points=2, cooldown_s=0.0)
+    clock = FakeClock()
+    ctl = AutotuneController(ex, sampler, tele, policy=policy, clock=clock)
+    split = {"value": 0}
+    ctl.attach_decode_split(get=lambda: split["value"],
+                            set_=lambda v: split.__setitem__("value", v) or v)
+
+    sampler.points.extend([_point(100, starved=0.9)] * 2)
+    entry = ctl.step()
+    assert entry is not None and entry["knob"] == "decode_split", entry
+    assert entry["action"] == "grow" and split["value"] == 1
+    clock.t += policy.settle_s + 0.01
+    assert ctl.step() is None
+    sampler.points.extend([_point(150)] * 2)
+    done = ctl.step()
+    assert done["outcome"] == "kept" and split["value"] == 1
+    assert tele.snapshot()["gauges"]["autotune.decode_split"] == 1
+
+    # consumer-bound now: pull the decode back onto the host workers
+    sampler.points.extend([_point(100, blocked=0.9)] * 2)
+    entry = ctl.step()
+    assert entry["knob"] == "decode_split" and entry["action"] == "shrink"
+    assert split["value"] == 0
+
+
+# -- straggler release --------------------------------------------------------
+
+class _StubReader:
+    """Minimal reader: emits canned ColumnBatches with scripted delays."""
+
+    def __init__(self, batches, delays):
+        self.schema = Schema("Stub", [Field("x", np.int64, ())])
+        self.output_schema = self.schema
+        self._batches = batches
+        self._delays = delays
+        self.telemetry = None
+
+    def iter_batches(self):
+        for batch, delay in zip(self._batches, self._delays):
+            if delay:
+                time.sleep(delay)
+            yield batch
+
+    def stop(self):
+        pass
+
+    def join(self):
+        pass
+
+
+def test_straggler_release_bypasses_floor():
+    """With enough rows buffered but the decorrelation floor refusing
+    retrieval, a straggling source must not gate assembly: the batch is
+    released at the threshold and the late rows ride the next batch."""
+    from petastorm_tpu.jax import JaxDataLoader
+
+    def cb(lo, hi):
+        return ColumnBatch({"x": np.arange(lo, hi, dtype=np.int64)}, hi - lo)
+
+    batches = [cb(0, 8), cb(8, 16), cb(16, 24), cb(24, 32)]
+    delays = [0, 0, 0, 1.2]  # the last rowgroup straggles
+    reader = _StubReader(batches, delays)
+    loader = JaxDataLoader(reader, batch_size=8, drop_last=False,
+                           shuffling_queue_capacity=24, min_after_retrieve=12,
+                           buffer_seed=7, straggler_release_s=0.25)
+    t0 = time.perf_counter()
+    first_at = None
+    rows = []
+    with loader:
+        for b in loader:
+            if first_at is None:
+                first_at = time.perf_counter() - t0
+            rows += [int(v) for v in np.asarray(b["x"])]
+    assert sorted(rows) == list(range(32))
+    assert loader.diagnostics["straggler_releases"] >= 1
+    # the release happened during the straggler's sleep, not after it
+    assert first_at < 1.1, first_at
+
+
+def test_straggler_release_auto_off_without_floor():
+    from petastorm_tpu.jax import JaxDataLoader
+
+    reader = _StubReader([ColumnBatch({"x": np.arange(8)}, 8)], [0])
+    with JaxDataLoader(reader, batch_size=8) as loader:
+        assert loader._straggler_s is None
+        rows = [int(v) for b in loader for v in np.asarray(b["x"])]
+    assert rows == list(range(8))
+
+
+def test_iter_batched_multi_matches_iter_batched():
+    from petastorm_tpu.shuffle import (NoopShufflingBuffer, iter_batched,
+                                       iter_batched_multi)
+
+    def cb(lo, hi):
+        return ColumnBatch({"x": np.arange(lo, hi, dtype=np.int64)}, hi - lo)
+
+    src = [cb(0, 5), cb(5, 11), cb(11, 12), cb(12, 20)]
+    a = [b.columns["x"].tolist()
+         for b in iter_batched(iter(src), NoopShufflingBuffer(), 4)]
+    it = iter(src)
+    b = [batch.columns["x"].tolist()
+         for batch in iter_batched_multi(lambda _t: next(it), lambda _b: (),
+                                         NoopShufflingBuffer, 4)]
+    assert a == b
+
+
+# -- transfer commit ----------------------------------------------------------
+
+def test_transfer_commit_modes(monkeypatch):
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.jax import loader as loader_mod
+
+    def run(**kwargs):
+        reader = _StubReader([ColumnBatch({"x": np.arange(8)}, 8)], [0])
+        with JaxDataLoader(reader, batch_size=8, **kwargs) as ld:
+            rows = [int(v) for b in ld for v in np.asarray(b["x"])]
+            assert rows == list(range(8))
+            return ld
+
+    ld = run(transfer_commit=False)
+    assert ld._commit_transfers is False
+    ld = run(transfer_commit=True)
+    assert ld._commit_transfers is True and ld._commit_probe_ms is None
+
+    # 'auto' with an impossible threshold: every runtime looks like a
+    # round-trip runtime -> async-chained from batch 1
+    monkeypatch.setattr(loader_mod, "_COMMIT_PROBE_THRESHOLD_S", -1.0)
+    ld = run(transfer_commit="auto")
+    assert ld._commit_transfers is False
+    assert ld._commit_probe_ms is not None
+    assert ld.diagnostics["transfer_commit"] is False
+
+    # healthy threshold: commits stay on
+    monkeypatch.setattr(loader_mod, "_COMMIT_PROBE_THRESHOLD_S", 1e9)
+    ld = run(transfer_commit="auto")
+    assert ld._commit_transfers is True
+
+
+def test_transfer_commit_rejects_bad_value():
+    from petastorm_tpu.jax import JaxDataLoader
+
+    reader = _StubReader([], [])
+    with pytest.raises(PetastormTpuError, match="transfer_commit"):
+        JaxDataLoader(reader, batch_size=8, transfer_commit="maybe")
+    # 0 == False but is not False: must be rejected, not silently treated
+    # as commits-enabled (the opposite of what the caller asked for)
+    with pytest.raises(PetastormTpuError, match="transfer_commit"):
+        JaxDataLoader(reader, batch_size=8, transfer_commit=0)
+    with pytest.raises(PetastormTpuError, match="transfer_commit"):
+        JaxDataLoader(reader, batch_size=8, transfer_commit=1)
+
+
+def test_roi_fallback_passes_nulls_through():
+    """A nullable image column under decode_roi must not crash on None
+    cells (the per-cell fallback path decodes them as None)."""
+    from petastorm_tpu.codecs import _slice_roi
+
+    c = CompressedImageCodec("jpeg", quality=90)
+    field = _jpeg_field((16, 16, 3))
+    img = c.decode(field, c.encode(field, synthetic_rgb_image(1, 16, 16)))
+    col = np.empty(3, dtype=object)
+    col[0], col[1], col[2] = img, None, img
+    out = _slice_roi(col, (2, 3, 8, 8))
+    assert out[1] is None
+    assert (out[0] == img[2:10, 3:11]).all()
+    assert (out[2] == img[2:10, 3:11]).all()
+
+
+# -- io window / read amplification -------------------------------------------
+
+def test_reads_per_rowgroup_is_one_with_window(tmp_path):
+    from petastorm_tpu.test_util.latency_fs import latent_filesystem
+    from petastorm_tpu.test_util.synthetic import write_wide_dataset
+
+    url = str(tmp_path / "wide")
+    write_wide_dataset(url, n_cols=8, n_rowgroups=8, rows_per_rg=32,
+                       vec_len=16, seed=1)
+    fs, _stats = latent_filesystem(latency_s=0.0)
+    tele = Telemetry()
+    with make_batch_reader(url, filesystem=fs, shuffle_row_groups=False,
+                           num_epochs=1, workers_count=2,
+                           telemetry=tele) as r:
+        n = sum(b.num_rows for b in r.iter_batches())
+    assert n == 8 * 32
+    counters = tele.snapshot()["counters"]
+    assert counters["io.rowgroups_read"] == 8
+    # the single-span window: exactly ONE ranged read per rowgroup (down
+    # from the ~1.7 BENCH_r05 measured through pre_buffer alone)
+    assert counters["io.read_calls"] == 8, counters
+    assert tele.snapshot()["gauges"]["io.reads_per_rowgroup"] == 1
+
+
+def test_rowgroup_span_guards():
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.io_window import rowgroup_span
+
+    class _Col:
+        def __init__(self, name, off, size):
+            self.path_in_schema = name
+            self.data_page_offset = off
+            self.dictionary_page_offset = None
+            self.total_compressed_size = size
+
+    class _RG:
+        def __init__(self, cols):
+            self._cols = cols
+            self.num_columns = len(cols)
+
+        def column(self, j):
+            return self._cols[j]
+
+    class _Meta:
+        def __init__(self, cols):
+            self._rg = _RG(cols)
+
+        def row_group(self, i):
+            return self._rg
+
+    # contiguous chunks: span == sum
+    meta = _Meta([_Col("a", 0, 100), _Col("b", 100, 50)])
+    assert rowgroup_span(meta, 0) == (0, 150, 150)
+    # column pruning keeps the span tight
+    assert rowgroup_span(meta, 0, ["b"]) == (100, 50, 50)
+    # far-apart needed columns: amplification guard refuses the window
+    meta = _Meta([_Col("a", 0, 100), _Col("b", 100_000_000, 50)])
+    assert rowgroup_span(meta, 0, ["a", "b"]) is None
+
+
+def test_windowed_file_serves_reads_from_window(tmp_path):
+    import pyarrow as pa
+
+    from petastorm_tpu.io_window import WindowedFile
+
+    path = tmp_path / "blob.bin"
+    payload = bytes(range(256)) * 64
+    path.write_bytes(payload)
+    wf = WindowedFile(pa.OSFile(str(path), "rb"))
+    assert wf.prefetch(1000, 4096)
+    assert wf.raw_reads == 1
+    wf.seek(1100)
+    assert wf.read(100) == payload[1100:1200]
+    assert wf.raw_reads == 1            # served from the window
+    wf.seek(9000)
+    assert wf.read(10) == payload[9000:9010]
+    assert wf.raw_reads == 2            # outside: direct read
+    wf.close()
+
+
+# -- native-unavailable fallback ----------------------------------------------
+
+def test_native_unavailable_warns_once_and_shows_in_diagnostics(
+        tmp_path, monkeypatch, caplog):
+    url = _image_dataset(tmp_path, n_rows=8, rows_per_rg=8)
+    monkeypatch.setattr(native_image, "_load", lambda: None)
+    monkeypatch.setattr(native_image, "_warned_unavailable", False)
+    with caplog.at_level(logging.WARNING, logger=native_image.__name__):
+        with make_batch_reader(url, shuffle_row_groups=False,
+                               workers_count=1) as r:
+            got = _by_label(r)
+            diag = r.diagnostics
+    assert set(got) == set(range(8))        # cv2 fallback still decodes
+    assert diag["native"]["image_decode"] is False
+    assert "build" in diag["native"]["build_command"]
+    warnings = [rec for rec in caplog.records
+                if "native image decode library" in rec.getMessage()]
+    assert len(warnings) == 1, [r.getMessage() for r in warnings]
+    assert native_image.BUILD_COMMAND in warnings[0].getMessage()

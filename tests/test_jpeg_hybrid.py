@@ -228,7 +228,7 @@ def test_device_decode_rejects_png(tmp_path):
     with pytest.raises(PetastormTpuError, match="jpeg"):
         make_batch_reader(url, decode_placement={"image": "device"})
     with pytest.raises(PetastormTpuError,
-                       match="'host', 'device' or 'device-mixed'"):
+                       match="'host', 'device', 'device-mixed' or 'auto'"):
         make_batch_reader(url, decode_placement={"image": "chip"})
 
 
