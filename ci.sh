@@ -268,6 +268,56 @@ print("autotune smoke OK"
       f" {len(rows)} rows delivered exactly once under a worker kill)")
 PY
 
+echo "== warm-cache smoke (shared tier: warm re-read with zero extra decodes) =="
+# reader A decodes a jpeg dataset cold into the shared warm tier; reader B -
+# a NEW reader over the same tier - must deliver the exact rows with cache
+# hits and ZERO additional rowgroup decodes (decode.batch_calls delta == 0):
+# the cross-reader warm-tier contract of ISSUE 7
+JAX_PLATFORMS=cpu timeout -k 10 120 python - <<'PY'
+import tempfile
+import numpy as np
+from petastorm_tpu.cache_shared import SharedWarmCache
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.synthetic import synthetic_rgb_image
+
+tmp = tempfile.mkdtemp(prefix="petastorm_tpu_warm_smoke_")
+tier = tempfile.mkdtemp(prefix="petastorm_tpu_warm_tier_")
+schema = Schema("WarmSmoke", [
+    Field("label", np.int64, (), ScalarCodec()),
+    Field("image", np.uint8, (48, 48, 3), CompressedImageCodec("jpeg", quality=90)),
+])
+write_dataset(tmp, schema,
+              [{"label": i, "image": synthetic_rgb_image(i, 48, 48)}
+               for i in range(48)], row_group_size_rows=8)
+
+def read(tele):
+    with make_batch_reader(tmp, reader_pool_type="thread", workers_count=2,
+                           shuffle_row_groups=False, cache_type="shared",
+                           cache_location=tier, telemetry=tele) as reader:
+        return sorted(int(x) for b in reader.iter_batches()
+                      for x in b.columns["label"])
+
+tele_a, tele_b = Telemetry(), Telemetry()
+rows_a = read(tele_a)
+rows_b = read(tele_b)
+assert rows_a == rows_b == list(range(48)), (len(rows_a), len(rows_b))
+ca = tele_a.snapshot()["counters"]
+cb = tele_b.snapshot()["counters"]
+assert ca["cache.misses"] == 6, ca
+assert ca.get("decode.batch_calls", 0) >= 6, ca      # cold epoch decoded
+assert cb["cache.hits"] >= 6, cb                     # warm re-read hit the tier
+assert cb.get("decode.batch_calls", 0) == 0, cb      # with ZERO extra decodes
+SharedWarmCache(location=tier).cleanup()
+print("warm-cache smoke OK"
+      f" (cold: {int(ca['cache.misses'])} misses,"
+      f" {int(ca['decode.batch_calls'])} batched decodes; warm re-read:"
+      f" {int(cb['cache.hits'])} hits, 0 decodes, rows exact)")
+PY
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

@@ -29,7 +29,8 @@ API = [
     ("petastorm_tpu.codecs", ["Codec", "ScalarCodec", "NdarrayCodec",
                               "CompressedNdarrayCodec", "CompressedImageCodec",
                               "register_codec"]),
-    ("petastorm_tpu.transform", ["TransformSpec", "transform_schema"]),
+    ("petastorm_tpu.transform", ["TransformSpec", "transform_schema",
+                                 "transform_signature"]),
     ("petastorm_tpu.predicates", ["in_set", "in_intersection", "in_lambda",
                                   "in_negate", "in_reduce",
                                   "in_pseudorandom_split"]),
@@ -56,6 +57,7 @@ API = [
                                     "SingleFieldIndexer", "FieldNotNullIndexer"]),
     ("petastorm_tpu.cache", ["make_cache", "InMemoryCache", "LocalDiskCache",
                              "NullCache", "CacheBase"]),
+    ("petastorm_tpu.cache_shared", ["SharedWarmCache"]),
     ("petastorm_tpu.fs", ["get_filesystem_and_path", "FilesystemFactory",
                           "normalize_dir_url"]),
     ("petastorm_tpu.retry", ["RetryPolicy", "retry_call", "resolve_retry_policy",

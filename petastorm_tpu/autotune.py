@@ -283,7 +283,8 @@ class AutotuneController:
         self._m_kept = telemetry.counter("autotune.moves_kept")
         self._m_reverted = telemetry.counter("autotune.moves_reverted")
         self._gauges = {}
-        for name in ("workers", "results_queue", "prefetch", "decode_split"):
+        for name in ("workers", "results_queue", "prefetch", "decode_split",
+                     "cache_mem"):
             self._gauges[name] = telemetry.gauge(f"autotune.{name}")
         self._stamp_gauges()
 
@@ -307,6 +308,32 @@ class AutotuneController:
             get=lambda: int(loader.prefetch),
             set_=loader.set_prefetch,
             lo=p.min_prefetch, hi=p.max_prefetch)
+        self._stamp_gauges()
+
+    def attach_cache_memory(self, get: Callable[[], int],
+                            set_: Callable[[int], int],
+                            lo_mb: int, hi_mb: int) -> None:
+        """Register the shared warm tier's L1 residency cap as a knob
+        (called by make_reader for ``cache_type='shared'`` readers; values
+        in MB - the knob plane is integer).
+
+        The memory-vs-worker-count trade (ROADMAP item 5): a starved
+        consumer first widens the worker plane; once those moves are blocked
+        or bounded, growing the warm tier's residency turns repeat reads
+        into memcpys instead of decodes (same bottleneck, different lever).
+        A consumer-bound pipeline shrinks the tier - decoded-batch memcpys
+        and eviction churn spend host memory bandwidth the consumer needs.
+        Doubling/halving steps (the useful range spans orders of magnitude);
+        judged and reverted on delivered throughput like every knob.  NOTE:
+        the cap lives in the tier's shared header, so a move applies to
+        every job on the tier - pin it (docs/operations.md "Warm cache")
+        when jobs must not tune each other.
+        """
+        if hi_mb < lo_mb or hi_mb < 1:
+            return
+        self._knobs["cache_mem"] = _Knob(
+            "cache_mem", get=get, set_=set_, lo=max(1, lo_mb), hi=hi_mb,
+            step_kind="mul")
         self._stamp_gauges()
 
     def attach_decode_split(self, get: Callable[[], int],
@@ -510,14 +537,18 @@ class AutotuneController:
             candidates = [("workers", +1, reason),
                           ("prefetch", +1, reason),
                           ("results_queue", +1, reason),
+                          ("cache_mem", +1, reason),
                           ("decode_split", +1, reason)]
         elif blocked >= p.blocked_threshold:
             # the consumer can't keep up: free CPU for it (fewer workers),
-            # let the workers run ahead (wider results bound), or pull the
-            # decode back onto the idle worker plane (split toward host)
+            # let the workers run ahead (wider results bound), shrink the
+            # warm tier (its memcpys/eviction churn compete for the memory
+            # bandwidth the consumer needs), or pull the decode back onto
+            # the idle worker plane (split toward host)
             reason = f"workers blocked on full results {blocked:.0%} of wall"
             candidates = [("workers", -1, reason),
                           ("results_queue", +1, reason),
+                          ("cache_mem", -1, reason),
                           ("decode_split", -1, reason)]
         elif p.explore:
             # no queue-wait signal: probe around the current point - some

@@ -100,6 +100,21 @@ def build_parser() -> argparse.ArgumentParser:
                              " prefetch adapt to the live metrics sampler"
                              " (petastorm_tpu.autotune; decisions ride"
                              " telemetry as autotune.*)")
+    parser.add_argument("--cache-type", default="null",
+                        choices=("null", "memory", "local-disk", "shared"),
+                        help="decoded-rowgroup cache"
+                             " (docs/operations.md 'Warm cache'): 'shared' ="
+                             " the host-wide warm tier - repeat this command"
+                             " (or run it concurrently) to measure warm-vs-"
+                             "cold; cache.* telemetry shows the hit rate")
+    parser.add_argument("--cache-location", default=None, metavar="PATH",
+                        help="names the cache tier (same location = same"
+                             " shared tier host-wide; also the disk"
+                             " directory)")
+    parser.add_argument("--cache-size-mb", type=int, default=None,
+                        metavar="MB",
+                        help="cache size cap (shared: the L1 shm arena;"
+                             " memory/local-disk: the tier's byte cap)")
     return parser
 
 
@@ -115,6 +130,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.chaos:
         from petastorm_tpu.test_util.chaos import ChaosSpec
         chaos = ChaosSpec.parse(args.chaos)
+
+    cache_kwargs = dict(
+        cache_type=args.cache_type, cache_location=args.cache_location,
+        cache_size_limit=(args.cache_size_mb * 2 ** 20
+                          if args.cache_size_mb else None))
 
     if args.isolated:
         from petastorm_tpu.benchmark.throughput import run_isolated
@@ -137,7 +157,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after,
             metrics_port=args.metrics_port,
             flight_record_path=args.flight_record,
-            autotune=args.autotune)
+            autotune=args.autotune, **cache_kwargs)
     else:
         from petastorm_tpu.benchmark.throughput import reader_throughput
         result = reader_throughput(
@@ -149,7 +169,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             item_deadline_s=args.item_deadline, hedge_after_s=args.hedge_after,
             metrics_port=args.metrics_port,
             flight_record_path=args.flight_record,
-            autotune=args.autotune)
+            autotune=args.autotune, **cache_kwargs)
 
     if telemetry is not None and args.trace_out and not args.isolated:
         telemetry.export_chrome_trace(args.trace_out)

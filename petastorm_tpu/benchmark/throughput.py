@@ -93,7 +93,10 @@ def reader_throughput(dataset_url: str,
                       hedge_after_s=None,
                       metrics_port: Optional[int] = None,
                       flight_record_path: Optional[str] = None,
-                      autotune=False) -> BenchmarkResult:
+                      autotune=False,
+                      cache_type: str = "null",
+                      cache_location: Optional[str] = None,
+                      cache_size_limit: Optional[int] = None) -> BenchmarkResult:
     """Measure raw reader throughput in samples/sec.
 
     ``read_method='row'`` counts one sample per ``next()`` (make_reader);
@@ -124,6 +127,8 @@ def reader_throughput(dataset_url: str,
                  hedge_after_s=hedge_after_s,
                  metrics_port=metrics_port,
                  flight_record_path=flight_record_path,
+                 cache_type=cache_type, cache_location=cache_location,
+                 cache_size_limit=cache_size_limit,
                  autotune=autotune or None) as reader:
         if reader.metrics_server is not None:
             # stderr so --json stdout stays one parseable line; without this
@@ -169,7 +174,10 @@ def jax_loader_throughput(dataset_url: str,
                           hedge_after_s=None,
                           metrics_port: Optional[int] = None,
                           flight_record_path: Optional[str] = None,
-                          autotune=False) -> BenchmarkResult:
+                          autotune=False,
+                          cache_type: str = "null",
+                          cache_location: Optional[str] = None,
+                          cache_size_limit: Optional[int] = None) -> BenchmarkResult:
     """Measure the device feed path: batches landing as committed ``jax.Array``.
 
     Blocks on every batch (``block_until_ready``) so the number reflects
@@ -200,6 +208,8 @@ def jax_loader_throughput(dataset_url: str,
         telemetry=tele, chaos=chaos, on_error=on_error,
         item_deadline_s=item_deadline_s, hedge_after_s=hedge_after_s,
         metrics_port=metrics_port, flight_record_path=flight_record_path,
+        cache_type=cache_type, cache_location=cache_location,
+        cache_size_limit=cache_size_limit,
         autotune=autotune or None)
     if reader.metrics_server is not None:
         # same stderr contract as reader_throughput: the ephemeral bound

@@ -28,6 +28,15 @@ _MISSING = object()  # sentinel: cache miss vs a legitimately-None entry
 
 
 class CacheBase(ABC):
+    #: True when the cache may hold REFERENCES to values it served or was
+    #: filled with (vs private copies / serialized bytes).  The worker only
+    #: arms arena batch-slot decode (decode output allocated directly in the
+    #: process-pool transport's shared memory) when this is False - a cache
+    #: retaining a reference to a slot-backed array would serve a dangling
+    #: view after the consumer frees the block.  Conservative default for
+    #: unknown subclasses; every cache in this module stores copies.
+    retains_value_references = True
+
     @abstractmethod
     def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
         """Return cached value or compute+store via ``fill_cache_func``."""
@@ -59,6 +68,8 @@ class CacheBase(ABC):
 class NullCache(CacheBase):
     """No-op cache (reference cache.py:35-39)."""
 
+    retains_value_references = False  # retains nothing at all
+
     def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
         return fill_cache_func()
 
@@ -72,6 +83,10 @@ class InMemoryCache(CacheBase):
     IO, no decode).  Size accounting uses ``ColumnBatch`` array nbytes when
     available, else ``sys.getsizeof``.
     """
+
+    # both directions cross through _copy_value: stored entries and served
+    # hits are private copies, never references to pipeline arrays
+    retains_value_references = False
 
     def __init__(self, size_limit_bytes: int = 4 * 2 ** 30, telemetry=None):
         from collections import OrderedDict as _OD
@@ -175,60 +190,112 @@ class LocalDiskCache(CacheBase):
 
     Reference semantics (local_disk_cache.py:22-63): persistent across runs unless
     ``cleanup()`` is called; sized eviction.  Keys are hashed, so any string key
-    works.  Concurrent readers/writers are safe per-entry (atomic rename); the
-    eviction sweep is best-effort.
+    works.  Safe under concurrent MULTI-PROCESS readers/writers sharing one
+    directory (the shared warm tier's L2, docs/operations.md "Warm cache"):
+    entries appear atomically (temp-file + rename), in-flight ``.tmp`` files
+    are never evicted young (a partner deleting a writer's temp would fail
+    the writer's rename) but ARE swept once orphan-aged (a crashed writer
+    must not leak them forever), and every path tolerates a partner having
+    deleted the entry first.
     """
+
+    # values cross a pickle round-trip in both directions: nothing served or
+    # stored aliases a pipeline array (batch-slot decode stays armed)
+    retains_value_references = False
+
+    #: a ``.tmp`` older than this is a crashed writer's orphan: evictable
+    ORPHAN_TMP_S = 300.0
+    #: stores between full eviction sweeps (the sweep lists + stats the whole
+    #: directory - O(entries); per-store it would put a linear scan on every
+    #: cold-decode miss and go quadratic over a cold epoch).  The cap may
+    #: overshoot by up to SWEEP_EVERY entries between sweeps - it is
+    #: best-effort by contract.
+    SWEEP_EVERY = 16
 
     def __init__(self, path: str, size_limit_bytes: int = 10 * 2 ** 30,
                  telemetry=None):
         self._dir = path
         self._size_limit = size_limit_bytes
         self._telemetry = _resolve_telemetry(telemetry)
+        # GIL-atomic counter; a race just shifts the sweep cadence by one
+        self._stores_since_sweep = 0
         os.makedirs(path, exist_ok=True)
 
     def _entry_path(self, key: str) -> str:
         return os.path.join(self._dir, hashlib.sha1(key.encode()).hexdigest() + ".bin")
 
-    def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
+    def lookup(self, key: str) -> Any:
+        """Probe-only half of :meth:`get`: the stored value, or the module's
+        ``_MISSING`` sentinel (never fills).  The shared warm tier uses this
+        to compose L2 behind its shared-memory L1."""
         path = self._entry_path(key)
         try:
             with open(path, "rb") as f:
                 value = pickle.load(f)
-            os.utime(path)  # LRU touch
-            self._record_lookup(True)
-            return value
         except FileNotFoundError:
-            pass
+            return _MISSING
         except Exception as exc:  # corrupt entry: recompute
             logger.warning("Dropping corrupt cache entry %s: %s", path, exc)
             try:
                 os.remove(path)
             except OSError:
                 pass
-        self._record_lookup(False)
-        value = fill_cache_func()
+            return _MISSING
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            # a concurrent evictor deleted the entry between our open and
+            # the touch - the value we already read is still good
+            pass
+        return value
+
+    def store(self, key: str, value: Any) -> None:
+        """Fill-only half of :meth:`get`: atomically publish ``value`` under
+        ``key`` (temp file + rename; concurrent writers of one key are safe,
+        last rename wins) and run the best-effort eviction sweep (amortized:
+        every ``SWEEP_EVERY`` stores)."""
         tmp_fd, tmp_path = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
         try:
             with os.fdopen(tmp_fd, "wb") as f:
                 pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
-            os.replace(tmp_path, path)
+            os.replace(tmp_path, self._entry_path(key))
         except Exception:
             try:
                 os.remove(tmp_path)
             except OSError:
                 pass
             raise
-        self._maybe_evict()
+        self._stores_since_sweep += 1
+        if self._stores_since_sweep >= self.SWEEP_EVERY:
+            self._stores_since_sweep = 0
+            self._maybe_evict()
+
+    def get(self, key: str, fill_cache_func: Callable[[], Any]) -> Any:
+        value = self.lookup(key)
+        if value is not _MISSING:
+            self._record_lookup(True)
+            return value
+        self._record_lookup(False)
+        value = fill_cache_func()
+        self.store(key, value)
         return value
 
     def _maybe_evict(self) -> None:
+        import time as _time
+
         entries = []
         total = 0
+        now = _time.time()
         for name in os.listdir(self._dir):
             p = os.path.join(self._dir, name)
             try:
                 st = os.stat(p)
             except OSError:
+                continue  # a partner evicted it between listdir and stat
+            if name.endswith(".tmp") and now - st.st_mtime < self.ORPHAN_TMP_S:
+                # a LIVE concurrent writer's temp file: deleting it would
+                # fail that writer's rename.  Old ones are crashed-writer
+                # orphans and sweep like any entry.
                 continue
             total += st.st_size
             entries.append((st.st_mtime, st.st_size, p))
@@ -240,7 +307,7 @@ class LocalDiskCache(CacheBase):
                 os.remove(p)
                 total -= size
             except OSError:
-                continue
+                continue  # a partner's sweep got there first: same outcome
             if total <= self._size_limit:
                 return
 
@@ -252,9 +319,16 @@ class LocalDiskCache(CacheBase):
 
 def make_cache(cache_type: str = "null", cache_location: str = None,
                cache_size_limit: int = None, telemetry=None) -> CacheBase:
-    """'null' | 'local-disk' | 'memory' (reference: reader.py:126-131; 'memory'
-    is new here - decoded-batch LRU in host RAM).  ``telemetry``: optional
-    petastorm_tpu.telemetry recorder for cache.hits / cache.misses counters."""
+    """'null' | 'local-disk' | 'memory' | 'shared' (reference:
+    reader.py:126-131; 'memory' and 'shared' are new here).
+
+    'shared' is the host-wide warm tier (petastorm_tpu.cache_shared,
+    docs/operations.md "Warm cache"): decoded rowgroups in a shared-memory
+    arena every worker/reader/job on the host can hit, backed by a bounded
+    disk tier.  ``cache_location`` names the tier (same location = same
+    tier host-wide; also the disk tier's directory); ``cache_size_limit``
+    sizes the shared-memory arena.  ``telemetry``: optional
+    petastorm_tpu.telemetry recorder for the cache.* series."""
     if cache_type in (None, "null", "none"):
         return NullCache()
     if cache_type == "local-disk":
@@ -265,4 +339,10 @@ def make_cache(cache_type: str = "null", cache_location: str = None,
     if cache_type == "memory":
         return InMemoryCache(cache_size_limit or 4 * 2 ** 30,
                              telemetry=telemetry)
+    if cache_type == "shared":
+        from petastorm_tpu.cache_shared import DEFAULT_L1_BYTES, SharedWarmCache
+
+        return SharedWarmCache(location=cache_location,
+                               l1_bytes=cache_size_limit or DEFAULT_L1_BYTES,
+                               telemetry=telemetry)
     raise ValueError(f"Unknown cache_type {cache_type!r}")

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import threading
 from multiprocessing import resource_tracker, shared_memory
 from typing import Optional
 
@@ -38,14 +39,53 @@ def _load_lib() -> Optional[ctypes.CDLL]:
 
 
 def is_available() -> bool:
-    """True if the native library is (or can be) built on this machine."""
+    """True if the zero-copy transport plane works here: the native library
+    builds AND the interpreter supports PEP 688 buffer-protocol leases."""
     import sys
 
     if sys.version_info < (3, 12):
         # zero-copy leases rely on the PEP 688 buffer protocol (__buffer__),
         # which np.frombuffer only honors from 3.12
         return False
+    return allocator_available()
+
+
+def allocator_available() -> bool:
+    """True if the C arena allocator itself is usable (no interpreter-version
+    gate: copy-based users like the shared warm-cache tier work on any
+    python - only the transport's zero-copy leases need 3.12)."""
     return _load_lib() is not None
+
+
+#: serializes the resource-tracker monkeypatch below: two concurrent
+#: attaches (e.g. a warm-cache lazy attach in a worker racing the transport
+#: arena attach) could otherwise interleave save/restore and leave the
+#: suppressed register installed process-wide permanently
+_ATTACH_LOCK = threading.Lock()
+
+
+def attach_shared_memory(name: str) -> shared_memory.SharedMemory:
+    """Attach an existing named segment WITHOUT registering it with the
+    resource tracker.
+
+    python<3.13 registers even *attached* segments with the resource tracker,
+    which would unlink the creator's segment when this process exits (and
+    sending unregister instead races other attachers into KeyErrors inside
+    the shared tracker).  Suppress the registration during the constructor
+    call - the creator's own registration is the only one that should exist.
+    """
+    with _ATTACH_LOCK:
+        orig_register = resource_tracker.register
+
+        def _no_shm_register(rname, rtype):
+            if rtype != "shared_memory":
+                orig_register(rname, rtype)
+
+        resource_tracker.register = _no_shm_register
+        try:
+            return shared_memory.SharedMemory(name=name, create=False)
+        finally:
+            resource_tracker.register = orig_register
 
 
 class SharedArena:
@@ -82,23 +122,7 @@ class SharedArena:
 
     @classmethod
     def attach(cls, name: str) -> "SharedArena":
-        # python<3.13 registers even *attached* segments with the resource
-        # tracker, which would unlink the creator's segment when this process
-        # exits (and sending unregister instead races other attachers into
-        # KeyErrors inside the shared tracker).  Suppress the registration
-        # during the constructor call - the creator's own registration is the
-        # only one that should exist.
-        orig_register = resource_tracker.register
-
-        def _no_shm_register(rname, rtype):
-            if rtype != "shared_memory":
-                orig_register(rname, rtype)
-
-        resource_tracker.register = _no_shm_register
-        try:
-            shm = shared_memory.SharedMemory(name=name, create=False)
-        finally:
-            resource_tracker.register = orig_register
+        shm = attach_shared_memory(name)
         arena = cls(shm, owner=False)
         if not arena._lib.psa_check(arena._base):
             raise RuntimeError(f"shared arena {name!r} is not initialized")
@@ -115,6 +139,14 @@ class SharedArena:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    def disown(self) -> None:
+        """Give up unlink responsibility: ``close()`` will detach this
+        process's mapping but leave the named segment alive for other
+        attached processes (the warm-cache tier's lifecycle - the segment
+        outlives any single reader; the creator process's resource-tracker
+        registration still reclaims it at process exit)."""
+        self._owner = False
 
     def close(self) -> None:
         """Unmap (and unlink, if owner) the segment.  If zero-copy batch views

@@ -1,0 +1,17 @@
+"""jax version compatibility shims shared by the ops modules."""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(*args, **kwargs):
+        # the 0.4.x replication checker mis-types lax.cond branches (its own
+        # error names check_rep=False as the workaround; the top-level API's
+        # varying-manual-axes tracking fixed this class of false positive)
+        kwargs.setdefault("check_rep", False)
+        return _exp_shard_map(*args, **kwargs)

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from petastorm_tpu.ops._compat import shard_map as _shard_map
+
 
 def _full_attention(q, k, v, scale, causal):
     """Dense softmax attention, (B, H, S, D) all-local."""
@@ -84,8 +86,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
     spec = P(batch_axes, None, seq_axis, None)
     inner = functools.partial(ulysses_attention_sharded, axis_name=seq_axis,
                               causal=causal, scale=scale)
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    fn = _shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec)
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
     return fn(q, k, v)

@@ -730,8 +730,8 @@ def _launch_and_collect(phase: str, num_processes: int,
     """Shared launcher boilerplate: spawn the workers, wait, load their
     result JSONs.  Returns ``(report, workers)``; ``workers`` is None when
     the launch failed (``report['failures']``/``'timeout'`` say why)."""
-    report: Dict = {"ok": False, "timeout": False, "failures": [],
-                    "workdir": workdir}
+    report: Dict = {"ok": False, "timeout": False, "environment": False,
+                    "failures": [], "workdir": workdir}
     logs: List[str] = []
     report["logs"] = logs
     error = _launch(phase, num_processes, devices_per_process, dataset,
@@ -739,6 +739,7 @@ def _launch_and_collect(phase: str, num_processes: int,
     if error:
         report["failures"].append(error)
         report["timeout"] = "timed out" in error
+        report["environment"] = "environment-bound" in error
         return report, None
     workers = []
     prefix = result_prefix or phase
@@ -919,6 +920,31 @@ def _worker_env(devices_per_process: int) -> Dict[str, str]:
     return env
 
 
+#: worker-log markers of failures that are properties of the RUNTIME, not
+#: the data plane: this jax build simply cannot run the check here.  A
+#: worker exit matching one is reported "environment-bound" so callers
+#: (tests) can skip rather than fail - same contract as launcher timeouts.
+_ENV_BOUND_MARKERS = (
+    # jax 0.4.x CPU backend has no cross-process collective implementation
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "Unable to initialize backend",
+)
+
+
+def _environment_bound_reason(log_path: str) -> Optional[str]:
+    """The matching environment-bound marker line from a failed worker's
+    log, or None (a real failure)."""
+    try:
+        with open(log_path, errors="replace") as f:
+            tail = f.read()[-20000:]
+    except OSError:
+        return None
+    for marker in _ENV_BOUND_MARKERS:
+        if marker in tail:
+            return marker
+    return None
+
+
 def _launch(phase: str, num_processes: int, devices_per_process: int,
             dataset: str, out: str, timeout: float, logs: List[str],
             extra: Optional[List[str]] = None) -> Optional[str]:
@@ -957,6 +983,15 @@ def _launch(phase: str, num_processes: int, devices_per_process: int,
                 proc.kill()
                 proc.wait()
             log.close()
+    if error and "timed out" not in error:
+        # classify runtime-capability exits (e.g. a jax build whose CPU
+        # backend has no cross-process collectives) so callers can skip
+        for pid in range(num_processes):
+            reason = _environment_bound_reason(
+                os.path.join(out, f"{phase}_{pid}.log"))
+            if reason is not None:
+                return (f"{phase}: environment-bound: {reason}"
+                        f" (worker {pid})")
     return error
 
 
@@ -1001,7 +1036,8 @@ def run_selfcheck(num_processes: int = 2,
                        for i in range(total_rows)],
                       row_group_size_rows=local_rows)
 
-    report: Dict = {"ok": False, "timeout": False, "failures": [],
+    report: Dict = {"ok": False, "timeout": False, "environment": False,
+                    "failures": [],
                     "workdir": workdir, "num_processes": num_processes,
                     "devices_per_process": devices_per_process,
                     "global_batch": global_batch, "n_batches": n_batches}
@@ -1025,6 +1061,7 @@ def run_selfcheck(num_processes: int = 2,
         if error:
             failures.append(error)
             report["timeout"] = "timed out" in error
+            report["environment"] = "environment-bound" in error
             return report
         workers = []
         for pid in range(num_processes):
@@ -1138,6 +1175,8 @@ def run_selfcheck(num_processes: int = 2,
         if error:
             failures.append(error)
             report["timeout"] = report["timeout"] or "timed out" in error
+            report["environment"] = (report.get("environment", False)
+                                     or "environment-bound" in error)
             return report
         resumed: List[int] = []
         for pid in range(resume_processes):

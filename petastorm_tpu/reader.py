@@ -148,6 +148,20 @@ def make_reader(dataset_url: str,
     has shape ``(h, w[, C])``.  Output is byte-identical to slicing a full
     decode.
 
+    ``cache_type``: decoded-rowgroup cache (docs/operations.md "Warm
+    cache").  ``'null'`` (default) decodes every read; ``'memory'`` /
+    ``'local-disk'`` are per-reader tiers (reference parity);
+    ``'shared'`` is the HOST-WIDE warm tier (petastorm_tpu.cache_shared):
+    decoded rowgroups live as columns in a shared-memory arena keyed by
+    (dataset fingerprint, rowgroup, schema/transform/ROI/split signature),
+    hit by every worker, epoch, reader and job on the host, backed by a
+    bounded disk tier that survives restarts.  ``cache_location`` names the
+    tier (same location = same tier host-wide) and the disk directory;
+    ``cache_size_limit`` sizes the shared-memory arena.  Composes with the
+    process pool and its zero-copy batch-slot decode; hit/miss/eviction
+    rates ride the ``cache.*`` telemetry series, and an armed autotune
+    controller trades cache memory against worker count live.
+
     ``io_retries``: transient remote-IO policy (petastorm_tpu.retry).
     ``'auto'`` = bounded retry-with-backoff on remote filesystems (GCS/S3/
     HDFS/fsspec), off for local paths; an int sets the attempt budget; a
@@ -526,12 +540,14 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                         shuffle_row_drop_partitions=shuffle_row_drop_partitions,
                         shard_mode=shard_mode)
 
-    cache = make_cache(cache_type, cache_location, cache_size_limit,
-                       telemetry=telemetry)
     # cache+predicate is disallowed (reference py_dict_reader_worker.py:145-150);
-    # cache+row-drop is fine here because cache keys include the row slice
+    # cache+row-drop is fine here because cache keys include the row slice.
+    # Refuse BEFORE make_cache: a 'shared' cache creates host-wide shm
+    # segments + disk dirs at construction, which a raised refusal would leak
     if cache_type not in (None, "null", "none") and worker_predicate is not None:
         raise PetastormTpuError("cache_type cannot be combined with a predicate")
+    cache = make_cache(cache_type, cache_location, cache_size_limit,
+                       telemetry=telemetry)
 
     read_fields = [f.name for f in view]
     fs_factory = FilesystemFactory(dataset_url if isinstance(dataset_url, str)
@@ -650,6 +666,21 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     #: split cell's value when the rowgroup decoded
     reader.device_decode_split = split_fields
     reader._decode_split_cell = decode_split_cell
+    from petastorm_tpu.cache_shared import SharedWarmCache
+
+    if isinstance(cache, SharedWarmCache):
+        # the reader is the tier's telemetry publisher (cache.* series) and
+        # surfaces tier stats in diagnostics; the tier itself is host-wide
+        reader.warm_cache = cache
+        if reader.autotune is not None and cache.l1_enabled:
+            # the memory-vs-worker-count trade becomes a live knob: the L1
+            # residency cap (MB) rides the same starved/blocked signals as
+            # the structural knobs (docs/operations.md "Warm cache")
+            mb = 2 ** 20
+            reader.autotune.attach_cache_memory(
+                get=lambda: max(1, cache.get_target_bytes() // mb),
+                set_=lambda n: cache.set_target_bytes(n * mb) // mb,
+                lo_mb=16, hi_mb=max(16, int(0.8 * cache.l1_size_bytes) // mb))
     if decode_split_cell is not None and reader.autotune is not None:
         # the split becomes a live autotune knob: starved consumers push
         # decode work off the host (toward device), consumer-bound pipelines
@@ -912,6 +943,12 @@ class Reader:
         self.device_decode_split: frozenset = frozenset()
         #: shared split cell (set by make_reader when 'auto' fields exist)
         self._decode_split_cell = None
+        #: the host-wide shared warm-cache tier (petastorm_tpu.cache_shared;
+        #: set by make_reader for cache_type='shared').  The reader is the
+        #: tier's telemetry publisher: shared-header counter deltas fold into
+        #: this registry as the cache.* series on the consume path
+        self.warm_cache = None
+        self._cache_publish_at = 0.0
 
         self._start_item = start_item
         self._consumed_items = 0
@@ -1168,6 +1205,8 @@ class Reader:
                 self._m_batches.add(1)
                 self._m_rows_emitted.add(batch.num_rows)
             last_progress = time.monotonic()
+            if self.warm_cache is not None:
+                self._maybe_publish_cache(last_progress)
             self._account_consumed(batch.ordinal)
             if batch.num_rows > 0:
                 if self.batched_output and self._all_items_consumed():
@@ -1189,6 +1228,19 @@ class Reader:
             while self._prefix in self._consumed_ordinals:
                 self._consumed_ordinals.discard(self._prefix)
                 self._prefix += 1
+
+    def _maybe_publish_cache(self, now: float) -> None:
+        """Fold the shared warm tier's cross-process counters into this
+        reader's telemetry as the ``cache.*`` series (time-gated: the shared
+        index lock must not be taken per batch).  One publisher per reader -
+        workers only bump the shared header, so nothing double-counts."""
+        if now - self._cache_publish_at < 0.5:
+            return
+        self._cache_publish_at = now
+        try:
+            self.warm_cache.publish_telemetry()
+        except Exception:  # noqa: BLE001 - observability must not break reads
+            logger.debug("warm-cache telemetry publish failed", exc_info=True)
 
     # -- flight recorder (docs/operations.md "Live monitoring") ---------------
 
@@ -1373,6 +1425,14 @@ class Reader:
         its counters just because nobody held the ``Telemetry`` object.
         """
         self._stopped = True
+        if self.warm_cache is not None:
+            # final fold BEFORE the observability close latches the final
+            # telemetry snapshot: a short run's cache.* activity must not
+            # be lost to the 0.5s publish gate
+            try:
+                self.warm_cache.publish_telemetry()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.debug("final warm-cache publish failed", exc_info=True)
         if self.autotune is not None:
             # controller before executor: a tuning tick landing mid-close
             # must not resize a stopped pool (a process-pool grow would
@@ -1506,6 +1566,13 @@ class Reader:
                           "build_command": _native_image.BUILD_COMMAND}
         if self._decode_split_cell is not None:
             diag["decode_split"] = self.decode_split
+        if self.warm_cache is not None:
+            # host-wide tier state: hit/miss/eviction ledger, resident bytes
+            # vs target, entry count (petastorm_tpu.cache_shared)
+            try:
+                diag["cache"] = self.warm_cache.stats()
+            except Exception:  # noqa: BLE001 - diagnostics must not raise
+                logger.debug("warm-cache stats failed", exc_info=True)
         if self.circuit_breaker is not None:
             diag["circuit_breaker"] = self.circuit_breaker.snapshot()
         if self.autotune is not None:

@@ -128,6 +128,17 @@ def build_parser() -> argparse.ArgumentParser:
                              " results-queue bound adapt to the live metrics"
                              " sampler; the report lists every decision and"
                              " --watch frames show the autotune.* counters")
+    parser.add_argument("--cache-type", default="null",
+                        choices=("null", "memory", "local-disk", "shared"),
+                        help="decoded-rowgroup cache (docs/operations.md"
+                             " 'Warm cache'); 'shared' = the host-wide warm"
+                             " tier - --watch then renders a live cache:"
+                             " hit/miss/hit-rate line, and re-running the"
+                             " command shows the warm profile")
+    parser.add_argument("--cache-location", default=None, metavar="PATH",
+                        help="names the cache tier (same location = same"
+                             " shared tier host-wide; also the disk"
+                             " directory)")
     return parser
 
 
@@ -143,6 +154,8 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                   flight_record_path: Optional[str] = None,
                   sample_interval_s: Optional[float] = None,
                   autotune=False,
+                  cache_type: str = "null",
+                  cache_location: Optional[str] = None,
                   on_reader=None) -> dict:
     """Read ``dataset_url`` with telemetry enabled; returns a result dict
     with ``rows``, ``batches``, ``snapshot``, ``report``,
@@ -174,6 +187,7 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                  metrics_port=metrics_port,
                  flight_record_path=flight_record_path,
                  sample_interval_s=sample_interval_s,
+                 cache_type=cache_type, cache_location=cache_location,
                  autotune=autotune or None) as reader:
         if on_reader is not None:
             on_reader(reader)
@@ -314,6 +328,18 @@ def render_watch_frame(point: Dict, diagnostics: Optional[Dict] = None,
     if depth_parts:
         lines.append("queue depths: " + "  ".join(depth_parts))
     counters = point.get("counters", {})
+    if counters.get("cache.hits") or counters.get("cache.misses") \
+            or counters.get("cache.l2_hits"):
+        # the shared warm tier's pulse: per-interval hit/miss rates, the
+        # cumulative hit-rate gauge, resident L1 bytes and eviction total
+        hit_rate = gauges.get("cache.hit_rate", 0.0)
+        lines.append(
+            f"cache: {rates.get('cache.hits', 0.0):6.1f} hit/s"
+            f"  {rates.get('cache.misses', 0.0):6.1f} miss/s"
+            f"  {rates.get('cache.l2_hits', 0.0):5.1f} l2hit/s"
+            f"  hit-rate {100.0 * hit_rate:5.1f}%"
+            f"  L1 {gauges.get('cache.bytes', 0.0) / 2 ** 20:.0f}MB"
+            f"  evictions {counters.get('cache.evictions', 0):g}")
     faults = {n: v for n, v in counters.items()
               if n.startswith(_WATCH_FAULT_PREFIXES) and v}
     if faults:
@@ -361,6 +387,8 @@ def _watch(args, url: str, chaos) -> int:
                 flight_record_path=args.flight_record,
                 sample_interval_s=args.interval,
                 autotune=args.autotune,
+                cache_type=args.cache_type,
+                cache_location=args.cache_location,
                 on_reader=lambda r: reader_box.update(reader=r))
         except BaseException as exc:  # noqa: BLE001 - reported on main thread
             box["error"] = exc
@@ -533,7 +561,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                metrics_port=args.metrics_port,
                                flight_record_path=args.flight_record,
                                sample_interval_s=args.interval,
-                               autotune=args.autotune)
+                               autotune=args.autotune,
+                               cache_type=args.cache_type,
+                               cache_location=args.cache_location)
         if args.trace_out:
             result["telemetry"].export_chrome_trace(args.trace_out)
         if args.json:

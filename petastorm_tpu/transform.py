@@ -47,6 +47,62 @@ class TransformSpec:
         return out
 
 
+def _hash_code_object(code, update) -> None:
+    """Feed a code object's CONTENT (bytecode, names, stable const tokens,
+    nested code objects recursively) into ``update``.  repr() of a code
+    object embeds its memory address and repr() of a set is
+    hash-randomization-ordered - both would make the digest differ between
+    interpreters, silently defeating cross-process cache sharing."""
+    import types
+
+    update(code.co_code)
+    update(repr(code.co_names).encode())
+    update(repr(code.co_varnames).encode())
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            _hash_code_object(const, update)
+        elif isinstance(const, frozenset):
+            update(("frozenset:"
+                    + ",".join(sorted(map(repr, const)))).encode())
+        else:
+            update(repr(const).encode())
+
+
+def transform_signature(spec: Optional["TransformSpec"]) -> str:
+    """Short content signature of a transform, for shared-cache keys.
+
+    Two readers sharing the host-wide warm tier must never trade entries
+    across DIFFERENT transforms (docs/operations.md "Warm cache"), so the
+    cache key carries this digest.  The function half hashes the compiled
+    bytecode + constants (recursively through nested code objects, so the
+    digest is stable ACROSS interpreters - editing the function body changes
+    the key, restarting the process does not) and degrades to the qualified
+    name; the schema-edit half hashes the declared field edits.  Best-effort
+    by design: a closure over changed external state is not detectable -
+    documented operator caveat.
+    """
+    if spec is None:
+        return "-"
+    import hashlib
+
+    digest = hashlib.md5()
+    func = getattr(spec, "func", None)
+    if func is not None:
+        # plain function, or a callable object's __call__ (its configuring
+        # instance state falls under the documented closure caveat)
+        code = getattr(func, "__code__", None) or getattr(
+            getattr(func, "__call__", None), "__code__", None)
+        if code is not None:
+            _hash_code_object(code, digest.update)
+        digest.update((f"{getattr(func, '__module__', '')}."
+                       f"{getattr(func, '__qualname__', '')}."
+                       f"{type(func).__qualname__}").encode())
+    digest.update(repr(getattr(spec, "edit_fields", None)).encode())
+    digest.update(repr(getattr(spec, "removed_fields", None)).encode())
+    digest.update(repr(getattr(spec, "selected_fields", None)).encode())
+    return digest.hexdigest()[:12]
+
+
 def transform_schema(schema: Schema, spec: TransformSpec) -> Schema:
     """Derive the post-transform schema (reference: transform.py:60-89)."""
     fields = list(schema)

@@ -114,10 +114,20 @@ class RowGroupDecoderWorker:
         #: inherit the multiprocessing.Value through Process args.
         self._split_fields = frozenset(split_fields)
         self._decode_split = decode_split
-        #: arena batch-slot decode is only safe when no cache retains the
-        #: decoded batch beyond delivery (a cached arena view would dangle
-        #: after the consumer frees the slot)
-        self._allow_batch_slots = isinstance(self._cache, NullCache)
+        #: arena batch-slot decode is only safe when the cache never retains
+        #: REFERENCES to the decoded batch beyond delivery (a cached arena
+        #: view would dangle after the consumer frees the slot).  Every
+        #: in-tree cache stores copies / serialized bytes and declares so
+        #: (CacheBase.retains_value_references) - notably the shared warm
+        #: tier, which composes with slot decode instead of disabling it;
+        #: unknown third-party caches keep the conservative default.
+        self._allow_batch_slots = not getattr(
+            self._cache, "retains_value_references", True)
+        self._cache_is_null = isinstance(self._cache, NullCache)
+        #: per-file (size, mtime) fingerprints for cache keys - a dataset
+        #: rewritten in place must never serve stale warm-tier entries.
+        #: Plain dict: GIL-atomic set; a racing duplicate stat is benign.
+        self._file_fps: Dict[str, str] = {}
 
     # -- factory protocol -----------------------------------------------------
 
@@ -196,7 +206,7 @@ class RowGroupDecoderWorker:
 
                 stats_before = native_image.decode_stats()
             batch = retry_call(
-                lambda: self._process(_parquet_file, item),
+                lambda: self._process(_parquet_file, item, fs),
                 self._retry_policy,
                 what=f"rowgroup {item.row_group.path}"
                      f"#{item.row_group.row_group}",
@@ -232,7 +242,7 @@ class RowGroupDecoderWorker:
 
     # -- hot path -------------------------------------------------------------
 
-    def _process(self, parquet_file, item: WorkItem) -> ColumnBatch:
+    def _process(self, parquet_file, item: WorkItem, fs=None) -> ColumnBatch:
         anchor = None
         row_range = None
         if self._ngram is not None:
@@ -262,7 +272,7 @@ class RowGroupDecoderWorker:
             # key covers the rows ACTUALLY loaded (incl. ngram lookahead), so
             # readers with different ngram lengths never share an entry
             span = row_range if row_range is not None else load_item.row_slice()
-            key = self._cache_key(load_item, span)
+            key = self._cache_key(load_item, span, fs)
             with decode_stage:
                 batch = self._cache.get(key, lambda: self._load(
                     parquet_file, load_item, self._read_fields,
@@ -286,8 +296,28 @@ class RowGroupDecoderWorker:
                                                  anchor_range=anchor)
         return batch
 
-    def _cache_key(self, item: WorkItem, span: tuple) -> str:
+    def _file_fingerprint(self, path: str, fs) -> str:
+        """(size, mtime) fingerprint of a dataset file, memoized per path -
+        the content-address component of shared-tier cache keys (a file
+        rewritten in place changes the key, so no reader on the host can be
+        served the OLD decode).  '-' for NullCache readers (no key is ever
+        used) and when the filesystem cannot answer."""
+        if self._cache_is_null or fs is None:
+            return "-"
+        fp = self._file_fps.get(path)
+        if fp is None:
+            try:
+                info = fs.get_file_info(path)
+                fp = f"{info.size}:{info.mtime_ns}"
+            except Exception:  # noqa: BLE001 - fingerprint is best-effort
+                fp = "?"
+            self._file_fps[path] = fp
+        return fp
+
+    def _cache_key(self, item: WorkItem, span: tuple, fs=None) -> str:
         start, stop = span
+        from petastorm_tpu.transform import transform_signature
+
         # 'rawcoef1' versions the stored form of raw/device fields (coefficient
         # plane columns); bump it whenever that format changes, or a warm
         # persistent cache from an older version poisons the pipeline
@@ -298,10 +328,17 @@ class RowGroupDecoderWorker:
                # a cached batch; key them so a mode flip never serves stale
                + "|split:" + ("-" if self._decode_split is None
                               else str(int(self._decode_split.value)))
-               + "|roi:" + repr(sorted(self._decode_roi.items())))
+               + "|roi:" + repr(sorted(self._decode_roi.items()))
+               # the cached value is the PRE-transform decode, but the key
+               # carries the transform signature anyway: the warm tier is
+               # shared across jobs, and cross-transform sharing is not worth
+               # the blast radius of a signature collision serving job B a
+               # batch decoded under job A's settings (ISSUE 7 satellite)
+               + "|tf:" + transform_signature(self._transform))
         fields_tag = hashlib.md5(tag.encode()).hexdigest()[:8]
+        fp = self._file_fingerprint(item.row_group.path, fs)
         return (f"{self._cache_prefix}:{item.row_group.path}:{item.row_group.row_group}"
-                f":{start}:{stop}:{fields_tag}")
+                f":{start}:{stop}:{fields_tag}:{fp}")
 
     def _apply_transform(self, batch: ColumnBatch) -> ColumnBatch:
         if self._transform is None:

@@ -29,12 +29,24 @@ import pytest
 from petastorm_tpu.parallel.selfcheck import run_selfcheck
 
 
+def _skip_if_unrunnable(report, what):
+    """Skip (never fail) on the two environment-style launch outcomes: a
+    launcher timeout (hang vs glacial box, indistinguishable from here) and
+    an environment-bound worker exit (this jax build cannot run the check at
+    all, e.g. a CPU backend without cross-process collectives - selfcheck
+    classifies worker logs against known markers)."""
+    if report["timeout"]:
+        pytest.skip(f"{what} timed out: {report['failures']}")
+    if report.get("environment"):
+        pytest.skip(f"environment-bound: {report['failures']}")
+
+
+
 def test_multiprocess_data_plane(tmp_path):
     report = run_selfcheck(num_processes=2, devices_per_process=2,
                            global_batch=8, n_batches=28, resume_processes=3,
                            workdir=str(tmp_path), timeout=300.0)
-    if report["timeout"]:
-        pytest.skip(f"multi-process selfcheck timed out: {report['failures']}")
+    _skip_if_unrunnable(report, "multi-process selfcheck")
     assert report["ok"], report["failures"]
     # both phases moved real data
     assert report["consumed_rows"] > 0
@@ -59,8 +71,7 @@ def test_multiprocess_shuffled_stacked(tmp_path):
 
     report = run_shuffled_check(num_processes=4, devices_per_process=2,
                                 workdir=str(tmp_path), timeout=360.0)
-    if report["timeout"]:
-        pytest.skip(f"shuffled check timed out: {report['failures']}")
+    _skip_if_unrunnable(report, "shuffled check")
     assert report["ok"], report["failures"]
     assert report["units"] >= 8
     assert report["rho_global"] < 0.5
@@ -79,8 +90,7 @@ def test_multiprocess_mixed_decode(tmp_path):
 
     report = run_mixed_check(num_processes=2, devices_per_process=4,
                              workdir=str(tmp_path), timeout=300.0)
-    if report["timeout"]:
-        pytest.skip(f"mixed check timed out: {report['failures']}")
+    _skip_if_unrunnable(report, "mixed check")
     assert report["ok"], report["failures"]
     assert report["max_pixel_err"] <= 6
     assert all(g.get("image", 0) <= 2 for g in report["geometries_per_host"])
@@ -96,8 +106,7 @@ def test_multiprocess_context_parallel(tmp_path):
     report = run_context_parallel_check(num_processes=2,
                                         devices_per_process=2,
                                         workdir=str(tmp_path), timeout=240.0)
-    if report["timeout"]:
-        pytest.skip(f"context-parallel check timed out: {report['failures']}")
+    _skip_if_unrunnable(report, "context-parallel check")
     assert report["ok"], report["failures"]
     assert report["err_ring"] < 2e-4
     assert report["err_uly"] < 2e-4
@@ -112,8 +121,7 @@ def test_multiprocess_distributed_write(tmp_path):
 
     report = run_distributed_write_check(num_processes=2,
                                          workdir=str(tmp_path), timeout=240.0)
-    if report["timeout"]:
-        pytest.skip(f"distributed-write check timed out: {report['failures']}")
+    _skip_if_unrunnable(report, "distributed-write check")
     assert report["ok"], report["failures"]
     assert report["rows_read"] == 64
     assert all(n > 0 for n in report["files_per_host"])
@@ -128,7 +136,6 @@ def test_multiprocess_2d_mesh_dp_x_tp(tmp_path):
 
     report = run_mesh2d_check(num_processes=2, devices_per_process=2,
                               workdir=str(tmp_path), timeout=240.0)
-    if report["timeout"]:
-        pytest.skip(f"2-D mesh check timed out: {report['failures']}")
+    _skip_if_unrunnable(report, "2-D mesh check")
     assert report["ok"], report["failures"]
     assert report["mesh"] == {"data": 2, "model": 2}

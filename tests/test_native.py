@@ -4,6 +4,8 @@ Reference parity: workers_pool/tests/test_workers_pool.py exercises the zmq
 data plane in both copy modes; here the native arena replaces zmq.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -11,6 +13,14 @@ from petastorm_tpu.batch import ColumnBatch
 
 native = pytest.importorskip("petastorm_tpu.native")
 if not native.is_available():
+    if os.environ.get("PETASTORM_TPU_REQUIRE_ARENA"):
+        # the CI py312 job sets this: on a runtime that SHOULD have the
+        # arena plane, a silent skip hides a broken .so (it did for a whole
+        # PR cycle - CHANGES.md PR 6); fail loudly instead
+        raise RuntimeError(
+            "PETASTORM_TPU_REQUIRE_ARENA=1 but the shm arena plane is"
+            " unavailable on this runtime (python >= 3.12 + buildable"
+            " native lib expected; see petastorm_tpu.native.is_available)")
     pytest.skip("native toolchain unavailable", allow_module_level=True)
 
 from petastorm_tpu.native import SharedArena  # noqa: E402

@@ -1,0 +1,837 @@
+"""Shared warm-cache tier tests (ISSUE 7): the content-addressed
+host-wide L1 (shm arena + shm index) / L2 (disk) cache, its cache-key
+correctness contract (changing transform / ROI / placement / schema
+selection / file content must change the key), the cross-reader e2e
+(reader B's first epoch hits entries reader A decoded, with ZERO additional
+rowgroup decodes), L2 survival of an L1 wipe, slot-decode composition,
+telemetry publishing, the autotune cache-memory knob, and the hardened
+LocalDiskCache under concurrent multi-process eviction."""
+
+import os
+import pickle
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.cache import (InMemoryCache, LocalDiskCache, NullCache,
+                                 _MISSING, make_cache)
+from petastorm_tpu.cache_shared import (DEFAULT_SLOTS, SharedWarmCache,
+                                        STALE_PIN_S)
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.synthetic import synthetic_rgb_image
+from petastorm_tpu.transform import TransformSpec, transform_signature
+
+
+def _arena_ok() -> bool:
+    from petastorm_tpu.native import allocator_available
+
+    return allocator_available()
+
+
+needs_arena = pytest.mark.skipif(
+    not _arena_ok() and not os.environ.get("PETASTORM_TPU_REQUIRE_ARENA"),
+    reason="native shm_arena library unavailable")
+
+
+@pytest.fixture
+def tier(tmp_path):
+    cache = SharedWarmCache(location=str(tmp_path / "tier"),
+                            l1_bytes=16 * 2 ** 20)
+    yield cache
+    cache.cleanup()
+
+
+def _batch(n=64, seed=0, extra=None):
+    rng = np.random.default_rng(seed)
+    cols = {"x": np.arange(n, dtype=np.int64),
+            "img": rng.integers(0, 255, (n, 8, 8, 3), dtype=np.uint8)}
+    s = np.empty(n, dtype=object)
+    s[:] = [f"row{i}" for i in range(n)]
+    cols["s"] = s
+    if extra:
+        cols.update(extra)
+    return ColumnBatch(cols, n)
+
+
+# -- L1 roundtrip -------------------------------------------------------------
+
+@needs_arena
+def test_roundtrip_hit_preserves_types_and_isolation(tier):
+    batch = _batch()
+    calls = []
+    v1 = tier.get("k", lambda: calls.append(1) or batch)
+    v2 = tier.get("k", lambda: calls.append(1) or batch)
+    assert calls == [1]
+    assert v1 is batch                      # the fill's value passes through
+    np.testing.assert_array_equal(v2.columns["x"], batch.columns["x"])
+    np.testing.assert_array_equal(v2.columns["img"], batch.columns["img"])
+    assert list(v2.columns["s"]) == list(batch.columns["s"])
+    assert v2.columns["s"].dtype == object
+    # served arrays are private: a consumer mutating them in place must not
+    # corrupt the tier
+    v2.columns["img"][:] = 0
+    v3 = tier.get("k", lambda: calls.append(1) or batch)
+    np.testing.assert_array_equal(v3.columns["img"], batch.columns["img"])
+    assert calls == [1]
+    stats = tier.stats()
+    assert stats["hits"] == 2 and stats["misses"] == 1
+    assert stats["entries"] == 1 and stats["bytes"] > 0
+
+
+@needs_arena
+def test_non_columnbatch_values_roundtrip(tier):
+    value = {"arbitrary": [1, 2, 3]}
+    assert tier.get("v", lambda: value) == value
+    assert tier.get("v", lambda: pytest.fail("should hit")) == value
+
+
+@needs_arena
+def test_cross_instance_hit_same_namespace(tier, tmp_path):
+    batch = _batch()
+    tier.get("shared-key", lambda: batch)
+    other = SharedWarmCache(location=str(tmp_path / "tier"))
+    try:
+        got = other.get("shared-key", lambda: pytest.fail("should hit"))
+        np.testing.assert_array_equal(got.columns["img"],
+                                      batch.columns["img"])
+    finally:
+        other.close()
+
+
+@needs_arena
+def test_pickled_copy_reattaches_and_hits(tier):
+    batch = _batch()
+    tier.get("p", lambda: batch)
+    clone = pickle.loads(pickle.dumps(tier))
+    try:
+        got = clone.get("p", lambda: pytest.fail("should hit"))
+        np.testing.assert_array_equal(got.columns["x"], batch.columns["x"])
+    finally:
+        clone.close()
+
+
+# -- eviction / pinning -------------------------------------------------------
+
+@needs_arena
+def test_lru_eviction_under_pressure(tmp_path):
+    cache = SharedWarmCache(location=str(tmp_path / "small"),
+                            l1_bytes=4 * 2 ** 20, l2_enabled=False)
+    try:
+        big = _batch(n=256, seed=1)   # ~50KB payload each
+        for i in range(200):
+            cache.get(f"k{i}", lambda: big)
+        stats = cache.stats()
+        assert stats["evictions"] > 0
+        assert stats["bytes"] <= stats["target_bytes"]
+        # the NEWEST entry survived; the oldest was evicted (LRU order)
+        assert cache._l1_lookup("k199") is not _MISSING  # noqa: SLF001
+        assert cache._l1_lookup("k0") is _MISSING        # noqa: SLF001
+    finally:
+        cache.cleanup()
+
+
+@needs_arena
+def test_pinned_entries_survive_eviction_stale_pins_do_not(tmp_path):
+    cache = SharedWarmCache(location=str(tmp_path / "pins"),
+                            l1_bytes=4 * 2 ** 20, l2_enabled=False)
+    try:
+        cache.get("pinned", lambda: _batch(n=256))
+        s = cache._slots_arr  # noqa: SLF001 - white-box pin surgery
+        i = cache._find(*__import__("petastorm_tpu.cache_shared",
+                                    fromlist=["_digest_pair"])
+                        ._digest_pair("pinned"))  # noqa: SLF001
+        s["pins"][i] = 1
+        s["pin_wall"][i] = time.time()       # live pin
+        big = _batch(n=256, seed=2)
+        for j in range(200):
+            cache.get(f"f{j}", lambda: big)
+        assert cache.stats()["evictions"] > 0
+        assert cache._l1_lookup("pinned") is not _MISSING  # noqa: SLF001
+        # age the pin past the crash threshold: now evictable
+        j = cache._find(*__import__("petastorm_tpu.cache_shared",
+                                    fromlist=["_digest_pair"])
+                        ._digest_pair("pinned"))  # noqa: SLF001
+        s["pins"][j] = 1
+        s["pin_wall"][j] = time.time() - STALE_PIN_S - 1
+        for j in range(200, 400):
+            cache.get(f"f{j}", lambda: big)
+        assert cache._l1_lookup("pinned") is _MISSING  # noqa: SLF001
+    finally:
+        del s  # release the test's view so the segment can unmap cleanly
+        cache.cleanup()
+
+
+@needs_arena
+def test_set_target_bytes_shrinks_residency(tmp_path):
+    cache = SharedWarmCache(location=str(tmp_path / "target"),
+                            l1_bytes=8 * 2 ** 20, l2_enabled=False)
+    try:
+        for i in range(20):
+            cache.get(f"k{i}", lambda: _batch(n=256, seed=i))
+        before = cache.stats()["bytes"]
+        assert before > 2 ** 20
+        clamped = cache.set_target_bytes(2 ** 20)
+        assert clamped == 2 ** 20
+        assert cache.stats()["bytes"] <= 2 ** 20
+        # clamp floor and ceiling
+        assert cache.set_target_bytes(1) == 2 ** 20
+        assert cache.set_target_bytes(2 ** 60) <= int(0.8 * 8 * 2 ** 20)
+    finally:
+        cache.cleanup()
+
+
+@needs_arena
+def test_oversize_entry_rejected_not_stored(tmp_path):
+    cache = SharedWarmCache(location=str(tmp_path / "oversize"),
+                            l1_bytes=2 * 2 ** 20, l2_enabled=False)
+    try:
+        huge = ColumnBatch(
+            {"b": np.zeros((4, 2 ** 20), dtype=np.uint8)}, 4)  # 4MB > arena/2
+        calls = []
+        cache.get("huge", lambda: calls.append(1) or huge)
+        cache.get("huge", lambda: calls.append(1) or huge)
+        assert calls == [1, 1]              # served uncached, both times
+        assert cache.stats()["rejected_stores"] >= 1
+    finally:
+        cache.cleanup()
+
+
+# -- L2 tier ------------------------------------------------------------------
+
+@needs_arena
+def test_l2_survives_l1_wipe_and_promotes_back(tmp_path):
+    loc = str(tmp_path / "t2")
+    cache = SharedWarmCache(location=loc, l1_bytes=16 * 2 ** 20)
+    batch = _batch()
+    cache.get("persist", lambda: batch)
+    # simulate a host losing its shared memory (reboot / segment purge)
+    # while the disk tier survives
+    from petastorm_tpu.native import attach_shared_memory
+
+    cache.close()
+    for name in (cache._arena_name, cache._index_name):  # noqa: SLF001
+        seg = attach_shared_memory(name)
+        seg.unlink()
+        seg.close()
+    fresh = SharedWarmCache(location=loc, l1_bytes=16 * 2 ** 20)
+    try:
+        got = fresh.get("persist", lambda: pytest.fail("L2 must hit"))
+        np.testing.assert_array_equal(got.columns["img"],
+                                      batch.columns["img"])
+        stats = fresh.stats()
+        assert stats["l2_hits"] == 1
+        # the L2 hit was PROMOTED into L1: the next get is an L1 hit
+        fresh.get("persist", lambda: pytest.fail("should hit"))
+        assert fresh.stats()["hits"] == 1
+    finally:
+        fresh.cleanup()
+
+
+@needs_arena
+def test_orphaned_uninitialized_index_is_adopted(tmp_path):
+    """A creator dying between index-create and magic-set must not
+    permanently poison the namespace: the next attacher (holding the init
+    lock with no magic visible) adopts and initializes the orphan."""
+    from multiprocessing import shared_memory
+
+    from petastorm_tpu.cache_shared import (_HEADER_DTYPE, _SLOT_DTYPE,
+                                            SharedWarmCache)
+
+    probe = SharedWarmCache(location=str(tmp_path / "orph"), l2_enabled=False)
+    index_name = probe._index_name  # noqa: SLF001
+    probe.cleanup()
+    size = _HEADER_DTYPE.itemsize + DEFAULT_SLOTS * _SLOT_DTYPE.itemsize
+    orphan = shared_memory.SharedMemory(name=index_name, create=True,
+                                        size=size)  # zeroed: no magic
+    try:
+        cache = SharedWarmCache(location=str(tmp_path / "orph"),
+                                l2_enabled=False)
+        try:
+            assert cache.l1_enabled
+            batch = _batch()
+            cache.get("k", lambda: batch)
+            got = cache.get("k", lambda: pytest.fail("should hit"))
+            np.testing.assert_array_equal(got.columns["x"],
+                                          batch.columns["x"])
+        finally:
+            cache.cleanup()
+    finally:
+        try:
+            orphan.close()
+        except BufferError:
+            pass
+
+
+def test_l2_only_degrade_when_arena_unavailable(tmp_path, monkeypatch):
+    import petastorm_tpu.native as native
+
+    monkeypatch.setattr(native, "allocator_available", lambda: False)
+    cache = SharedWarmCache(location=str(tmp_path / "deg"))
+    try:
+        assert not cache.l1_enabled
+        assert cache.stats() == {"l1_enabled": False, "l2_enabled": True,
+                                 "location": str(tmp_path / "deg")}
+        batch = _batch()
+        calls = []
+        cache.get("k", lambda: calls.append(1) or batch)
+        got = cache.get("k", lambda: calls.append(1) or batch)
+        assert calls == [1]                 # disk tier still serves
+        np.testing.assert_array_equal(got.columns["x"], batch.columns["x"])
+    finally:
+        cache.cleanup()
+
+
+# -- cache-key correctness (no stale-hit cross-contamination) -----------------
+
+def _key_worker(tmp_path, cache, **kwargs):
+    from petastorm_tpu.worker import RowGroupDecoderWorker
+
+    class _Factory:
+        url = "file:///ds"
+
+        def __call__(self):
+            raise AssertionError("never opened in key tests")
+
+    schema = Schema("K", [Field("x", np.int64, (), ScalarCodec()),
+                          Field("image", np.uint8, (32, 32, 3),
+                                CompressedImageCodec("jpeg"))])
+    defaults = dict(read_fields=["x", "image"])
+    defaults.update(kwargs)
+    return RowGroupDecoderWorker(_Factory(), schema, cache=cache, **defaults)
+
+
+def _item(path="/ds/part0.parquet"):
+    from petastorm_tpu.etl.metadata import RowGroupRef
+    from petastorm_tpu.plan import WorkItem
+
+    return WorkItem(RowGroupRef(path=path, row_group=0, num_rows=10,
+                                global_index=0))
+
+
+def test_cache_key_changes_with_every_signature_dimension(tmp_path):
+    cache = InMemoryCache()
+    base = _key_worker(tmp_path, cache)
+    key = base._cache_key(_item(), (0, 10))  # noqa: SLF001
+
+    # identical settings -> identical key (two readers SHARE)
+    again = _key_worker(tmp_path, cache)
+    assert again._cache_key(_item(), (0, 10)) == key  # noqa: SLF001
+
+    variants = {
+        "schema selection": _key_worker(tmp_path, cache,
+                                        read_fields=["image"]),
+        "transform": _key_worker(
+            tmp_path, cache,
+            transform=TransformSpec(lambda c: {k: v * 2
+                                               for k, v in c.items()})),
+        "decode_roi": _key_worker(tmp_path, cache,
+                                  decode_roi={"image": (0, 0, 16, 16)}),
+        "decode_placement": _key_worker(tmp_path, cache,
+                                        raw_fields=["image"]),
+        "mixed placement": _key_worker(tmp_path, cache,
+                                       mixed_raw_fields=["image"]),
+    }
+    keys = {name: w._cache_key(_item(), (0, 10))  # noqa: SLF001
+            for name, w in variants.items()}
+    for name, k in keys.items():
+        assert k != key, f"{name} did not change the cache key"
+    assert len(set(keys.values())) == len(keys), "variant keys collide"
+    # row span is in the key (ngram lookahead contract)
+    assert base._cache_key(_item(), (0, 12)) != key  # noqa: SLF001
+
+
+def test_transform_signature_stable_across_interpreters(tmp_path):
+    """The signature must hash code CONTENT, not reprs embedding memory
+    addresses / hash-randomized set ordering: a per-process digest would
+    silently defeat cross-job and restart sharing for any transform with a
+    nested lambda/comprehension (every worker derives a different key)."""
+    import subprocess
+    import sys as _sys
+
+    script = tmp_path / "sig.py"
+    script.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "from petastorm_tpu.transform import TransformSpec, transform_signature\n"
+        "def tf(cols):\n"
+        "    inner = lambda v: {k for k in ('a', 'b')} and v * 2\n"
+        "    return {k: inner(v) for k, v in cols.items()}\n"
+        "print(transform_signature(TransformSpec(tf)))\n")
+    out = set()
+    for seed in ("0", "7"):
+        env = dict(os.environ, PYTHONHASHSEED=seed)
+        out.add(subprocess.run([_sys.executable, str(script)], env=env,
+                               stdout=subprocess.PIPE, text=True,
+                               check=True).stdout.strip())
+    assert len(out) == 1, f"signature differs across interpreters: {out}"
+
+
+def test_cache_key_transform_signature_tracks_function_body():
+    def f1(cols):
+        return cols
+
+    def f2(cols):
+        return {k: v * 2 for k, v in cols.items()}
+
+    s1 = transform_signature(TransformSpec(f1))
+    s2 = transform_signature(TransformSpec(f2))
+    assert s1 != s2
+    assert transform_signature(TransformSpec(f1)) == s1   # deterministic
+    assert transform_signature(None) == "-"
+    # schema edits alone also change it
+    s3 = transform_signature(TransformSpec(f1, removed_fields=["x"]))
+    assert s3 != s1
+
+
+def test_cache_key_file_fingerprint_tracks_rewrites(tmp_path):
+    import pyarrow.fs as pafs
+
+    cache = InMemoryCache()
+    worker = _key_worker(tmp_path, cache)
+    fs = pafs.LocalFileSystem()
+    path = str(tmp_path / "data.parquet")
+    with open(path, "wb") as f:
+        f.write(b"v1")
+    k1 = worker._cache_key(_item(path), (0, 10), fs)  # noqa: SLF001
+    # rewrite in place: a NEW worker (fresh memo) must derive a NEW key
+    time.sleep(0.01)
+    with open(path, "wb") as f:
+        f.write(b"v2-longer")
+    worker2 = _key_worker(tmp_path, cache)
+    k2 = worker2._cache_key(_item(path), (0, 10), fs)  # noqa: SLF001
+    assert k1 != k2
+    # NullCache readers skip the stat entirely
+    nullw = _key_worker(tmp_path, NullCache())
+    assert nullw._cache_key(_item(path), (0, 10), fs).endswith(":-")  # noqa: SLF001
+
+
+# -- slot-decode composition --------------------------------------------------
+
+def test_batch_slot_decode_stays_armed_for_copying_caches(tmp_path):
+    probes = {
+        NullCache(): True,
+        InMemoryCache(): True,
+        LocalDiskCache(str(tmp_path / "d")): True,
+    }
+    for cache, expect in probes.items():
+        worker = _key_worker(tmp_path, cache)
+        assert worker._allow_batch_slots is expect, type(cache)  # noqa: SLF001
+
+    class UnknownCache(NullCache):
+        retains_value_references = True  # third-party: conservative default
+
+    assert not _key_worker(tmp_path, UnknownCache())._allow_batch_slots  # noqa: SLF001
+
+
+@needs_arena
+def test_shared_tier_keeps_slots_armed(tier, tmp_path):
+    worker = _key_worker(tmp_path, tier)
+    assert worker._allow_batch_slots  # noqa: SLF001
+    assert SharedWarmCache.retains_value_references is False
+
+
+@needs_arena
+def test_hit_materializes_into_armed_transport_slot(tier):
+    """A warm hit under the process pool copies straight into an arena batch
+    slot (one shm->shm memcpy) so encode_batch ships it zero-copy."""
+    from petastorm_tpu.native import SharedArena
+    from petastorm_tpu.native.transport import SlotAllocator, _slot_scope
+
+    tier.get("slot-key", lambda: _batch())
+    arena = SharedArena.create(8 * 2 ** 20)
+    try:
+        allocator = SlotAllocator(arena)
+        with _slot_scope(allocator):
+            got = tier.get("slot-key", lambda: pytest.fail("should hit"))
+        # fixed-shape columns were allocated FROM the transport slots
+        assert allocator.claim(got.columns["img"]) is not None
+        assert allocator.claim(got.columns["x"]) is not None
+        allocator.rollback_claims()
+        allocator.finalize(None)
+    finally:
+        del got, allocator  # release slot views so the arena unmaps cleanly
+        arena.close()
+
+
+# -- telemetry ----------------------------------------------------------------
+
+@needs_arena
+def test_publish_telemetry_folds_deltas_once(tmp_path):
+    tele = Telemetry()
+    cache = SharedWarmCache(location=str(tmp_path / "pub"), telemetry=tele)
+    try:
+        batch = _batch()
+        cache.get("a", lambda: batch)
+        cache.get("a", lambda: batch)
+        cache.publish_telemetry()
+        c = tele.snapshot()["counters"]
+        assert c["cache.hits"] == 1 and c["cache.misses"] == 1
+        assert c["cache.stores"] == 1
+        g = tele.snapshot()["gauges"]
+        assert g["cache.bytes"] > 0
+        assert g["cache.hit_rate"] == pytest.approx(0.5)
+        # idempotent: republishing without activity adds nothing
+        cache.publish_telemetry()
+        assert tele.snapshot()["counters"]["cache.hits"] == 1
+
+        # the series ride the Prometheus exposition mechanically
+        from petastorm_tpu.telemetry.export import render_prometheus
+
+        body = render_prometheus(tele.snapshot())
+        assert "petastorm_tpu_cache_hits_total 1" in body
+        assert "petastorm_tpu_cache_misses_total 1" in body
+        assert "petastorm_tpu_cache_hit_rate 0.5" in body
+        assert "petastorm_tpu_cache_bytes" in body
+
+        # a SECOND instance (another reader) baselines at attach: it only
+        # publishes activity it observed, so nothing double-counts
+        tele2 = Telemetry()
+        other = SharedWarmCache(location=str(tmp_path / "pub"),
+                                telemetry=tele2)
+        try:
+            other.get("a", lambda: pytest.fail("should hit"))
+            other.publish_telemetry()
+            c2 = tele2.snapshot()["counters"]
+            assert c2["cache.hits"] == 1
+            assert "cache.misses" not in c2
+        finally:
+            other.close()
+    finally:
+        cache.cleanup()
+
+
+def test_watch_frame_renders_cache_line():
+    from petastorm_tpu.tools.diagnose import render_watch_frame
+
+    point = {"dt_s": 1.0,
+             "rates": {"reader.rows_emitted": 100.0, "cache.hits": 12.0,
+                       "cache.misses": 3.0},
+             "counters": {"reader.rows_emitted": 100, "cache.hits": 12,
+                          "cache.misses": 3, "cache.evictions": 2},
+             "gauges": {"cache.hit_rate": 0.8,
+                        "cache.bytes": 64 * 2 ** 20},
+             "stages": {}}
+    frame = render_watch_frame(point)
+    assert "cache:" in frame
+    assert "hit-rate  80.0%" in frame
+    assert "L1 64MB" in frame
+    assert "evictions 2" in frame
+    # no cache activity -> no cache line
+    assert "cache:" not in render_watch_frame(
+        {"dt_s": 1.0, "rates": {}, "counters": {}, "gauges": {},
+         "stages": {}})
+
+
+# -- autotune knob ------------------------------------------------------------
+
+def test_autotune_cache_memory_knob_moves_on_signals():
+    from petastorm_tpu.autotune import AutotuneController, AutotunePolicy
+
+    class _Clock:
+        t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    class _Sampler:
+        def __init__(self):
+            self.points = []
+
+        def series(self):
+            return list(self.points)
+
+    def _point(rate, starved=0.0, blocked=0.0):
+        return {"dt_s": 1.0,
+                "rates": {"reader.rows_emitted": rate,
+                          "queue.results_empty_wait_s": starved,
+                          "queue.results_full_wait_s": blocked},
+                "gauges": {}, "counters": {}, "stages": {}}
+
+    tele = Telemetry()
+    sampler = _Sampler()
+    clock = _Clock()
+    # bare executor: no worker/results knobs, so cache_mem is the only
+    # candidate and the signal routing is unambiguous
+    ctl = AutotuneController(object(), sampler, tele,
+                             policy=AutotunePolicy(settle_s=1.0,
+                                                   eval_points=2,
+                                                   cooldown_s=0.0,
+                                                   explore=False),
+                             clock=clock)
+    state = {"mb": 256}
+    ctl.attach_cache_memory(get=lambda: state["mb"],
+                            set_=lambda n: state.__setitem__("mb", n) or n,
+                            lo_mb=16, hi_mb=1024)
+    sampler.points.extend([_point(100, starved=0.9)] * 2)
+    entry = ctl.step()
+    assert entry is not None
+    assert (entry["knob"], entry["action"]) == ("cache_mem", "grow")
+    assert state["mb"] == 512               # mul step: doubled
+    clock.t += 1.01
+    assert ctl.step() is None               # settle over, eval anchored
+    sampler.points.extend([_point(150)] * 2)
+    done = ctl.step()
+    assert done["outcome"] == "kept"
+    assert tele.snapshot()["gauges"]["autotune.cache_mem"] == 512
+
+    # consumer-bound: shrink
+    sampler.points.extend([_point(100, blocked=0.9)] * 2)
+    entry = ctl.step()
+    assert (entry["knob"], entry["action"]) == ("cache_mem", "shrink")
+    assert state["mb"] == 256
+
+
+@needs_arena
+def test_reader_attaches_cache_memory_knob(tmp_path):
+    from petastorm_tpu.autotune import AutotunePolicy
+    from petastorm_tpu.reader import make_batch_reader
+
+    ds = str(tmp_path / "ds")
+    schema = Schema("T", [Field("x", np.int64)])
+    write_dataset(ds, schema, [{"x": i} for i in range(40)],
+                  row_group_size_rows=10)
+    loc = str(tmp_path / "tier")
+    with make_batch_reader(ds, reader_pool_type="thread", workers_count=1,
+                           shuffle_row_groups=False, cache_type="shared",
+                           cache_location=loc,
+                           autotune=AutotunePolicy(warmup_s=60),
+                           sample_interval_s=0.2) as r:
+        assert r.warm_cache is not None
+        assert "cache_mem" in r.autotune.knobs()
+        list(r.iter_batches())
+    SharedWarmCache(location=loc).cleanup()
+
+
+# -- e2e: two readers, one tier ----------------------------------------------
+
+def _image_dataset(tmp_path, rows=48, rg=8):
+    ds = str(tmp_path / "imgds")
+    schema = Schema("Img", [
+        Field("label", np.int64, (), ScalarCodec()),
+        Field("image", np.uint8, (48, 48, 3),
+              CompressedImageCodec("jpeg", quality=90)),
+    ])
+    write_dataset(ds, schema,
+                  [{"label": i, "image": synthetic_rgb_image(i, 48, 48)}
+                   for i in range(rows)], row_group_size_rows=rg)
+    return ds
+
+
+@needs_arena
+def test_two_readers_share_tier_zero_extra_decodes(tmp_path):
+    """The acceptance shape: reader A decodes cold; reader B over the SAME
+    tier delivers identical rows from its FIRST epoch with cache hits and
+    ZERO additional rowgroup decodes (decode.batch_calls delta == 0)."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    ds = _image_dataset(tmp_path)
+    loc = str(tmp_path / "tier")
+
+    def read(tele):
+        with make_batch_reader(ds, reader_pool_type="thread",
+                               workers_count=2, shuffle_row_groups=False,
+                               cache_type="shared", cache_location=loc,
+                               telemetry=tele) as r:
+            return sorted(int(x) for b in r.iter_batches()
+                          for x in b.columns["label"])
+
+    tele_a, tele_b = Telemetry(), Telemetry()
+    rows_a = read(tele_a)
+    rows_b = read(tele_b)
+    assert rows_a == rows_b == list(range(48))
+    ca = tele_a.snapshot()["counters"]
+    cb = tele_b.snapshot()["counters"]
+    assert ca["cache.misses"] == 6
+    assert cb["cache.hits"] >= 6
+    assert "cache.misses" not in cb
+    from petastorm_tpu.native import image as native_image
+
+    if native_image.available():
+        # the decode-counter proof (decode.batch_* only move when the
+        # native batched decode actually ran): cold epoch decoded every
+        # rowgroup, the warm re-read decoded NOTHING
+        assert ca["decode.batch_calls"] >= 6
+        assert cb.get("decode.batch_calls", 0) == 0
+    SharedWarmCache(location=loc).cleanup()
+
+
+@needs_arena
+def test_readers_with_different_transforms_do_not_cross_contaminate(tmp_path):
+    from petastorm_tpu.reader import make_batch_reader
+
+    ds = _image_dataset(tmp_path, rows=16, rg=8)
+    loc = str(tmp_path / "tier")
+
+    def read(transform):
+        with make_batch_reader(ds, reader_pool_type="thread",
+                               workers_count=1, shuffle_row_groups=False,
+                               cache_type="shared", cache_location=loc,
+                               transform_spec=transform) as r:
+            return {n: np.concatenate([b.columns[n]
+                                       for b in r.iter_batches()])
+                    for n in ("label",)}
+
+    plain = read(None)
+    shifted = read(TransformSpec(
+        lambda cols: {**cols, "label": cols["label"] + 1000}))
+    # a stale cross-transform hit would leak UNSHIFTED labels into the
+    # transformed reader (the cached value is the pre-transform decode, but
+    # the key still separates the tiers' namespaces)
+    np.testing.assert_array_equal(plain["label"], np.arange(16))
+    np.testing.assert_array_equal(shifted["label"], np.arange(16) + 1000)
+    SharedWarmCache(location=loc).cleanup()
+
+
+@needs_arena
+def test_concurrent_readers_cross_hit_live(tmp_path):
+    """Two readers running AT THE SAME TIME over one tier: B records hits
+    during its first epoch (cross-job sharing, not just epoch-2 reuse)."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    ds = _image_dataset(tmp_path, rows=64, rg=8)
+    loc = str(tmp_path / "tier")
+    teles = [Telemetry(), Telemetry()]
+    rows = [None, None]
+
+    def read(i, epochs):
+        with make_batch_reader(ds, reader_pool_type="thread",
+                               workers_count=2, shuffle_row_groups=False,
+                               cache_type="shared", cache_location=loc,
+                               num_epochs=epochs, telemetry=teles[i]) as r:
+            rows[i] = sorted(int(x) for b in r.iter_batches()
+                             for x in b.columns["label"])
+
+    a = threading.Thread(target=read, args=(0, 2))
+    a.start()
+    time.sleep(0.3)                      # let A warm part of the tier
+    read(1, 1)
+    a.join()
+    assert rows[0] == sorted(list(range(64)) * 2)
+    assert rows[1] == list(range(64))
+    cb = teles[1].snapshot()["counters"]
+    assert cb.get("cache.hits", 0) > 0, cb
+    SharedWarmCache(location=loc).cleanup()
+
+
+# -- LocalDiskCache hardening (satellite 1) -----------------------------------
+
+def test_disk_cache_lookup_store_halves(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / "d"), size_limit_bytes=2 ** 20)
+    assert cache.lookup("nope") is _MISSING
+    cache.store("k", {"v": 1})
+    assert cache.lookup("k") == {"v": 1}
+    assert cache.get("k", lambda: pytest.fail("should hit")) == {"v": 1}
+
+
+def test_disk_cache_tolerates_partner_deleted_entry(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / "d"))
+    cache.store("k", "value")
+    path = cache._entry_path("k")  # noqa: SLF001
+    real_utime = os.utime
+
+    def racing_utime(p, *a, **kw):
+        # a concurrent evictor removes the entry between our open and touch
+        os.remove(path)
+        return real_utime(p, *a, **kw)
+
+    import unittest.mock as mock
+
+    with mock.patch("os.utime", racing_utime):
+        assert cache.lookup("k") == "value"   # value already read: a hit
+    assert cache.lookup("k") is _MISSING      # and the entry is gone
+
+
+def test_disk_cache_eviction_spares_live_tmp_sweeps_orphans(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / "d"), size_limit_bytes=100)
+    live_tmp = os.path.join(cache._dir, "writer.tmp")  # noqa: SLF001
+    with open(live_tmp, "wb") as f:
+        f.write(b"x" * 400)
+    orphan_tmp = os.path.join(cache._dir, "orphan.tmp")  # noqa: SLF001
+    with open(orphan_tmp, "wb") as f:
+        f.write(b"x" * 400)
+    old = time.time() - LocalDiskCache.ORPHAN_TMP_S - 10
+    os.utime(orphan_tmp, (old, old))
+    cache.store("k", "v" * 200)
+    cache._maybe_evict()  # noqa: SLF001 - sweeps are amortized (SWEEP_EVERY)
+    assert os.path.exists(live_tmp), "live writer temp was evicted"
+    assert not os.path.exists(orphan_tmp), "crashed-writer orphan leaked"
+
+
+def test_disk_cache_sweep_is_amortized(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / "d"), size_limit_bytes=10)
+    for i in range(LocalDiskCache.SWEEP_EVERY - 1):
+        cache.store(f"k{i}", "v" * 100)
+    # over the cap, but no sweep yet: entries survive between sweeps
+    assert len(os.listdir(cache._dir)) == LocalDiskCache.SWEEP_EVERY - 1  # noqa: SLF001
+    cache.store("trigger", "v" * 100)         # SWEEP_EVERY-th store sweeps
+    assert len(os.listdir(cache._dir)) <= 1  # noqa: SLF001
+
+
+@pytest.mark.slow
+def test_disk_cache_multiprocess_eviction_stress(tmp_path):
+    """Concurrent writers + evictors from threads AND processes over one
+    tiny directory: every get returns the correct value and nothing
+    raises (the satellite-1 race contract)."""
+    import multiprocessing as mp
+
+    d = str(tmp_path / "stress")
+    errs = mp.get_context("spawn").Queue()
+    procs = [mp.get_context("spawn").Process(
+        target=_stress_worker, args=(d, seed, errs)) for seed in range(3)]
+    for p in procs:
+        p.start()
+    threads = [threading.Thread(target=_stress_worker, args=(d, 100 + s, errs))
+               for s in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for p in procs:
+        p.join(60)
+        assert p.exitcode == 0
+    assert errs.empty(), errs.get()
+
+
+def _stress_worker(d, seed, errs):
+    try:
+        cache = LocalDiskCache(d, size_limit_bytes=64 * 1024)
+        rng = np.random.default_rng(seed)
+        for i in range(150):
+            k = f"key{rng.integers(0, 40)}"
+            expected = f"value-{k}" * 50
+            got = cache.get(k, lambda: expected)
+            assert got == expected, (k, got[:40])
+    except Exception as exc:  # noqa: BLE001 - reported to the parent
+        errs.put(f"worker {seed}: {type(exc).__name__}: {exc}")
+        raise
+
+
+# -- make_cache ---------------------------------------------------------------
+
+@needs_arena
+def test_make_cache_shared(tmp_path):
+    cache = make_cache("shared", str(tmp_path / "mc"), 8 * 2 ** 20)
+    try:
+        assert isinstance(cache, SharedWarmCache)
+        assert cache.l1_size_bytes == 8 * 2 ** 20
+        assert cache.l1_enabled
+    finally:
+        cache.cleanup()
+
+
+@needs_arena
+def test_index_slot_capacity_constant():
+    # layout regression guard: the shared index is a fixed binary format
+    # other PROCESSES map - dtype drift corrupts every attached job
+    from petastorm_tpu.cache_shared import _HEADER_DTYPE, _SLOT_DTYPE
+
+    assert _HEADER_DTYPE.itemsize == 128
+    assert _SLOT_DTYPE.itemsize == 64
+    assert DEFAULT_SLOTS == 4096

@@ -25,6 +25,15 @@ decrease).  Without it the comparison is report-only and always exits 0, so
 the same command serves both a human diff and a CI gate on the bench
 trajectory (RESULTS.md notes this host's rates drift +-30% between sessions;
 pick thresholds accordingly).
+
+Warm-cache metrics (BENCH_r07+, docs/operations.md "Warm cache"): the
+``warm_cache_*`` family gates like any rate, but note the two ratio-shaped
+members are SAME-SESSION anchored and therefore drift-immune - treat a
+regression in ``warm_cache_epoch2_vs_epoch1_ratio`` (warm epoch over cold
+epoch; the ISSUE 7 target is vs_baseline >= 1.0 against its 3.0x bar) or in
+``warm_cache_cross_reader_hit_rate`` (fraction of reader B's first-epoch
+items served from the tier; 1.0 = fully warm) as a code regression even in
+a session whose absolute rates drifted.
 """
 
 from __future__ import annotations
