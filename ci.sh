@@ -318,6 +318,98 @@ print("warm-cache smoke OK"
       f" {int(cb['cache.hits'])} hits, 0 decodes, rows exact)")
 PY
 
+echo "== service smoke (disaggregated ingest: dispatcher + fleet + 2 clients, one worker SIGKILLed) =="
+# the full service topology as REAL subprocesses: a dispatcher (CLI), two
+# fleet workers (CLI), and two trainer clients, with one worker SIGKILLed
+# while it holds in-flight work.  Both clients must deliver their exact row
+# multiset and the dispatcher's service.requeued_items must account for the
+# kill - the disaggregated-ingest contract of ISSUE 9 (docs/operations.md
+# "Disaggregated ingest service").
+SVC_SMOKE="$(mktemp /tmp/petastorm_tpu_service_smoke_XXXXXX.py)"
+cat > "$SVC_SMOKE" <<'PY'
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service.protocol import connect_frames, parse_address
+
+CLIENT = """
+import sys
+from petastorm_tpu.reader import make_batch_reader
+with make_batch_reader(sys.argv[1], service_address=sys.argv[2],
+                       shuffle_row_groups=False) as reader:
+    rows = sorted(x for b in reader.iter_batches() for x in b.columns["x"])
+print("ROWS", len(rows), sum(rows))
+"""
+
+def stats(addr):
+    conn = connect_frames(parse_address(addr), timeout=5.0)
+    try:
+        conn.send({"t": "stats?"})
+        return conn.recv(timeout=5.0)["stats"]
+    finally:
+        conn.close()
+
+if __name__ == "__main__":
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_service_smoke_")
+    schema = Schema("ServiceSmoke", [Field("x", np.int64)])
+    write_dataset(tmp, schema, [{"x": i} for i in range(400)],
+                  row_group_size_rows=10)
+    procs = []
+    try:
+        disp = subprocess.Popen(
+            [sys.executable, "-m", "petastorm_tpu.service.cli", "dispatcher",
+             "--host", "127.0.0.1", "--port", "0",
+             "--heartbeat-timeout", "5"],
+            stdout=subprocess.PIPE, text=True)
+        procs.append(disp)
+        line = disp.stdout.readline()
+        addr = re.search(r"listening on (\S+)", line).group(1)
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "petastorm_tpu.service.cli", "worker",
+                 "--address", addr, "--capacity", "2", "--name", f"w{i}"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.monotonic() + 30
+        while len(stats(addr)["workers"]) < 2:
+            assert time.monotonic() < deadline, "workers never registered"
+            time.sleep(0.1)
+        clients = [subprocess.Popen([sys.executable, "-c", CLIENT, tmp, addr],
+                                    stdout=subprocess.PIPE, text=True)
+                   for _ in range(2)]
+        procs.extend(clients)
+        deadline = time.monotonic() + 30
+        while stats(addr)["workers"].get("w0", {}).get("inflight", 0) == 0:
+            assert time.monotonic() < deadline, "w0 never took work"
+            time.sleep(0.05)
+        os.kill(procs[1].pid, signal.SIGKILL)  # w0, mid-epoch
+        for client in clients:
+            out, _ = client.communicate(timeout=150)
+            assert client.returncode == 0, f"client exited {client.returncode}"
+            n, total = map(int, out.strip().split()[1:])
+            assert (n, total) == (400, sum(range(400))), (n, total)
+        s = stats(addr)
+        requeued = s["counters"].get("service.requeued_items", 0)
+        assert requeued >= 1, s["counters"]
+        print("service smoke OK (2 clients exact under a worker SIGKILL,"
+              f" {int(requeued)} item(s) requeued, fleet="
+              f"{sorted(s['workers'])})")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+PY
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 200 python "$SVC_SMOKE"
+rm -f "$SVC_SMOKE"
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

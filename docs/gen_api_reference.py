@@ -64,6 +64,13 @@ API = [
                              "CircuitBreaker", "make_circuit_breaker"]),
     ("petastorm_tpu.pool", ["make_executor", "WorkerError",
                             "PipelineStallError"]),
+    ("petastorm_tpu.service.dispatcher", ["Dispatcher"]),
+    ("petastorm_tpu.service.worker", ["ServiceWorker", "run_worker"]),
+    ("petastorm_tpu.service.client", ["ServiceExecutor",
+                                      "ServiceConnectionError"]),
+    ("petastorm_tpu.service.protocol", ["FrameSocket", "connect_frames",
+                                        "parse_address", "encode_result",
+                                        "PayloadDecoder"]),
     ("petastorm_tpu.errors", None),
     ("petastorm_tpu.ops.normalize", ["normalize_images"]),
     ("petastorm_tpu.ops.augment", ["random_crop", "random_flip",

@@ -107,6 +107,7 @@ def make_reader(dataset_url: str,
                 flight_record_path: Optional[str] = None,
                 sample_interval_s: Optional[float] = None,
                 autotune=None,
+                service_address=None,
                 chaos=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -240,6 +241,24 @@ def make_reader(dataset_url: str,
     Every decision is visible as ``autotune.*`` counters/gauges, trace
     events, and ``Reader.diagnostics['autotune']``.
 
+    ``service_address``: consume through the disaggregated ingest service
+    (docs/operations.md "Disaggregated ingest service") instead of an
+    in-process pool.  ``'host:port'`` (or ``(host, port)``) of a running
+    ``petastorm-tpu-service dispatcher``; the reader ships its worker
+    factory to the dispatcher's remote-worker fleet and receives decoded
+    batches over the wire - preprocessing then scales independently of
+    this process, and co-located workers using ``cache_type='shared'``
+    decode each rowgroup once across ALL clients of the dataset.  The
+    deterministic plan, resume cursors, shuffle and ``on_error`` policies
+    all behave exactly as with a local pool; ``reader_pool_type`` /
+    ``workers_count`` are ignored (fleet size is the dispatcher's concern -
+    its ``scaling_signal`` says when to grow it), and the liveness/autotune
+    knobs that steer a local pool are inoperative client-side.  A lost
+    dispatcher connection reconnects with backoff and, failing that,
+    raises a classified infrastructure
+    :class:`~petastorm_tpu.service.client.ServiceConnectionError` instead
+    of hanging the epoch.
+
     ``chaos``: deterministic fault injection for tests/benchmarks
     (``petastorm_tpu.test_util.chaos.ChaosSpec``); never set in production.
     """
@@ -264,7 +283,8 @@ def make_reader(dataset_url: str,
                              metrics_port=metrics_port,
                              flight_record_path=flight_record_path,
                              sample_interval_s=sample_interval_s,
-                             autotune=autotune)
+                             autotune=autotune,
+                             service_address=service_address)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -329,6 +349,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       flight_record_path: Optional[str] = None,
                       sample_interval_s: Optional[float] = None,
                       autotune=None,
+                      service_address=None,
                       chaos=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
@@ -337,7 +358,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
     column arrays per decoded rowgroup.  ``io_retries``/``telemetry``/
     ``on_error``/``item_deadline_s``/``hedge_after_s``/``stall_warn_s``/
     ``stall_abort_s``/``metrics_port``/``flight_record_path``/
-    ``sample_interval_s``/``autotune``/``chaos``: see ``make_reader``.
+    ``sample_interval_s``/``autotune``/``service_address``/``chaos``: see
+    ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -360,7 +382,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              metrics_port=metrics_port,
                              flight_record_path=flight_record_path,
                              sample_interval_s=sample_interval_s,
-                             autotune=autotune)
+                             autotune=autotune,
+                             service_address=service_address)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -384,12 +407,35 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       metrics_port: Optional[int] = None,
                       flight_record_path: Optional[str] = None,
                       sample_interval_s: Optional[float] = None,
-                      autotune=None) -> "Reader":
+                      autotune=None,
+                      service_address=None) -> "Reader":
     from petastorm_tpu.autotune import resolve_autotune
 
     telemetry = _resolve_telemetry(telemetry)
     autotune_policy = resolve_autotune(autotune, workers_count,
                                        reader_pool_type)
+    if service_address is not None:
+        if autotune_policy is not None:
+            # the client has no local worker plane to resize; fleet sizing
+            # is the dispatcher's scaling signal (docs/operations.md)
+            if autotune is not None and autotune is not False:
+                logger.warning(
+                    "autotune is inoperative with service_address readers:"
+                    " the worker plane lives in the remote fleet (size it"
+                    " off the dispatcher's scaling_signal)")
+            autotune_policy = None
+        if item_deadline_s is not None or hedge_after_s is not None:
+            logger.warning(
+                "item_deadline_s/hedge_after_s are client-side liveness"
+                " knobs and are inoperative with service_address readers"
+                " (the dispatcher requeues items off dead workers)")
+            item_deadline_s = hedge_after_s = None
+        if cache_type == "memory":
+            raise PetastormTpuError(
+                "cache_type='memory' is process-local: every remote worker"
+                " would hold its own empty cache. Use cache_type='shared'"
+                " (the host-wide tier remote workers share) or"
+                " 'local-disk' with service_address readers.")
     if not flight_record_path:
         flight_record_path = (
             os.environ.get("PETASTORM_TPU_FLIGHT_RECORD", "").strip() or None)
@@ -420,6 +466,11 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             register("decode")
             if transform_spec is not None:
                 register("transform")
+            if service_address is not None:
+                # the service plane's client-side stage: a just-started
+                # fleet renders as "(no samples yet)" in reports/--watch
+                # instead of vanishing (docs/operations.md)
+                register("service")
     error_policy = resolve_error_policy(on_error)
     if chaos is not None and chaos.affects_filesystem():
         # transient-IO chaos lives in the filesystem layer so it exercises
@@ -614,23 +665,41 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
 
         worker = ChaosWorker(worker, chaos)
 
-    executor = make_executor(
-        reader_pool_type, workers_count, results_queue_size,
-        telemetry=telemetry,
-        # skip policies need the pool to survive delivered failures so the
-        # consumer can quarantine the item and keep iterating
-        stop_on_failure=error_policy is None,
-        max_requeue_attempts=(error_policy.max_requeue_attempts
-                              if error_policy is not None
-                              else DEFAULT_REQUEUE_ATTEMPTS),
-        item_deadline_s=item_deadline_s,
-        hedge_after_s=hedge_after_s,
-        # the serial pool's per-item watchdog is the only observer of a
-        # mid-item stall there; it must honor the first-class kwarg too
-        stall_warn_s=stall_warn_s,
-        # process pools pre-allocate resize slots up to the autotune ceiling
-        max_workers=(autotune_policy.max_workers
-                     if autotune_policy is not None else None))
+    if service_address is not None:
+        # the disaggregated service plane: the dispatcher's remote-worker
+        # fleet replaces the in-process pool; the client executor speaks
+        # the same ExecutorBase protocol so everything downstream (ledger,
+        # resume cursor, on_error policies) is unchanged
+        from petastorm_tpu.service.client import ServiceExecutor
+
+        executor = ServiceExecutor(
+            service_address, telemetry=telemetry,
+            stop_on_failure=error_policy is None,
+            max_requeue_attempts=(error_policy.max_requeue_attempts
+                                  if error_policy is not None
+                                  else DEFAULT_REQUEUE_ATTEMPTS),
+            # the in-flight window is the service analog of the results
+            # queue bound: batches outstanding at the dispatcher per client
+            window=max(4, int(results_queue_size)))
+    else:
+        executor = make_executor(
+            reader_pool_type, workers_count, results_queue_size,
+            telemetry=telemetry,
+            # skip policies need the pool to survive delivered failures so
+            # the consumer can quarantine the item and keep iterating
+            stop_on_failure=error_policy is None,
+            max_requeue_attempts=(error_policy.max_requeue_attempts
+                                  if error_policy is not None
+                                  else DEFAULT_REQUEUE_ATTEMPTS),
+            item_deadline_s=item_deadline_s,
+            hedge_after_s=hedge_after_s,
+            # the serial pool's per-item watchdog is the only observer of a
+            # mid-item stall there; it must honor the first-class kwarg too
+            stall_warn_s=stall_warn_s,
+            # process pools pre-allocate resize slots up to the autotune
+            # ceiling
+            max_workers=(autotune_policy.max_workers
+                         if autotune_policy is not None else None))
     start_item = 0
     if resume_from is not None and "elastic" not in resume_from:
         if "elastic_rebased" in resume_from:

@@ -1,0 +1,43 @@
+"""Disaggregated ingest service: dispatcher + elastic remote-worker fleet.
+
+The single-host pipeline welds preprocessing capacity to the trainer
+process; this package splits the worker plane out (the tf.data-service
+move, ROADMAP item 1): a standalone **dispatcher** owns work-item
+assignment over each client's deterministic plan stream, an elastic fleet
+of **remote workers** runs the exact same decode path as the in-process
+pools (petastorm_tpu.worker.RowGroupDecoderWorker, shipped to workers as
+the pickled worker factory - the pool.WorkerFactory contract, lifted onto
+sockets), and trainer processes consume through a **client executor** that
+implements the pool ``ExecutorBase`` protocol - so
+``make_reader(service_address=...)`` transparently swaps the worker plane
+with zero changes anywhere downstream (shuffle, loaders, resume cursors,
+``on_error`` policies all keep working).
+
+Grounded in *tf.data service: A Case for Disaggregating ML Input Data
+Processing* (PAPERS.md): input workers scale independently of
+accelerators, and one dataset's decode work is shared across many
+concurrent jobs - co-located workers using ``cache_type='shared'`` decode
+each rowgroup once fleet-wide while every client still receives its exact
+row multiset.
+
+Topology::
+
+    trainer A --make_reader(service_address=...)--+
+                                                  +--> dispatcher <--+-- worker 1
+    trainer B --make_reader(service_address=...)--+                  +-- worker 2
+                                                                     +-- worker N
+
+Entry points: ``petastorm-tpu-service dispatcher`` / ``petastorm-tpu-service
+worker`` (service.cli), :class:`~petastorm_tpu.service.dispatcher.Dispatcher`,
+:class:`~petastorm_tpu.service.worker.ServiceWorker`, and
+:class:`~petastorm_tpu.service.client.ServiceExecutor`.  Operations guide:
+docs/operations.md "Disaggregated ingest service".
+"""
+
+from petastorm_tpu.service.client import (ServiceConnectionError,
+                                          ServiceExecutor)
+from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.service.worker import ServiceWorker
+
+__all__ = ["Dispatcher", "ServiceWorker", "ServiceExecutor",
+           "ServiceConnectionError"]

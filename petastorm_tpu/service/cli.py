@@ -1,0 +1,153 @@
+"""``petastorm-tpu-service``: run a dispatcher or a fleet worker.
+
+Usage::
+
+    petastorm-tpu-service dispatcher --port 7737 [--metrics-port 9100]
+    petastorm-tpu-service worker --address HOST:7737 [--capacity 4]
+    petastorm-tpu-service stats --address HOST:7737
+
+Topology and sizing guidance: docs/operations.md "Disaggregated ingest
+service".  Trainers connect with ``make_reader(...,
+service_address='HOST:7737')``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-service",
+        description="Disaggregated ingest service: dispatcher + workers")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    d = sub.add_parser("dispatcher", help="run the dispatcher control plane")
+    d.add_argument("--host", default="0.0.0.0",
+                   help="bind address (default all interfaces)")
+    d.add_argument("--port", type=int, default=7737,
+                   help="listen port (0 = ephemeral, printed at start)")
+    d.add_argument("--heartbeat-timeout", type=float, default=10.0,
+                   metavar="S", help="declare a silent worker dead after"
+                   " this many seconds (default 10)")
+    d.add_argument("--client-grace", type=float, default=30.0, metavar="S",
+                   help="keep a disconnected client's state this long for a"
+                   " reconnect (default 30)")
+    d.add_argument("--max-requeue-attempts", type=int, default=None,
+                   help="default per-item requeue budget for clients that"
+                   " do not bring their own")
+    d.add_argument("--assignment-deadline", type=float, default=None,
+                   metavar="S", help="liveness backstop: drop a worker"
+                   " whose assigned item produced no outcome for S seconds"
+                   " (it keeps heartbeating while wedged in user code);"
+                   " size WELL above the slowest legitimate decode."
+                   " Default off")
+    d.add_argument("--metrics-port", type=int, default=None, metavar="N",
+                   help="serve service.* series in Prometheus text format"
+                   " on localhost:N (0 = ephemeral)")
+    d.add_argument("--stats-interval", type=float, default=0.0, metavar="S",
+                   help="print a JSON stats line (fleet, clients, scaling"
+                   " signal) every S seconds (0 = off)")
+
+    w = sub.add_parser("worker", help="run one fleet worker")
+    w.add_argument("--address", required=True, metavar="HOST:PORT",
+                   help="dispatcher address")
+    w.add_argument("--capacity", type=int, default=2,
+                   help="concurrent work items this worker accepts"
+                   " (default 2)")
+    w.add_argument("--name", default=None, help="worker name (default"
+                   " assigned by the dispatcher)")
+    w.add_argument("--shm-size-mb", type=int, default=0, metavar="MB",
+                   help="arm the co-located-client shared-memory fast path"
+                   " with an arena this large (0 = plain frame payloads;"
+                   " needs the native transport plane)")
+    w.add_argument("--reconnect-attempts", type=int, default=0,
+                   help="survive dispatcher restarts: retry registration"
+                   " this many times (default 0 = exit with the dispatcher)")
+
+    s = sub.add_parser("stats", help="print one dispatcher stats snapshot")
+    s.add_argument("--address", required=True, metavar="HOST:PORT")
+    return parser
+
+
+def _run_dispatcher(args) -> int:
+    from petastorm_tpu.errors import DEFAULT_REQUEUE_ATTEMPTS
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    from petastorm_tpu.telemetry import Telemetry
+
+    dispatcher = Dispatcher(
+        host=args.host, port=args.port, telemetry=Telemetry(),
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        client_grace_s=args.client_grace,
+        max_requeue_attempts=(args.max_requeue_attempts
+                              if args.max_requeue_attempts is not None
+                              else DEFAULT_REQUEUE_ATTEMPTS),
+        assignment_deadline_s=args.assignment_deadline,
+        metrics_port=args.metrics_port)
+    dispatcher.start()
+    print(f"dispatcher listening on {args.host}:{dispatcher.port}",
+          flush=True)
+    if dispatcher.metrics_server is not None:
+        print(f"metrics: http://127.0.0.1:{dispatcher.metrics_server.port}"
+              "/metrics", flush=True)
+    try:
+        while True:
+            time.sleep(args.stats_interval or 3600.0)
+            if args.stats_interval:
+                print(json.dumps(dispatcher.stats()), flush=True)
+    except KeyboardInterrupt:
+        print("dispatcher stopping", flush=True)
+    finally:
+        dispatcher.stop()
+        dispatcher.join()
+    return 0
+
+
+def _run_worker(args) -> int:
+    from petastorm_tpu.service.worker import run_worker
+
+    try:
+        return run_worker(args.address, capacity=args.capacity,
+                          name=args.name,
+                          shm_size_bytes=args.shm_size_mb * 2 ** 20,
+                          reconnect_attempts=args.reconnect_attempts)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _run_stats(args) -> int:
+    from petastorm_tpu.service.protocol import (connect_frames,
+                                                parse_address)
+
+    conn = connect_frames(parse_address(args.address))
+    try:
+        conn.send({"t": "stats?"})
+        reply = conn.recv(timeout=10.0)
+    finally:
+        conn.close()
+    if not reply or reply.get("t") != "stats":
+        print(f"unexpected reply: {reply!r}", file=sys.stderr)
+        return 1
+    print(json.dumps(reply["stats"], indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    args = build_parser().parse_args(argv)
+    if args.command == "dispatcher":
+        return _run_dispatcher(args)
+    if args.command == "worker":
+        return _run_worker(args)
+    return _run_stats(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

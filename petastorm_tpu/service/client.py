@@ -1,0 +1,484 @@
+"""Client executor: the trainer-side plane of the disaggregated service.
+
+:class:`ServiceExecutor` implements the :class:`~petastorm_tpu.pool.
+ExecutorBase` protocol over a dispatcher connection, so
+``make_reader(service_address=...)`` swaps the worker plane transparently:
+the Ventilator ``put``\\ s the deterministic plan's
+:class:`~petastorm_tpu.pool.VentilatedItem`\\ s (flow-controlled by a
+bounded in-flight window), the Reader ``get``\\ s completed batches in
+completion order, and the per-ordinal ledger / resume-cursor / ``on_error``
+machinery all behave exactly as with an in-process pool.
+
+Graceful degrade (docs/operations.md "Disaggregated ingest service"): a
+lost dispatcher connection enters a reconnect-with-backoff window driven by
+a :class:`~petastorm_tpu.retry.RetryPolicy`; on reconnect the client
+resyncs its in-flight ledger (items whose ``enqueue`` died with the old
+connection are re-sent; the dispatcher replays unacked results, which the
+ledger dedups).  A window that closes without a connection raises
+:class:`ServiceConnectionError` - a **classified infrastructure**
+``WorkerError`` carrying ``.diagnostics`` - instead of hanging the epoch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from petastorm_tpu.errors import (DEFAULT_REQUEUE_ATTEMPTS,
+                                  PetastormTpuError, ReaderClosedError)
+from petastorm_tpu.pool import (ExecutorBase, VentilationCancelled,
+                                WorkerError, _Failure)
+from petastorm_tpu.retry import RetryPolicy
+from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
+                                            FrameClosedError, FrameSocket,
+                                            PayloadDecoder, connect_frames,
+                                            parse_address,
+                                            shm_transport_available)
+
+logger = logging.getLogger(__name__)
+
+_POLL_S = 0.05
+#: default bound on items in flight at the dispatcher per client (the
+#: service-plane analog of the pool's input+results queue bounds)
+DEFAULT_WINDOW = 16
+#: cadence of client_stats frames (the starved-seconds fleet-pressure feed)
+_STATS_INTERVAL_S = 1.0
+
+
+class ServiceConnectionError(WorkerError):
+    """The dispatcher connection was lost and could not be re-established
+    within the reconnect-with-backoff window.
+
+    Kind ``'infra'`` and unattributable (no single work item to blame), so
+    it is terminal under every ``on_error`` policy - a trainer must fail
+    loudly, not hang, when its ingest control plane is gone.  Carries the
+    executor's ``diagnostics`` snapshot (connection history, in-flight
+    window state) taken at raise time.
+    """
+
+    def __init__(self, message: str, diagnostics: Optional[dict] = None):
+        super().__init__(message, kind="infra")
+        self.diagnostics = diagnostics or {}
+
+
+class _ConnLost:
+    """Receiver-thread -> consumer sentinel: reconnect window exhausted."""
+
+    __slots__ = ("message",)
+
+    def __init__(self, message: str):
+        self.message = message
+
+
+class ServiceExecutor(ExecutorBase):
+    """``ExecutorBase`` over a dispatcher connection (see module docstring).
+
+    ``window``: max items in flight at the dispatcher (``put`` blocks past
+    it - the backpressure that keeps the Ventilator from streaming a whole
+    epoch ahead).  ``reconnect_policy``: backoff schedule for the
+    lost-connection window (``max_attempts`` reconnect tries before
+    :class:`ServiceConnectionError`).  ``max_requeue_attempts`` travels to
+    the dispatcher in the hello, so the service plane enforces the same
+    per-item budget the local pools would.
+
+    Liveness note: ``item_deadline_s`` / ``hedge_after_s`` are dispatcher /
+    worker-side concerns on the service plane and are not accepted here
+    (the reader warns and drops them for service-backed readers).
+    """
+
+    def __init__(self, address, telemetry=None, stop_on_failure: bool = True,
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
+                 window: int = DEFAULT_WINDOW,
+                 reconnect_policy: Optional[RetryPolicy] = None,
+                 client_id: Optional[str] = None):
+        super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
+                         max_requeue_attempts=max_requeue_attempts)
+        if window < 1:
+            raise PetastormTpuError("ServiceExecutor window must be >= 1")
+        self._address = parse_address(address)
+        self._window = int(window)
+        self._reconnect_policy = reconnect_policy or RetryPolicy(
+            max_attempts=5, initial_backoff_s=0.2, max_backoff_s=2.0)
+        self.client_id = client_id or uuid.uuid4().hex[:16]
+        self._conn: Optional[FrameSocket] = None
+        self._conn_lock = threading.Lock()      # connection swap + sends
+        self._connected = threading.Event()
+        #: set when the receiver's reconnect window closed for good (the
+        #: _ConnLost sentinel is queued); put() waiters stop waiting then
+        self._conn_failed = threading.Event()
+        self._results: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        self._slots = threading.BoundedSemaphore(self._window)
+        self._recv_thread: Optional[threading.Thread] = None
+        self._decoder = PayloadDecoder()
+        self._factory_blob: Optional[bytes] = None
+        self._reconnects = 0
+        self._bytes_in_folded = 0
+        self._starved_s = 0.0
+        self._stats_sent_at = 0.0
+        # service.* client-side series (docs/operations.md): the stage span
+        # is registered up front so reports/--watch render "(no samples
+        # yet)" for a just-started service reader instead of omitting it
+        if self._telemetry.enabled:
+            self._telemetry.register_stage("service")
+        self._m_bytes_out = self._telemetry.counter("service.frame_bytes_sent")
+        self._m_bytes_in = self._telemetry.counter(
+            "service.frame_bytes_received")
+        self._m_results = self._telemetry.counter("service.results")
+        self._m_reconnects = self._telemetry.counter("service.reconnects")
+        self._m_srv_requeued = self._telemetry.counter(
+            "service.requeued_items")
+        self._g_connected = self._telemetry.gauge("service.connected")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, worker_factory) -> None:
+        """Connect, register this client, and ship the pickled worker
+        factory the fleet will run (pool ``ExecutorBase.start`` contract)."""
+        import pickle
+
+        if self._recv_thread is not None:
+            raise PetastormTpuError("Executor already started")
+        try:
+            self._factory_blob = pickle.dumps(
+                worker_factory, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as exc:
+            raise PetastormTpuError(
+                "service_address readers ship the worker factory to remote"
+                f" workers, so it must be picklable: {exc}") from exc
+        self._connect(resume=False)
+        self._recv_thread = threading.Thread(
+            target=self._receiver_loop, daemon=True,
+            name="petastorm-tpu-service-recv")
+        self._recv_thread.start()
+
+    def _connect(self, resume: bool) -> None:
+        conn = connect_frames(self._address)
+        conn.send({"t": "client_hello", "protocol": PROTOCOL_VERSION,
+                   "client": self.client_id, "factory": self._factory_blob,
+                   "hostname": socket.gethostname(),
+                   "shm_ok": shm_transport_available(),
+                   "max_requeue": self._max_requeue,
+                   "resume": resume})
+        hello = conn.recv(timeout=10.0)
+        if not hello or hello.get("t") != "hello_ok":
+            conn.close()
+            raise OSError(f"dispatcher refused client hello: {hello!r}")
+        with self._conn_lock:
+            old, self._conn = self._conn, conn
+            self._bytes_in_folded = 0
+        if old is not None:
+            old.close()
+        self._connected.set()
+        self._g_connected.set(1)
+        if resume:
+            # re-send every ledger item the dispatcher may never have seen
+            # (an enqueue lost with the dying connection); the dispatcher
+            # dedups by ordinal against its pending/inflight/unacked state
+            with self._inflight_lock:
+                items = list(self._inflight.values())
+            if items:
+                self._send({"t": "resync", "items": items})
+
+    def stop(self) -> None:
+        """Stop consuming: best-effort goodbye, close the connection."""
+        self._stopped = True
+        self._connected.set()  # release put() waiters into the stopped check
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.send({"t": "bye"})
+            except OSError:
+                pass
+            conn.close()
+
+    def join(self) -> None:
+        """Wait for the receiver thread and release payload resources."""
+        if not self._stopped:
+            raise PetastormTpuError("call stop() before join()")
+        if self._recv_thread is not None:
+            self._recv_thread.join(timeout=5.0)
+        self._decoder.close()
+
+    # -- sending --------------------------------------------------------------
+
+    def _send(self, msg: Dict) -> None:
+        """Send on the current connection; OSError propagates (callers
+        decide between waiting out a reconnect and raising)."""
+        with self._conn_lock:
+            conn = self._conn
+            if conn is None:
+                raise OSError("not connected")
+            self._m_bytes_out.add(conn.send(msg))
+
+    def put(self, item: Any, cancel_event=None) -> None:
+        if self._stopped:
+            raise ReaderClosedError("Executor is stopped")
+        while not self._slots.acquire(timeout=_POLL_S):
+            if self._stopped:
+                raise ReaderClosedError("Executor stopped while putting")
+            if cancel_event is not None and cancel_event.is_set():
+                raise VentilationCancelled()
+        # ledger entry BEFORE the send (same reasoning as the process pool:
+        # a fast result must find its ordinal registered) - and the ledger
+        # doubles as the resync source after a reconnect
+        self._track_put(item)
+        try:
+            self._send({"t": "enqueue", "item": item})
+            self._ventilated += 1
+        except OSError:
+            # connection mid-drop: the item is in the ledger, so the
+            # receiver's reconnect resync re-sends it; wait for the window
+            # to settle rather than failing ventilation immediately
+            if not self._await_reconnect(cancel_event):
+                self._slots.release()
+                self._settle(getattr(item, "ordinal", None))
+                if self._stopped:
+                    raise ReaderClosedError("Executor stopped while putting")
+                raise VentilationCancelled()
+            try:
+                # a resync (ordinal-deduped dispatcher-side, unlike enqueue)
+                # covers the race where the receiver's reconnect resync ran
+                # before this item reached the ledger
+                self._send({"t": "resync", "items": [item]})
+            except OSError:
+                pass  # next drop repeats the recovery
+            self._ventilated += 1
+
+    def _await_reconnect(self, cancel_event=None) -> bool:
+        """Block until the receiver re-established the connection (True) or
+        the executor stopped / the receiver's reconnect window closed for
+        good (False).  Driven by the receiver's own signals - ``_connected``
+        and ``_conn_failed`` - not an independent timer: a timer shorter
+        than the receiver's real window (backoffs PLUS a connect timeout
+        per attempt) would cancel ventilation while the receiver later
+        reconnects fine, silently hanging the epoch.  The generous deadline
+        below is only a backstop against a wedged receiver thread."""
+        deadline = time.monotonic() + self._reconnect_budget_s()
+        while time.monotonic() < deadline:
+            if self._stopped or self._conn_failed.is_set():
+                return False
+            if cancel_event is not None and cancel_event.is_set():
+                return False
+            if self._connected.wait(timeout=_POLL_S):
+                return True
+        return False
+
+    def _reconnect_budget_s(self) -> float:
+        """Upper bound on the receiver's reconnect window: per attempt, the
+        capped backoff plus the 10s connect timeout, plus slack.  A
+        BACKSTOP only - _await_reconnect normally exits on the receiver's
+        _connected/_conn_failed signals long before this."""
+        p = self._reconnect_policy
+        total, backoff = 10.0, p.initial_backoff_s
+        for _ in range(p.max_attempts):
+            total += min(backoff, p.max_backoff_s) + 10.0
+            backoff *= p.backoff_multiplier
+        return total
+
+    # -- receiving ------------------------------------------------------------
+
+    def _receiver_loop(self) -> None:
+        try:
+            self._receiver_loop_impl()
+        except BaseException:  # noqa: BLE001 - the consumer must never hang
+            if not self._stopped:
+                # whatever killed the receiver, the consumer must learn it
+                # is alone (a silently-dead receiver = a wedged epoch)
+                logger.warning("service receiver thread failed",
+                               exc_info=True)
+                self._conn_failed.set()
+                self._results.put(_ConnLost(
+                    "service receiver thread failed (see log)"))
+
+    def _receiver_loop_impl(self) -> None:
+        while not self._stopped:
+            conn = self._conn
+            if conn is None:
+                break
+            try:
+                msg = conn.recv(timeout=0.2)
+            except (FrameClosedError, PetastormTpuError, OSError):
+                if self._stopped:
+                    return
+                self._g_connected.set(0)
+                self._connected.clear()
+                if not self._reconnect():
+                    self._conn_failed.set()  # release put() waiters first
+                    self._results.put(_ConnLost(
+                        f"dispatcher connection to"
+                        f" {self._address[0]}:{self._address[1]} lost and"
+                        f" {self._reconnect_policy.max_attempts} reconnect"
+                        " attempt(s) failed"))
+                    return
+                continue
+            if msg is None:
+                continue
+            self._dispatch_frame(conn, msg)
+
+    def _dispatch_frame(self, conn: FrameSocket, msg: Dict) -> None:
+        kind = msg.get("t")
+        if conn.bytes_received > self._bytes_in_folded:
+            self._m_bytes_in.add(conn.bytes_received - self._bytes_in_folded)
+            self._bytes_in_folded = conn.bytes_received
+        if kind == "result":
+            t0 = time.perf_counter_ns() if self._telemetry.enabled else None
+            try:
+                value = self._decoder.decode(msg["payload"])
+            except Exception as exc:  # noqa: BLE001 - surfaced to consumer
+                self._results.put(_Failure(exc, ordinal=msg.get("ordinal")))
+                return
+            if t0 is not None:
+                # the 'service' stage: client-side cost of receiving one
+                # result (payload decode; the wire wait shows up as the
+                # reader's queue.results_empty_wait_s, not busy time here)
+                self._telemetry.record_stage(
+                    "service", t0, time.perf_counter_ns() - t0,
+                    {"ordinal": msg.get("ordinal")})
+                self._m_results.add(1)
+            self._results.put(("ok", msg.get("ordinal"),
+                               msg.get("attempt", 0), value))
+            try:
+                self._send({"t": "ack", "ordinals": [msg.get("ordinal")]})
+                self._maybe_send_stats()
+            except OSError:
+                pass  # the read side will notice and reconnect
+        elif kind == "failure":
+            self._results.put(msg)
+            try:
+                # failures free the dispatcher's redelivery buffer exactly
+                # like results - an unacked failure would be buffered
+                # forever and replayed on every reconnect
+                self._send({"t": "ack", "ordinals": [msg.get("ordinal")]})
+            except OSError:
+                pass
+        elif kind == "requeued":
+            # accounting notice: the dispatcher moved one of our in-flight
+            # items off a dead worker (the item itself stays in flight)
+            self._requeued_items += 1
+            self._m_requeued.add(1)
+            self._m_srv_requeued.add(1)
+
+    def _reconnect(self) -> bool:
+        """Reconnect-with-backoff window (retry.py policy shape); True when
+        a connection was re-established and the ledger resynced."""
+        p = self._reconnect_policy
+        backoff = p.initial_backoff_s
+        for attempt in range(1, p.max_attempts + 1):
+            if self._stopped:
+                return False
+            logger.warning(
+                "Dispatcher connection lost; reconnect attempt %d/%d in"
+                " %.2fs", attempt, p.max_attempts, backoff)
+            deadline = time.monotonic() + min(backoff, p.max_backoff_s)
+            while time.monotonic() < deadline:
+                if self._stopped:
+                    return False
+                time.sleep(_POLL_S)
+            try:
+                self._connect(resume=True)
+            except (OSError, PetastormTpuError):
+                # OSError = refused/unreachable; PetastormTpuError covers a
+                # half-dead accept (FrameClosedError mid-hello: the listener
+                # backlog accepted us, then the dying dispatcher reset)
+                backoff *= p.backoff_multiplier
+                continue
+            self._reconnects += 1
+            self._m_reconnects.add(1)
+            logger.info("Reconnected to dispatcher (attempt %d)", attempt)
+            return True
+        return False
+
+    def _maybe_send_stats(self) -> None:
+        """Piggyback the consumer starved-seconds delta (the fleet-pressure
+        signal) on the ack path, at most once per _STATS_INTERVAL_S."""
+        now = time.monotonic()
+        if now - self._stats_sent_at < _STATS_INTERVAL_S:
+            return
+        self._stats_sent_at = now
+        starved, self._starved_s = self._starved_s, 0.0
+        if starved > 0:
+            self._send({"t": "client_stats", "starved_s": starved})
+
+    # -- consuming ------------------------------------------------------------
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Next completed batch (completion order); raises ``queue.Empty``
+        on timeout, classified WorkerErrors on forwarded failures, and
+        :class:`ServiceConnectionError` when the dispatcher is gone."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            t0 = time.monotonic()
+            try:
+                entry = self._results.get(timeout=_POLL_S)
+            except queue.Empty:
+                self._starved_s += time.monotonic() - t0
+                if self._stopped:
+                    raise ReaderClosedError("Executor is stopped")
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                continue
+            if isinstance(entry, _ConnLost):
+                if self._stop_on_failure:
+                    self.stop()
+                raise ServiceConnectionError(
+                    f"{entry.message}; epoch cannot complete"
+                    " (docs/operations.md 'Disaggregated ingest service')",
+                    diagnostics=self.diagnostics)
+            if isinstance(entry, _Failure):
+                # local failure (payload decode): classified like a pool one
+                entry = {"t": "failure", "ordinal": entry.ordinal,
+                         "failure": entry}
+            if isinstance(entry, dict):  # forwarded failure frame
+                if self._handle_failure_frame(entry):
+                    continue  # duplicate for an already-settled ordinal
+            else:
+                _tag, ordinal, attempt, value = entry
+                if not self._settle(ordinal):
+                    continue  # redelivery duplicate (reconnect replay)
+                self._slots.release()
+                self._note_delivery(ordinal, attempt)
+                self._consumed += 1
+                return value
+
+    def _handle_failure_frame(self, msg: Dict) -> bool:
+        """Deliver one forwarded failure; True = drop (duplicate).  Data
+        failures surface as classified WorkerErrors for the reader's
+        ``on_error`` policy; the dispatcher already ran the requeue budget
+        for infra failures, so whatever arrives here is final."""
+        ordinal = msg.get("ordinal")
+        failure = msg.get("failure")
+        if not self._settle(ordinal):
+            return True
+        self._slots.release()
+        if failure is not None:
+            message = f"Worker failed:\n{failure.formatted}"
+            kind = failure.kind
+            exc_type = failure.exc_type
+            item = failure.item
+        else:
+            message = msg.get("message", "service worker failure")
+            kind = msg.get("kind", "infra")
+            exc_type = None
+            item = msg.get("item")
+        if self._stop_on_failure:
+            self.stop()
+        raise WorkerError(message, kind=kind, ordinal=ordinal, item=item,
+                          exc_type=exc_type)
+
+    @property
+    def diagnostics(self) -> dict:
+        """Pool diagnostics plus connection state (address, reconnects,
+        in-flight window usage)."""
+        return {**super().diagnostics,
+                "service_address": f"{self._address[0]}:{self._address[1]}",
+                "client_id": self.client_id,
+                "connected": self._connected.is_set() and not self._stopped,
+                "reconnects": self._reconnects,
+                "window": self._window,
+                "window_in_use": len(self._inflight)}

@@ -1,0 +1,805 @@
+"""Ingest-service dispatcher: worker registry + per-client work assignment.
+
+The dispatcher owns each client's deterministic plan stream (the client's
+Ventilator feeds it :class:`~petastorm_tpu.pool.VentilatedItem`\\ s over the
+wire, in exactly the order the seeded :class:`~petastorm_tpu.plan.ReadPlan`
+produced them) and assigns items to registered workers, with the same
+fault-tolerance semantics the in-process pools implement:
+
+* a worker that disconnects or misses heartbeats has its in-flight items
+  **requeued** onto surviving workers through the per-item attempt budget
+  (``VentilatedItem.attempt`` rides the wire, so chaos injection and
+  quarantine classification behave identically to the local pools);
+* an item whose budget is spent surfaces to its client as a classified
+  infrastructure failure (the client raises the same ``WorkerError`` the
+  pools would);
+* in-worker *data* failures (corrupt rowgroup, codec error) are forwarded
+  to the client unchanged - ``on_error`` skip policies quarantine them
+  client-side exactly as with a local pool.
+
+Delivery is exactly-once per client: results are buffered until the client
+**acks** them, so a dropped client connection replays unacked results on
+reconnect and the client-side per-ordinal ledger dedups any overlap.
+
+Rowgroup affinity: items are routed by a stable hash of their rowgroup so
+repeated reads of one rowgroup (two clients on one dataset) prefer the same
+worker - and co-located workers sharing a ``cache_type='shared'`` warm tier
+decode each rowgroup once fleet-wide regardless.
+
+Fleet sizing: clients piggyback their consumer starved-seconds (the
+``queue.results_empty_wait_s`` signal petastorm_tpu.autotune drives worker
+counts with) and :meth:`Dispatcher.scaling_signal` turns the aggregate into
+a grow/ok/shrink recommendation plus a ``service.scale_pressure`` gauge -
+the operator's (or an orchestrator's) cue to resize the fleet
+(docs/operations.md "Disaggregated ingest service").
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import socket
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from petastorm_tpu.errors import DEFAULT_REQUEUE_ATTEMPTS, PetastormTpuError
+from petastorm_tpu.pool import VentilatedItem
+from petastorm_tpu.service.protocol import (PROTOCOL_VERSION, FrameClosedError,
+                                            FrameSocket)
+from petastorm_tpu.telemetry import resolve as _resolve_telemetry
+
+logger = logging.getLogger(__name__)
+
+#: telemetry counter prefixes a worker heartbeat may fold into the
+#: dispatcher's registry as ``service.fleet.<name>`` (fleet-wide decode /
+#: cache accounting - the observable proof of decode-once sharing)
+FLEET_COUNTER_PREFIXES = ("decode.", "worker.", "cache.", "io.")
+
+
+class _WorkerState:
+    __slots__ = ("name", "conn", "capacity", "hostname", "inflight",
+                 "last_heartbeat", "busy", "jobs_sent", "gone")
+
+    def __init__(self, name: str, conn: FrameSocket, capacity: int,
+                 hostname: str):
+        self.name = name
+        self.conn = conn
+        self.capacity = max(1, int(capacity))
+        self.hostname = hostname
+        #: (client_id, ordinal) assignments awaiting a result
+        self.inflight: Set[Tuple[str, int]] = set()
+        self.last_heartbeat = time.monotonic()
+        self.busy = 0
+        self.jobs_sent: Set[str] = set()
+        self.gone = False
+
+
+class _Assignment:
+    __slots__ = ("item", "worker", "assigned_at")
+
+    def __init__(self, item: VentilatedItem, worker: str):
+        self.item = item
+        self.worker = worker
+        self.assigned_at = time.monotonic()
+
+
+class _ClientState:
+    __slots__ = ("client_id", "conn", "factory", "hostname", "shm_ok",
+                 "max_requeue", "pending", "inflight", "unacked", "rows",
+                 "results", "requeued", "connected", "disconnected_at")
+
+    def __init__(self, client_id: str, conn: FrameSocket, factory: bytes,
+                 hostname: str, shm_ok: bool, max_requeue: int):
+        self.client_id = client_id
+        self.conn = conn
+        self.factory = factory
+        self.hostname = hostname
+        self.shm_ok = shm_ok
+        self.max_requeue = max_requeue
+        #: items awaiting assignment (requeues go to the FRONT so a
+        #: recovered item does not wait behind a whole epoch)
+        self.pending: Deque[VentilatedItem] = collections.deque()
+        #: ordinal -> _Assignment at a worker
+        self.inflight: Dict[int, _Assignment] = {}
+        #: ordinal -> outcome frame delivered but not yet acked (replayed
+        #: verbatim on reconnect; bounded by the client's in-flight window)
+        self.unacked: Dict[int, Dict] = {}
+        self.rows = 0
+        self.results = 0
+        self.requeued = 0
+        self.connected = True
+        self.disconnected_at: Optional[float] = None
+
+    def known_ordinals(self) -> Set[int]:
+        known = set(self.inflight) | set(self.unacked)
+        known.update(i.ordinal for i in self.pending)
+        return known
+
+
+class Dispatcher:
+    """The ingest-service control plane (one process serves many clients).
+
+    ``heartbeat_timeout_s``: a worker silent this long is declared dead and
+    its in-flight items requeue (socket EOF - the common death - is
+    detected immediately; the timeout covers a worker whose heartbeat
+    thread died with the process).  A worker wedged INSIDE user decode/IO
+    code keeps heartbeating - that failure mode needs
+    ``assignment_deadline_s``: when set, an assignment with no outcome for
+    that long declares its worker hung and drops it (connection closed ->
+    the worker process exits; its items requeue through the budget) - the
+    service-plane analog of the process pool's SIGKILL-and-respawn.  Off
+    by default, like ``item_deadline_s`` locally; size it WELL above the
+    slowest legitimate rowgroup decode.
+    ``client_grace_s``: a disconnected client's state (pending + in-flight
+    + unacked results) is kept this long for a reconnect before purging.
+    ``max_requeue_attempts``: default per-item budget; each client's hello
+    may carry its own (the reader's ``on_error`` policy budget travels with
+    the job, keeping service and in-process semantics identical).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 telemetry=None,
+                 heartbeat_timeout_s: float = 10.0,
+                 client_grace_s: float = 30.0,
+                 max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
+                 assignment_deadline_s: Optional[float] = None,
+                 metrics_port: Optional[int] = None):
+        if assignment_deadline_s is not None and assignment_deadline_s <= 0:
+            raise PetastormTpuError(
+                "assignment_deadline_s must be > 0 or None")
+        self._host = host
+        self._requested_port = port
+        self._heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._client_grace_s = float(client_grace_s)
+        self._assignment_deadline_s = assignment_deadline_s
+        self._max_requeue = int(max_requeue_attempts)
+        self.telemetry = _resolve_telemetry(telemetry)
+        self._lock = threading.RLock()
+        self._workers: Dict[str, _WorkerState] = {}
+        self._clients: Dict[str, _ClientState] = {}
+        self._client_order: List[str] = []  # round-robin fairness cursor
+        self._rr = 0
+        self._stop_event = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._listener: Optional[socket.socket] = None
+        self.port: Optional[int] = None
+        self._started_at = time.monotonic()
+        #: (monotonic, starved_s delta) reports from clients - the fleet
+        #: pressure window (scaling_signal)
+        self._starved_reports: Deque[Tuple[float, float]] = collections.deque(
+            maxlen=512)
+        self._worker_seq = 0
+        self._client_counter_ids: Set[str] = set()
+        self._metrics_port = metrics_port
+        self.metrics_server = None
+        # -- service.* telemetry (rides the registry -> Prometheus/--watch) --
+        tele = self.telemetry
+        self._g_workers = tele.gauge("service.registered_workers")
+        self._g_clients = tele.gauge("service.connected_clients")
+        self._g_pending = tele.gauge("service.pending_items")
+        self._g_inflight = tele.gauge("service.inflight_items")
+        self._g_pressure = tele.gauge("service.scale_pressure")
+        self._m_assigned = tele.counter("service.assigned_items")
+        self._m_completed = tele.counter("service.completed_items")
+        self._m_requeued = tele.counter("service.requeued_items")
+        self._m_failures = tele.counter("service.forwarded_failures")
+        self._m_dup = tele.counter("service.duplicate_results")
+        self._m_bytes_in = tele.counter("service.frame_bytes_received")
+        self._m_bytes_out = tele.counter("service.frame_bytes_sent")
+        self._m_rows = tele.counter("service.client_rows")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "Dispatcher":
+        """Bind the listener (``self.port`` is then live) and start the
+        accept + monitor threads; returns self for chaining."""
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self._host, self._requested_port))
+        listener.listen(64)
+        listener.settimeout(0.2)
+        self._listener = listener
+        self.port = listener.getsockname()[1]
+        for target, name in ((self._accept_loop, "accept"),
+                             (self._monitor_loop, "monitor")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"petastorm-tpu-dispatcher-{name}")
+            t.start()
+            self._threads.append(t)
+        if self._metrics_port is not None and self.telemetry.enabled:
+            from petastorm_tpu.telemetry.export import MetricsExportServer
+
+            self.metrics_server = MetricsExportServer(
+                self.telemetry, port=self._metrics_port)
+            self.metrics_server.start()
+        logger.info("Dispatcher listening on %s:%d", self._host, self.port)
+        return self
+
+    def stop(self) -> None:
+        """Close the listener and every live connection; workers and
+        clients see EOF immediately."""
+        self._stop_event.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = ([w.conn for w in self._workers.values()]
+                     + [c.conn for c in self._clients.values() if c.connected])
+        for conn in conns:
+            conn.close()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+
+    def join(self, timeout: float = 5.0) -> None:
+        """Bounded wait for the service threads after :meth:`stop`."""
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        self.join()
+
+    # -- connection handling --------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed at stop
+            t = threading.Thread(target=self._serve_conn,
+                                 args=(FrameSocket(sock),), daemon=True,
+                                 name="petastorm-tpu-dispatcher-conn")
+            t.start()
+            # prune finished connection threads as we go: a long-lived
+            # dispatcher probed by `stats` every few seconds would otherwise
+            # accumulate dead Thread objects for its whole lifetime
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: FrameSocket) -> None:
+        try:
+            hello = conn.recv(timeout=10.0)
+        except Exception:  # noqa: BLE001 - drop bad conns (EOF, garbage)
+            conn.close()
+            return
+        if hello is None or self._stop_event.is_set():
+            # a connection that raced the accept loop against stop() must be
+            # refused here: sending hello_ok and then never reading would
+            # leave the peer waiting on a silent live socket
+            conn.close()
+            return
+        kind = hello.get("t")
+        try:
+            if kind == "worker_hello":
+                self._worker_loop(conn, hello)
+            elif kind == "client_hello":
+                self._client_loop(conn, hello)
+            elif kind == "stats?":
+                conn.send({"t": "stats", "stats": self.stats()})
+                conn.close()
+            else:
+                logger.warning("Dropping connection with bad hello %r", kind)
+                conn.close()
+        except FrameClosedError:
+            pass
+        except Exception:  # noqa: BLE001 - one bad conn must not kill serving
+            if not self._stop_event.is_set():
+                logger.warning("Dispatcher connection handler failed",
+                               exc_info=True)
+
+    # -- worker side ----------------------------------------------------------
+
+    def _worker_loop(self, conn: FrameSocket, hello: Dict) -> None:
+        if hello.get("protocol") != PROTOCOL_VERSION:
+            conn.send({"t": "error", "error": "protocol version mismatch"})
+            conn.close()
+            return
+        with self._lock:
+            self._worker_seq += 1
+            name = hello.get("worker") or f"worker-{self._worker_seq}"
+            if name in self._workers:
+                name = f"{name}-{self._worker_seq}"
+            state = _WorkerState(name, conn, hello.get("capacity", 1),
+                                 hello.get("hostname", ""))
+            self._workers[name] = state
+            self._g_workers.set(len(self._workers))
+        conn.send({"t": "hello_ok", "worker": name})
+        logger.info("Worker %s registered (capacity %d, host %s)", name,
+                    state.capacity, state.hostname or "?")
+        self._pump()
+        bytes_folded = 0
+        try:
+            while not self._stop_event.is_set():
+                msg = conn.recv(timeout=1.0)
+                if conn.bytes_received > bytes_folded:
+                    self._m_bytes_in.add(conn.bytes_received - bytes_folded)
+                    bytes_folded = conn.bytes_received
+                if msg is None:
+                    continue
+                kind = msg.get("t")
+                if kind == "heartbeat":
+                    self._on_heartbeat(state, msg)
+                elif kind == "result":
+                    self._on_result(state, msg)
+                elif kind == "failure":
+                    self._on_worker_failure(state, msg)
+                elif kind == "bye":
+                    break
+        except FrameClosedError:
+            pass
+        finally:
+            self._worker_gone(name)
+
+    def _on_heartbeat(self, state: _WorkerState, msg: Dict) -> None:
+        state.last_heartbeat = time.monotonic()
+        state.busy = int(msg.get("busy", 0))
+        deltas = msg.get("counters") or {}
+        if self.telemetry.enabled:
+            for cname, delta in deltas.items():
+                if delta and cname.startswith(FLEET_COUNTER_PREFIXES):
+                    self.telemetry.counter(f"service.fleet.{cname}").add(delta)
+
+    def _on_result(self, state: _WorkerState, msg: Dict) -> None:
+        cid, ordinal = msg["client"], msg["ordinal"]
+        state.last_heartbeat = time.monotonic()
+        duplicate = False
+        with self._lock:
+            state.inflight.discard((cid, ordinal))
+            client = self._clients.get(cid)
+            if client is None or client.inflight.pop(ordinal, None) is None:
+                # late duplicate (the ordinal was requeued and its sibling
+                # delivered first, or the client was purged): drop - the
+                # client-side ledger would drop it anyway
+                duplicate = True
+        if duplicate:
+            # outside the lock: _pump's sends must never run while this
+            # thread holds the dispatcher lock (a worker with a full TCP
+            # buffer would stall every other connection's thread)
+            self._m_dup.add(1)
+            self._stamp_gauges()
+            self._pump()
+            return
+        with self._lock:
+            out = {"t": "result", "ordinal": ordinal,
+                   "attempt": msg.get("attempt", 0),
+                   "payload": msg["payload"], "rows": msg.get("rows", 0),
+                   "worker": state.name}
+            client.unacked[ordinal] = out
+            client.results += 1
+            client.rows += int(msg.get("rows", 0))
+            conn = client.conn if client.connected else None
+        self._m_completed.add(1)
+        self._m_rows.add(int(msg.get("rows", 0)))
+        if self.telemetry.enabled:
+            # per-client rows ride the registry under a bounded name set: a
+            # dispatcher serving an unbounded client churn must not grow the
+            # registry forever (stats() always has per-client exact counts)
+            if cid in self._client_counter_ids \
+                    or len(self._client_counter_ids) < 100:
+                self._client_counter_ids.add(cid)
+                self.telemetry.counter(
+                    f"service.client.{cid[:12]}.rows").add(
+                        int(msg.get("rows", 0)))
+        if conn is not None:
+            self._send_to_client(cid, conn, out)
+        self._stamp_gauges()
+        self._pump()
+
+    def _on_worker_failure(self, state: _WorkerState, msg: Dict) -> None:
+        cid, ordinal = msg["client"], msg["ordinal"]
+        failure = msg["failure"]  # a pool._Failure (picklable envelope)
+        state.last_heartbeat = time.monotonic()
+        with self._lock:
+            state.inflight.discard((cid, ordinal))
+            client = self._clients.get(cid)
+            if client is None:
+                return
+            assign = client.inflight.pop(ordinal, None)
+            if assign is None:
+                self._m_dup.add(1)
+                return
+        if getattr(failure, "kind", "data") == "infra":
+            # in-worker infra failure (e.g. MemoryError): the item is
+            # healthy, the worker wasn't - same treatment as a death
+            self._requeue_or_fail(
+                cid, ordinal, assign,
+                f"in-worker infra failure ({failure.exc_type})")
+        else:
+            self._forward_failure(cid, ordinal, failure=failure)
+        self._pump()
+
+    def _worker_gone(self, name: str) -> None:
+        with self._lock:
+            state = self._workers.pop(name, None)
+            if state is None or state.gone:
+                return
+            state.gone = True
+            lost = list(state.inflight)
+            self._g_workers.set(len(self._workers))
+        state.conn.close()
+        if lost:
+            logger.warning("Worker %s lost with %d in-flight item(s);"
+                           " requeueing", name, len(lost))
+        for cid, ordinal in lost:
+            with self._lock:
+                client = self._clients.get(cid)
+                assign = client.inflight.pop(ordinal, None) if client else None
+            if assign is not None:
+                self._requeue_or_fail(cid, ordinal, assign,
+                                      f"worker {name} death")
+        self._pump()
+
+    def _requeue_or_fail(self, cid: str, ordinal: int, assign: _Assignment,
+                         why: str) -> None:
+        """Pool `_requeue_lost` semantics across the wire: re-ventilate
+        through the attempt budget, else surface a classified infra failure."""
+        with self._lock:
+            client = self._clients.get(cid)
+            if client is None:
+                return
+            attempt = getattr(assign.item, "attempt", 0)
+            if attempt < client.max_requeue:
+                retry = VentilatedItem(ordinal,
+                                       getattr(assign.item, "item", assign.item),
+                                       attempt + 1)
+                client.pending.appendleft(retry)
+                client.requeued += 1
+                conn = client.conn if client.connected else None
+                notice = {"t": "requeued", "ordinal": ordinal,
+                          "attempt": attempt + 1, "why": why}
+            else:
+                conn = None
+                notice = None
+        if notice is not None:
+            self._m_requeued.add(1)
+            logger.warning("Requeueing work item %s for client %s after %s"
+                           " (attempt %d/%d)", ordinal, cid, why, attempt + 1,
+                           client.max_requeue)
+            if conn is not None:
+                self._send_to_client(cid, conn, notice)
+            return
+        self._forward_failure(
+            cid, ordinal, message=(
+                f"Work item {ordinal} lost to {why}; requeue budget exhausted"
+                f" ({attempt} requeue(s) of max {client.max_requeue})"
+                " - possible crash/OOM"),
+            kind="infra", item=assign.item)
+
+    def _forward_failure(self, cid: str, ordinal: int, failure=None,
+                         message: Optional[str] = None, kind: str = "data",
+                         item=None) -> None:
+        with self._lock:
+            client = self._clients.get(cid)
+            if client is None:
+                return
+            out = {"t": "failure", "ordinal": ordinal}
+            if failure is not None:
+                out["failure"] = failure
+            else:
+                out["message"] = message
+                out["kind"] = kind
+                out["item"] = item
+            client.unacked[ordinal] = out
+            conn = client.conn if client.connected else None
+        self._m_failures.add(1)
+        if conn is not None:
+            self._send_to_client(cid, conn, out)
+
+    # -- client side ----------------------------------------------------------
+
+    def _client_loop(self, conn: FrameSocket, hello: Dict) -> None:
+        if hello.get("protocol") != PROTOCOL_VERSION:
+            conn.send({"t": "error", "error": "protocol version mismatch"})
+            conn.close()
+            return
+        cid = hello["client"]
+        with self._lock:
+            client = self._clients.get(cid)
+            if client is None:
+                client = _ClientState(
+                    cid, conn, hello.get("factory"),
+                    hello.get("hostname", ""), bool(hello.get("shm_ok")),
+                    int(hello.get("max_requeue", self._max_requeue)))
+                self._clients[cid] = client
+                self._client_order.append(cid)
+                logger.info("Client %s registered", cid)
+            else:
+                # reconnect: swap the connection in, replay unacked outcomes
+                old = client.conn
+                client.conn = conn
+                client.connected = True
+                client.disconnected_at = None
+                if old is not conn:
+                    old.close()
+                logger.info("Client %s reconnected (%d unacked outcome(s)"
+                            " to replay)", cid, len(client.unacked))
+            replay = list(client.unacked.values())
+            self._g_clients.set(
+                sum(1 for c in self._clients.values() if c.connected))
+        conn.send({"t": "hello_ok", "client": cid})
+        for out in replay:
+            self._send_to_client(cid, conn, out)
+        self._pump()
+        bytes_folded = 0
+        try:
+            while not self._stop_event.is_set():
+                msg = conn.recv(timeout=1.0)
+                if conn.bytes_received > bytes_folded:
+                    self._m_bytes_in.add(conn.bytes_received - bytes_folded)
+                    bytes_folded = conn.bytes_received
+                if msg is None:
+                    continue
+                kind = msg.get("t")
+                if kind == "enqueue":
+                    with self._lock:
+                        client.pending.append(msg["item"])
+                    self._pump()
+                elif kind == "ack":
+                    with self._lock:
+                        for ordinal in msg["ordinals"]:
+                            client.unacked.pop(ordinal, None)
+                elif kind == "resync":
+                    self._on_resync(client, msg)
+                elif kind == "client_stats":
+                    starved = float(msg.get("starved_s", 0.0))
+                    if starved > 0:
+                        self._starved_reports.append(
+                            (time.monotonic(), starved))
+                elif kind == "stats?":
+                    conn.send({"t": "stats", "stats": self.stats()})
+                elif kind == "bye":
+                    self._purge_client(cid, reason="clean goodbye")
+                    return
+        except FrameClosedError:
+            pass
+        finally:
+            with self._lock:
+                current = self._clients.get(cid)
+                if current is not None and current.conn is conn:
+                    current.connected = False
+                    current.disconnected_at = time.monotonic()
+                    self._g_clients.set(sum(1 for c in self._clients.values()
+                                            if c.connected))
+            if self._stop_event.is_set():
+                # stop-path exit (not a client-side drop): close the socket
+                # so the peer sees EOF instead of an idle live connection
+                conn.close()
+
+    def _on_resync(self, client: _ClientState, msg: Dict) -> None:
+        """Reconnect recovery: re-enqueue any ledger item the dispatcher has
+        no record of (an ``enqueue`` frame lost in the dying connection)."""
+        with self._lock:
+            known = client.known_ordinals()
+            restored = 0
+            for item in msg.get("items", ()):
+                if item.ordinal not in known:
+                    client.pending.append(item)
+                    restored += 1
+        if restored:
+            logger.info("Client %s resync restored %d lost work item(s)",
+                        client.client_id, restored)
+        self._pump()
+
+    def _send_to_client(self, cid: str, conn: FrameSocket, out: Dict) -> None:
+        try:
+            self._m_bytes_out.add(conn.send(out))
+        except OSError:
+            # connection died mid-send: the outcome stays in unacked and
+            # replays on reconnect; the client read loop marks disconnect
+            logger.debug("send to client %s failed (kept for replay)", cid)
+
+    def _purge_client(self, cid: str, reason: str) -> None:
+        notify = []
+        with self._lock:
+            client = self._clients.pop(cid, None)
+            if client is None:
+                return
+            if cid in self._client_order:
+                self._client_order.remove(cid)
+            dropped = len(client.pending) + len(client.inflight)
+            for worker in self._workers.values():
+                worker.inflight = {(c, o) for c, o in worker.inflight
+                                   if c != cid}
+                if cid in worker.jobs_sent:
+                    notify.append(worker.conn)
+            self._g_clients.set(sum(1 for c in self._clients.values()
+                                    if c.connected))
+        for conn in notify:  # sends stay outside the dispatcher lock
+            try:
+                conn.send({"t": "job_done", "client": cid})
+            except OSError:
+                pass
+        client.conn.close()
+        logger.info("Client %s purged (%s; %d undelivered item(s) dropped)",
+                    cid, reason, dropped)
+        self._stamp_gauges()
+
+    # -- assignment -----------------------------------------------------------
+
+    def _pick_worker(self, item: VentilatedItem,
+                     free: List[_WorkerState]) -> _WorkerState:
+        """Rowgroup-affine choice among workers with spare capacity: the
+        same rowgroup prefers the same worker (warm-tier locality), falling
+        back to least-loaded."""
+        work = getattr(item, "item", None)
+        rg = getattr(work, "row_group", None)
+        if rg is not None:
+            # every member of `free` has spare capacity (pre-filtered in
+            # _pump), so the affine choice is unconditional among them
+            key = hash((getattr(rg, "path", ""), getattr(rg, "row_group", 0)))
+            return free[key % len(free)]
+        return min(free, key=lambda w: len(w.inflight))
+
+    def _pump(self) -> None:
+        """Assign pending items to free workers (round-robin across clients
+        for fairness).  Sends happen outside the lock; assignment state is
+        recorded first, so a failed send surfaces as a worker death whose
+        requeue path recovers the item."""
+        sends: List[Tuple[_WorkerState, Dict]] = []
+        with self._lock:
+            while True:
+                free = [w for w in self._workers.values()
+                        if not w.gone and len(w.inflight) < w.capacity]
+                if not free:
+                    break
+                # round-robin over clients with pending work
+                order = self._client_order
+                candidates = [cid for cid in order
+                              if self._clients[cid].pending]
+                if not candidates:
+                    break
+                self._rr = (self._rr + 1) % len(candidates)
+                cid = candidates[self._rr % len(candidates)]
+                client = self._clients[cid]
+                item = client.pending.popleft()
+                worker = self._pick_worker(item, free)
+                client.inflight[item.ordinal] = _Assignment(item, worker.name)
+                worker.inflight.add((cid, item.ordinal))
+                if cid not in worker.jobs_sent:
+                    worker.jobs_sent.add(cid)
+                    sends.append((worker, {
+                        "t": "job", "client": cid, "factory": client.factory,
+                        "shm_ok": (client.shm_ok
+                                   and client.hostname == worker.hostname)}))
+                sends.append((worker, {"t": "work", "client": cid,
+                                       "item": item}))
+                self._m_assigned.add(1)
+        for worker, msg in sends:
+            try:
+                self._m_bytes_out.add(worker.conn.send(msg))
+            except OSError:
+                # dying worker: its read loop will run _worker_gone, which
+                # requeues everything it held (including this item)
+                logger.debug("send to worker %s failed", worker.name)
+        if sends:
+            self._stamp_gauges()
+
+    def _stamp_gauges(self) -> None:
+        with self._lock:
+            pending = sum(len(c.pending) for c in self._clients.values())
+            inflight = sum(len(c.inflight) for c in self._clients.values())
+        self._g_pending.set(pending)
+        self._g_inflight.set(inflight)
+
+    # -- monitoring / scaling -------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_event.wait(0.5):
+            now = time.monotonic()
+            dead = []
+            hung = {}
+            with self._lock:
+                for name, w in self._workers.items():
+                    if now - w.last_heartbeat > self._heartbeat_timeout_s:
+                        dead.append(name)
+                if self._assignment_deadline_s is not None:
+                    # liveness backstop for workers wedged INSIDE user code:
+                    # they keep heartbeating (the heartbeat thread is
+                    # independent), so a stuck ASSIGNMENT is the signal
+                    for c in self._clients.values():
+                        for ordinal, assign in c.inflight.items():
+                            age = now - assign.assigned_at
+                            if (age > self._assignment_deadline_s
+                                    and assign.worker in self._workers):
+                                hung.setdefault(assign.worker,
+                                                (ordinal, age))
+                expired = [cid for cid, c in self._clients.items()
+                           if not c.connected and c.disconnected_at is not None
+                           and now - c.disconnected_at > self._client_grace_s]
+            for name in dead:
+                logger.warning("Worker %s missed heartbeats for %.0fs;"
+                               " declaring it dead", name,
+                               self._heartbeat_timeout_s)
+                self._worker_gone(name)
+            for name, (ordinal, age) in hung.items():
+                if name in dead:
+                    continue
+                logger.warning(
+                    "Worker %s has held item %s for %.1fs >"
+                    " assignment_deadline_s=%.1f; declaring it hung and"
+                    " dropping it (its items requeue; the remote process"
+                    " exits on the closed connection)", name, ordinal, age,
+                    self._assignment_deadline_s)
+                if self.telemetry.enabled:
+                    self.telemetry.counter(
+                        "service.hung_workers_dropped").add(1)
+                self._worker_gone(name)
+            for cid in expired:
+                self._purge_client(cid, reason="reconnect grace expired")
+            self._g_pressure.set(self.scaling_signal()["pressure"])
+            self._stamp_gauges()
+
+    def scaling_signal(self, window_s: float = 10.0) -> Dict[str, Any]:
+        """Fleet-size pressure from the clients' queue-wait signals.
+
+        ``pressure`` is the aggregate consumer starved-seconds per second
+        over the last ``window_s`` (clients report their
+        ``queue.results_empty_wait_s`` deltas - the exact signal
+        petastorm_tpu.autotune grows local worker pools on).  Crossing the
+        autotune policy's ``starved_threshold`` with work queued means the
+        fleet is the bottleneck -> ``'grow'``; an idle fleet with nothing
+        pending -> ``'shrink'``; else ``'ok'``.
+        """
+        from petastorm_tpu.autotune import AutotunePolicy
+
+        threshold = AutotunePolicy.starved_threshold
+        now = time.monotonic()
+        with self._lock:
+            starved = sum(delta for t, delta in self._starved_reports
+                          if now - t <= window_s)
+            pending = sum(len(c.pending) for c in self._clients.values())
+            inflight = sum(len(c.inflight) for c in self._clients.values())
+            capacity = sum(w.capacity for w in self._workers.values())
+            clients = sum(1 for c in self._clients.values() if c.connected)
+        pressure = starved / window_s
+        busy_frac = (inflight / capacity) if capacity else 0.0
+        if clients and (pressure > threshold or not capacity) \
+                and (pending > 0 or not capacity):
+            recommendation = "grow"
+        elif capacity and clients and busy_frac < 0.1 and pending == 0 \
+                and pressure < threshold / 4:
+            recommendation = "shrink"
+        else:
+            recommendation = "ok"
+        return {"pressure": round(pressure, 4),
+                "starved_threshold": threshold,
+                "busy_fraction": round(busy_frac, 4),
+                "pending_items": pending, "worker_capacity": capacity,
+                "recommendation": recommendation}
+
+    def stats(self) -> Dict[str, Any]:
+        """Point-in-time service snapshot (CLI ``stats`` / tests /
+        operators): fleet membership, per-client progress, counters, and
+        the scaling signal."""
+        with self._lock:
+            workers = {name: {"capacity": w.capacity, "busy": w.busy,
+                              "inflight": len(w.inflight),
+                              "hostname": w.hostname,
+                              "heartbeat_age_s": round(
+                                  time.monotonic() - w.last_heartbeat, 2)}
+                       for name, w in self._workers.items()}
+            clients = {cid: {"connected": c.connected,
+                             "pending": len(c.pending),
+                             "inflight": len(c.inflight),
+                             "unacked": len(c.unacked),
+                             "rows": c.rows, "results": c.results,
+                             "requeued": c.requeued}
+                       for cid, c in self._clients.items()}
+        counters = {}
+        if self.telemetry.enabled:
+            counters = {k: v for k, v in
+                        self.telemetry.snapshot()["counters"].items()
+                        if k.startswith("service.")}
+        return {"uptime_s": round(time.monotonic() - self._started_at, 1),
+                "port": self.port, "workers": workers, "clients": clients,
+                "counters": counters, "scaling": self.scaling_signal()}

@@ -1,0 +1,270 @@
+"""Wire protocol for the disaggregated ingest service.
+
+Lifts ``pool.py``'s ventilate/results contract onto length-prefixed socket
+frames: the objects crossing the wire are the exact objects the in-process
+pools already move - :class:`~petastorm_tpu.pool.VentilatedItem` in,
+``_Ok``-shaped results / picklable ``_Failure`` envelopes out - so the
+client executor and the remote workers reuse the pool semantics (ordinals,
+attempt counts, failure classification) unchanged.
+
+Frame format: a 4-byte big-endian payload length followed by a pickled
+message.  Messages are plain dicts tagged by ``"t"``:
+
+======================  =======================================================
+``client_hello``        client -> dispatcher: client_id, pickled worker
+                        factory, hostname, shm capability, requeue budget,
+                        ``resume`` flag (reconnect of a known client)
+``enqueue``             client -> dispatcher: one VentilatedItem
+``resync``              client -> dispatcher after a reconnect: every item
+                        still in the client's in-flight ledger (dispatcher
+                        dedups by ordinal against its own state)
+``ack``                 client -> dispatcher: delivered ordinals (frees the
+                        dispatcher's redelivery buffer)
+``client_stats``        client -> dispatcher: consumer starved-seconds delta
+                        (the ``queue.results_empty_wait_s`` signal the
+                        autotune controller uses, repurposed as fleet-size
+                        pressure - Dispatcher.scaling_signal)
+``bye``                 client -> dispatcher: clean goodbye (purge state)
+``worker_hello``        worker -> dispatcher: worker name, capacity, hostname
+``heartbeat``           worker -> dispatcher: busy count + telemetry counter
+                        deltas (folded into the dispatcher's ``service.fleet.*``
+                        series)
+``result``/``failure``  worker -> dispatcher -> client: one work item's
+                        outcome (payload-encoded batch, or a pool._Failure)
+``job``                 dispatcher -> worker: a client's pickled worker
+                        factory (sent once per (worker, client) pair)
+``job_done``            dispatcher -> worker: drop that client's factory
+``work``                dispatcher -> worker: one assigned VentilatedItem
+``requeued``            dispatcher -> client: an in-flight item was requeued
+                        off a dead worker (accounting notice)
+``stats?``/``stats``    any -> dispatcher: state snapshot (CLI, tests)
+======================  =======================================================
+
+Result payloads: ``("pickle", value)`` is the portable form (plain frame
+payloads for remote workers).  ``("shm", arena_name, ShmBatchRef)`` is the
+local fast path reusing :mod:`petastorm_tpu.native.transport`'s batch
+encoders: a worker co-located with its client encodes the batch into a
+named shared-memory arena and ships only the descriptor; the client
+attaches the arena by name and decodes zero-copy views whose leases free
+the blocks cross-process.  Armed only when both ends share a host AND the
+native transport plane is available (python >= 3.12 PEP 688, like the
+process pool's shm transport).
+"""
+
+from __future__ import annotations
+
+import pickle
+import select
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.errors import PetastormTpuError
+
+#: protocol version, checked at hello time (bumped on incompatible change)
+PROTOCOL_VERSION = 1
+
+_LEN = struct.Struct("!I")
+#: frames larger than this are refused (a decoded rowgroup batch is tens of
+#: MB; anything approaching this is a corrupt length prefix, not data)
+MAX_FRAME_BYTES = 1 << 30
+
+
+class FrameClosedError(PetastormTpuError):
+    """The peer closed the connection (EOF mid-stream or before a frame)."""
+
+
+class FrameSocket:
+    """A socket speaking length-prefixed pickle frames.
+
+    ``send`` is thread-safe (one lock per socket: the dispatcher's pump and
+    reply paths send to the same worker from different threads).  ``recv``
+    has a single consumer per socket (each connection gets one reader
+    thread) and keeps partial frames across timeouts.
+    """
+
+    def __init__(self, sock: socket.socket):
+        try:
+            # small control frames must not sit in Nagle buffers behind a
+            # large result frame; best-effort (AF_UNIX sockets refuse it)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        # blocking mode, permanently: recv timeouts use select (see _fill),
+        # so a send can never inherit a recv timeout and die mid-frame
+        sock.settimeout(None)
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._buf = bytearray()
+        self._closed = False
+        #: cumulative frame bytes (telemetry: service.frame_bytes_*)
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+    def send(self, msg: Dict[str, Any]) -> int:
+        """Pickle + frame + sendall; returns the frame size in bytes.
+        Raises OSError when the connection is gone."""
+        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        if len(payload) > MAX_FRAME_BYTES:
+            raise PetastormTpuError(
+                f"frame of {len(payload)} bytes exceeds MAX_FRAME_BYTES")
+        frame = _LEN.pack(len(payload)) + payload
+        with self._send_lock:
+            if self._closed:
+                raise OSError("frame socket is closed")
+            self._sock.sendall(frame)
+        self.bytes_sent += len(frame)
+        return len(frame)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Next message, or None on timeout (partial frames are kept and
+        completed by later calls).  Raises FrameClosedError on EOF."""
+        need = _LEN.size
+        header = self._fill(need, timeout)
+        if header is None:
+            return None
+        (length,) = _LEN.unpack(bytes(self._buf[:need]))
+        if length > MAX_FRAME_BYTES:
+            raise PetastormTpuError(
+                f"incoming frame claims {length} bytes (corrupt stream?)")
+        body = self._fill(need + length, timeout)
+        if body is None:
+            return None
+        payload = bytes(self._buf[need:need + length])
+        del self._buf[:need + length]
+        self.bytes_received += need + length
+        return pickle.loads(payload)
+
+    def _fill(self, n: int, timeout: Optional[float]):
+        """Grow the buffer to ``n`` bytes; None on timeout, raises on EOF.
+
+        Timeouts come from ``select``, NOT ``settimeout``: a socket timeout
+        is socket-global, so setting one for recv would also arm it for a
+        concurrent ``sendall`` on another thread - which can then raise
+        after a PARTIAL write of a large frame and permanently desync the
+        length-prefixed stream.  The socket stays blocking throughout;
+        ``recv`` is only called when select reports readability."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while len(self._buf) < n:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+            else:
+                remaining = None
+            try:
+                readable, _, _ = select.select([self._sock], [], [],
+                                               remaining)
+                if not readable:
+                    return None
+                chunk = self._sock.recv(min(1 << 20, n - len(self._buf)))
+            except OSError as exc:
+                raise FrameClosedError(f"connection lost: {exc}") from exc
+            if not chunk:
+                raise FrameClosedError("peer closed the connection")
+            self._buf.extend(chunk)
+        return self._buf
+
+    def close(self) -> None:
+        """Shutdown + close; a blocked peer recv sees EOF immediately."""
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect_frames(address: Tuple[str, int],
+                   timeout: float = 10.0) -> FrameSocket:
+    """Open a FrameSocket to ``(host, port)`` (connect-timeout bounded)."""
+    sock = socket.create_connection(address, timeout=timeout)
+    sock.settimeout(None)
+    return FrameSocket(sock)
+
+
+def parse_address(address) -> Tuple[str, int]:
+    """'host:port' / (host, port) -> (host, port).  The one place the CLI,
+    client and tests agree on the address syntax."""
+    if isinstance(address, (tuple, list)) and len(address) == 2:
+        return str(address[0]), int(address[1])
+    if isinstance(address, str) and ":" in address:
+        host, _, port = address.rpartition(":")
+        return host or "127.0.0.1", int(port)
+    raise PetastormTpuError(
+        f"service address must be 'host:port' or (host, port); got {address!r}")
+
+
+# -- result payload encoding --------------------------------------------------
+
+def shm_transport_available() -> bool:
+    """True when the native arena transport can carry local-fast-path
+    payloads in this process (same gate as the process pool's shm plane)."""
+    from petastorm_tpu.native import is_available
+
+    return is_available()
+
+
+def encode_result(value: Any, arena=None, stop_check=None) -> Tuple:
+    """Worker-side payload encoding.
+
+    With a live ``arena`` (local fast path negotiated) ColumnBatches go
+    through :func:`petastorm_tpu.native.transport.encode_batch` - one
+    producer-side copy into shared memory, a small descriptor on the wire.
+    Everything else (remote clients, object columns, full arena fallback)
+    ships ``("pickle", value)`` - the plain frame payload.
+    """
+    if arena is not None and isinstance(value, ColumnBatch):
+        from petastorm_tpu.native.transport import ShmBatchRef, encode_batch
+
+        ref = encode_batch(arena, value, stop_check=stop_check)
+        if isinstance(ref, ShmBatchRef):
+            return ("shm", arena.name, ref)
+        value = ref  # encode fell back (object columns / arena full)
+    return ("pickle", value)
+
+
+class PayloadDecoder:
+    """Client-side payload decoding; caches attached arenas by name so the
+    local fast path attaches each worker's arena once, not per batch."""
+
+    def __init__(self):
+        self._arenas: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def decode(self, payload: Tuple) -> Any:
+        """Rebuild one result payload (``("pickle", v)`` passthrough;
+        ``("shm", ...)`` attaches the named arena and decodes zero-copy)."""
+        kind = payload[0]
+        if kind == "pickle":
+            return payload[1]
+        if kind == "shm":
+            from petastorm_tpu.native import SharedArena
+            from petastorm_tpu.native.transport import decode_batch
+
+            _, name, ref = payload
+            with self._lock:
+                arena = self._arenas.get(name)
+                if arena is None:
+                    arena = SharedArena.attach(name)
+                    self._arenas[name] = arena
+            return decode_batch(arena, ref)
+        raise PetastormTpuError(f"unknown payload kind {kind!r}")
+
+    def close(self) -> None:
+        """Detach every cached arena (held zero-copy views stay valid
+        until collected, like the process pool's arena close)."""
+        with self._lock:
+            for arena in self._arenas.values():
+                try:
+                    arena.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            self._arenas.clear()

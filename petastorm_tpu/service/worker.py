@@ -1,0 +1,341 @@
+"""Remote ingest worker: runs client worker-factories against dispatched items.
+
+One ``ServiceWorker`` process serves every client of its dispatcher: for
+each client it unpickles the client's worker factory (the exact
+``pool.WorkerFactory`` the in-process executors would have started -
+normally a :class:`~petastorm_tpu.worker.RowGroupDecoderWorker`, possibly
+chaos-wrapped) and runs ``fn(VentilatedItem) -> ColumnBatch`` over its
+assigned items on ``capacity`` processor threads (pyarrow IO and native
+decode release the GIL, same reasoning as the in-process thread pool).
+
+Decode-once sharing: a factory carrying ``cache_type='shared'`` attaches
+this host's warm tier on unpickle, so co-located workers (and repeated
+epochs, and other clients' jobs with matching cache keys) decode each
+rowgroup once fleet-wide - the tier IS the cross-worker data plane
+(docs/operations.md "Warm cache").
+
+Heartbeats carry the worker's busy count plus telemetry counter deltas
+(``decode.*`` / ``worker.*`` / ``cache.*``), which the dispatcher folds
+into its registry as ``service.fleet.*`` - the fleet-wide observable proof
+that each rowgroup decoded at most once.
+
+Crash semantics match the process pool: an exception whose
+``petastorm_tpu_simulated_crash`` attribute is set (the chaos harness's
+hard-kill injection) exits the process with ``os._exit`` - no result, no
+goodbye - and the dispatcher's death detection requeues the in-flight
+items onto surviving workers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import queue
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.pool import VentilatedItem, _Failure
+from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
+                                            FrameClosedError, FrameSocket,
+                                            connect_frames, encode_result,
+                                            parse_address,
+                                            shm_transport_available)
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.telemetry import resolve as _resolve_telemetry
+
+logger = logging.getLogger(__name__)
+
+
+def _inject_telemetry(factory: Any, telemetry) -> None:
+    """Point a (possibly wrapped) worker factory at this process's recorder.
+
+    ``RowGroupDecoderWorker`` resolves its recorder lazily in ``__call__``
+    when ``_telemetry`` is None (the pickled state always is - see its
+    ``__getstate__``); chaos wrappers hold the real factory in ``_inner``.
+    Best-effort by design: an opaque factory just runs unrecorded.
+    """
+    seen = set()
+    while factory is not None and id(factory) not in seen:
+        seen.add(id(factory))
+        if hasattr(factory, "_telemetry"):
+            factory._telemetry = telemetry  # noqa: SLF001 - documented hook
+        factory = getattr(factory, "_inner", None) or getattr(
+            factory, "_worker_factory", None)
+
+
+class ServiceWorker:
+    """One remote worker process/thread of the ingest-service fleet.
+
+    ``capacity``: concurrent items this worker accepts (the dispatcher
+    assigns at most this many in flight); each runs on its own processor
+    thread.  ``shm_size_bytes`` > 0 arms the local fast path: results for
+    co-located clients are encoded into a named shared-memory arena
+    (descriptor on the wire, zero-copy decode client-side) when the native
+    transport plane is available - remote clients always get plain frame
+    payloads.
+    """
+
+    def __init__(self, address, capacity: int = 2, name: Optional[str] = None,
+                 telemetry=None, heartbeat_interval_s: float = 2.0,
+                 shm_size_bytes: int = 0):
+        if capacity < 1:
+            raise PetastormTpuError("ServiceWorker capacity must be >= 1")
+        self._address = parse_address(address)
+        self._capacity = int(capacity)
+        self._name = name
+        #: a private recorder by default: heartbeat counter deltas must not
+        #: entangle with (or pollute) any client telemetry in this process
+        self.telemetry = (_resolve_telemetry(telemetry)
+                          if telemetry is not None else Telemetry())
+        self._hb_interval = float(heartbeat_interval_s)
+        self._shm_size_bytes = int(shm_size_bytes)
+        self._arena = None
+        self._stop_event = threading.Event()
+        self._conn: Optional[FrameSocket] = None
+        self._work: "queue.Queue[tuple]" = queue.Queue()
+        self._busy = 0
+        self._busy_lock = threading.Lock()
+        self._jobs: Dict[str, Dict] = {}   # cid -> {"factory": blob, "shm_ok"}
+        self._fns: Dict[str, Any] = {}     # cid -> built fn
+        self._fn_lock = threading.Lock()
+        self._hb_snapshot: Dict[str, float] = {}
+        self._threads = []
+        self.worker_name: Optional[str] = None
+        self.items_processed = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def stop(self) -> None:
+        """Stop serving: close the dispatcher connection (in-flight items
+        are requeued onto surviving workers by the dispatcher)."""
+        self._stop_event.set()
+        if self._conn is not None:
+            self._conn.close()
+
+    def run(self) -> int:
+        """Connect, register, and serve until the dispatcher goes away or
+        :meth:`stop` is called.  Returns an exit code (0 = clean)."""
+        try:
+            conn = connect_frames(self._address)
+        except OSError as exc:
+            logger.error("Cannot reach dispatcher at %s:%d: %s",
+                         self._address[0], self._address[1], exc)
+            return 1
+        self._conn = conn
+        try:
+            conn.send({"t": "worker_hello", "protocol": PROTOCOL_VERSION,
+                       "worker": self._name, "capacity": self._capacity,
+                       "hostname": socket.gethostname(), "pid": os.getpid()})
+            hello = conn.recv(timeout=10.0)
+        except (OSError, PetastormTpuError) as exc:
+            # a dispatcher mid-restart can accept then reset inside the
+            # hello; surface it as a failed registration (exit code 1) so
+            # run_worker's reconnect loop retries instead of crashing
+            logger.error("Registration handshake failed: %s", exc)
+            conn.close()
+            return 1
+        if not hello or hello.get("t") != "hello_ok":
+            logger.error("Dispatcher refused registration: %r", hello)
+            return 1
+        self.worker_name = hello.get("worker")
+        logger.info("Registered with dispatcher as %s (capacity %d)",
+                    self.worker_name, self._capacity)
+        for i in range(self._capacity):
+            t = threading.Thread(target=self._processor_loop, daemon=True,
+                                 name=f"petastorm-tpu-service-proc-{i}")
+            t.start()
+            self._threads.append(t)
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                              name="petastorm-tpu-service-heartbeat")
+        hb.start()
+        self._threads.append(hb)
+        try:
+            while not self._stop_event.is_set():
+                msg = conn.recv(timeout=1.0)
+                if msg is None:
+                    continue
+                kind = msg.get("t")
+                if kind == "job":
+                    with self._fn_lock:
+                        self._jobs[msg["client"]] = {
+                            "factory": msg["factory"],
+                            "shm_ok": bool(msg.get("shm_ok"))}
+                elif kind == "work":
+                    self._work.put((msg["client"], msg["item"]))
+                elif kind == "job_done":
+                    with self._fn_lock:
+                        self._jobs.pop(msg["client"], None)
+                        self._fns.pop(msg["client"], None)
+                elif kind == "stop":
+                    break
+        except FrameClosedError:
+            if not self._stop_event.is_set():
+                logger.warning("Dispatcher connection closed; worker exiting")
+        finally:
+            self.stop()
+            if self._arena is not None:
+                self._arena.close()
+        return 0
+
+    # -- processing -----------------------------------------------------------
+
+    def _fn_for(self, cid: str):
+        """The built worker function for one client (built once, under a
+        lock: factories open datasets lazily so the build is cheap, but two
+        processor threads must not race it).
+
+        A work frame can arrive moments BEFORE its client's job frame: two
+        dispatcher threads pumping the same worker send job+work1 and work2
+        concurrently, and only bytes - not cross-thread order - are
+        serialized.  The job frame is guaranteed in flight (the dispatcher
+        marks the pair before sending any work for it), so wait briefly
+        for it instead of failing the item; the wait loop releases the lock
+        so the read loop can register the arriving job."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            with self._fn_lock:
+                fn = self._fns.get(cid)
+                if fn is not None:
+                    return fn
+                job = self._jobs.get(cid)
+                if job is not None:
+                    factory = pickle.loads(job["factory"])
+                    _inject_telemetry(factory, self.telemetry)
+                    fn = factory()
+                    self._fns[cid] = fn
+                    return fn
+            if time.monotonic() > deadline or self._stop_event.is_set():
+                raise PetastormTpuError(
+                    f"work for unknown client {cid!r} (no job spec received"
+                    " within 5s)")
+            time.sleep(0.01)
+
+    def _arena_for(self, cid: str):
+        """The shm arena for local-fast-path encoding, or None (remote
+        client, shm disabled, or the native plane is unavailable)."""
+        if self._shm_size_bytes <= 0 or not shm_transport_available():
+            return None
+        with self._fn_lock:
+            job = self._jobs.get(cid)
+            if job is None or not job["shm_ok"]:
+                return None
+            if self._arena is None:
+                from petastorm_tpu.native import SharedArena
+
+                self._arena = SharedArena.create(self._shm_size_bytes)
+            return self._arena
+
+    def _processor_loop(self) -> None:
+        tele = self.telemetry
+        while not self._stop_event.is_set():
+            try:
+                cid, item = self._work.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            with self._busy_lock:
+                self._busy += 1
+            ordinal = getattr(item, "ordinal", None)
+            attempt = getattr(item, "attempt", 0)
+            try:
+                try:
+                    fn = self._fn_for(cid)
+                    result = fn(item)
+                except BaseException as exc:  # noqa: BLE001 - forwarded
+                    if getattr(exc, "petastorm_tpu_simulated_crash", False):
+                        # chaos harness: die like the OOM killer struck -
+                        # no result, no goodbye; the dispatcher's death
+                        # detection requeues our in-flight items
+                        os._exit(137)
+                    self._send({"t": "failure", "client": cid,
+                                "ordinal": ordinal, "attempt": attempt,
+                                "failure": _Failure(exc, ordinal=ordinal,
+                                                    item=item)})
+                else:
+                    try:
+                        payload = encode_result(
+                            result, arena=self._arena_for(cid),
+                            stop_check=self._stop_event.is_set)
+                        self._send({"t": "result", "client": cid,
+                                    "ordinal": ordinal, "attempt": attempt,
+                                    "rows": getattr(result, "num_rows", 0),
+                                    "payload": payload})
+                    except Exception as exc:  # noqa: BLE001 - must answer
+                        # an unencodable result (unpicklable transform
+                        # output, oversize frame) must become a classified
+                        # failure, not a silently-dead processor thread and
+                        # a forever-hanging client ordinal
+                        logger.warning("result for item %s not encodable;"
+                                       " forwarding as failure", ordinal,
+                                       exc_info=True)
+                        self._send({"t": "failure", "client": cid,
+                                    "ordinal": ordinal, "attempt": attempt,
+                                    "failure": _Failure(exc, ordinal=ordinal,
+                                                        item=item)})
+                    else:
+                        self.items_processed += 1
+                        if tele.enabled:
+                            tele.counter("service.worker_results").add(1)
+            finally:
+                with self._busy_lock:
+                    self._busy -= 1
+
+    def _send(self, msg: Dict) -> None:
+        conn = self._conn
+        if conn is None:
+            return
+        try:
+            conn.send(msg)
+        except OSError:
+            # dispatcher gone mid-send: the read loop notices EOF and exits;
+            # the dispatcher requeues whatever we held
+            logger.debug("result send failed (dispatcher gone?)")
+
+    # -- heartbeat ------------------------------------------------------------
+
+    def _counter_deltas(self) -> Dict[str, float]:
+        """Per-heartbeat deltas of this process's decode/cache/worker
+        counters (FLEET_COUNTER_PREFIXES on the dispatcher side)."""
+        if not self.telemetry.enabled:
+            return {}
+        counters = self.telemetry.snapshot().get("counters", {})
+        deltas = {}
+        for name, value in counters.items():
+            prev = self._hb_snapshot.get(name, 0.0)
+            if value > prev:
+                deltas[name] = value - prev
+            self._hb_snapshot[name] = value
+        return deltas
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop_event.wait(self._hb_interval):
+            with self._busy_lock:
+                busy = self._busy + self._work.qsize()
+            self._send({"t": "heartbeat", "busy": busy,
+                        "counters": self._counter_deltas()})
+
+
+def run_worker(address, capacity: int = 2, name: Optional[str] = None,
+               shm_size_bytes: int = 0,
+               reconnect_attempts: int = 0,
+               reconnect_backoff_s: float = 1.0) -> int:
+    """Blocking worker entry (the CLI's ``worker`` subcommand).
+
+    ``reconnect_attempts`` > 0 makes the worker survive dispatcher
+    restarts: after losing the connection it retries registration that
+    many times with a fixed backoff (elastic fleets keep workers running
+    while the control plane reschedules)."""
+    attempts_left = reconnect_attempts
+    while True:
+        worker = ServiceWorker(address, capacity=capacity, name=name,
+                               shm_size_bytes=shm_size_bytes)
+        rc = worker.run()
+        if attempts_left <= 0:
+            return rc
+        attempts_left -= 1
+        logger.info("Reconnecting to dispatcher in %.1fs (%d attempt(s)"
+                    " left)", reconnect_backoff_s, attempts_left + 1)
+        time.sleep(reconnect_backoff_s)

@@ -11,9 +11,12 @@ from __future__ import annotations
 from typing import Dict, List
 
 #: canonical pipeline order (SURVEY.md section 7).  Stages outside this list
-#: (component-private sub-stages) render after the known ones.
-STAGE_ORDER = ("ventilate", "decode", "transform", "host-assemble",
-               "host-prep", "device-transfer")
+#: (component-private sub-stages) render after the known ones.  ``service``
+#: is the disaggregated-ingest client stage (result receive/decode for
+#: ``make_reader(service_address=...)`` readers) - between ventilation and
+#: the local decode path it replaces.
+STAGE_ORDER = ("ventilate", "service", "decode", "transform",
+               "host-assemble", "host-prep", "device-transfer")
 
 #: queue-wait counters -> how the report explains them.  Queue-FULL waits
 #: point the finger downstream (the stage after the queue is the bottleneck);

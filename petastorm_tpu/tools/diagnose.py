@@ -340,6 +340,21 @@ def render_watch_frame(point: Dict, diagnostics: Optional[Dict] = None,
             f"  hit-rate {100.0 * hit_rate:5.1f}%"
             f"  L1 {gauges.get('cache.bytes', 0.0) / 2 ** 20:.0f}MB"
             f"  evictions {counters.get('cache.evictions', 0):g}")
+    if any(n.startswith("service.") for n in counters) \
+            or any(n.startswith("service.") for n in gauges):
+        # the disaggregated ingest plane's pulse (client-side series): a
+        # just-started fleet with nothing delivered yet renders an explicit
+        # "(no samples yet)" line instead of vanishing from the frame
+        results_total = counters.get("service.results", 0)
+        if results_total:
+            lines.append(
+                f"service: {rates.get('service.results', 0.0):6.1f} results/s"
+                f"  {rates.get('service.frame_bytes_received', 0.0) / 2 ** 10:8.1f} KB/s in"
+                f"  requeued {counters.get('service.requeued_items', 0):g}"
+                f"  reconnects {counters.get('service.reconnects', 0):g}"
+                f"  connected {gauges.get('service.connected', 0):g}")
+        else:
+            lines.append("service: (no samples yet)")
     faults = {n: v for n, v in counters.items()
               if n.startswith(_WATCH_FAULT_PREFIXES) and v}
     if faults:

@@ -34,6 +34,17 @@ epoch; the ISSUE 7 target is vs_baseline >= 1.0 against its 3.0x bar) or in
 ``warm_cache_cross_reader_hit_rate`` (fraction of reader B's first-epoch
 items served from the tier; 1.0 = fully warm) as a code regression even in
 a session whose absolute rates drifted.
+
+Service metrics (BENCH_r08+, docs/operations.md "Disaggregated ingest
+service"): ``service_ingest_samples_per_sec`` is the remote fleet's
+delivery rate (dispatcher + 2 worker subprocesses, pickle frames) and
+drifts with the host like any absolute rate;
+``service_inprocess_anchor_samples_per_sec`` is the same read through the
+in-process thread pool in the same session; their quotient
+``service_vs_inprocess_ratio`` is the SAME-SESSION-anchored, drift-immune
+member - it prices the wire-transport tax (r08: 0.36x on ~5MB pixel
+batches), so a drop in the RATIO means the service plane itself regressed
+even when both absolute rates moved with the host.
 """
 
 from __future__ import annotations
