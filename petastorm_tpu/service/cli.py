@@ -9,6 +9,12 @@ Usage::
 Topology and sizing guidance: docs/operations.md "Disaggregated ingest
 service".  Trainers connect with ``make_reader(...,
 service_address='HOST:7737')``.
+
+The dispatcher binds loopback by default: the wire protocol is pickled
+frames and workers execute client-shipped code, so exposing the port IS
+exposing remote code execution.  Bind other interfaces only on trusted
+networks, with a shared handshake secret (``$PETASTORM_TPU_SERVICE_TOKEN``
+or ``--auth-token-file``) set on every party.
 """
 
 from __future__ import annotations
@@ -21,15 +27,28 @@ import time
 from typing import List, Optional
 
 
+_TRUST_WARNING = (
+    "SECURITY: the wire protocol is pickled python frames and workers"
+    " execute client-supplied code - anyone who can reach the dispatcher"
+    " port can run arbitrary code on every fleet member and client.  Only"
+    " expose it on trusted networks, and set a shared secret via"
+    " $PETASTORM_TPU_SERVICE_TOKEN or --auth-token-file (all parties must"
+    " agree).  See docs/operations.md 'Disaggregated ingest service'.")
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="petastorm-tpu-service",
-        description="Disaggregated ingest service: dispatcher + workers")
+        description="Disaggregated ingest service: dispatcher + workers",
+        epilog=_TRUST_WARNING)
     sub = parser.add_subparsers(dest="command", required=True)
 
-    d = sub.add_parser("dispatcher", help="run the dispatcher control plane")
-    d.add_argument("--host", default="0.0.0.0",
-                   help="bind address (default all interfaces)")
+    d = sub.add_parser("dispatcher", help="run the dispatcher control plane",
+                       epilog=_TRUST_WARNING)
+    d.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default loopback; binding a"
+                   " non-loopback interface exposes remote code execution"
+                   " to that network - see the SECURITY note below)")
     d.add_argument("--port", type=int, default=7737,
                    help="listen port (0 = ephemeral, printed at start)")
     d.add_argument("--heartbeat-timeout", type=float, default=10.0,
@@ -53,8 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--stats-interval", type=float, default=0.0, metavar="S",
                    help="print a JSON stats line (fleet, clients, scaling"
                    " signal) every S seconds (0 = off)")
+    d.add_argument("--auth-token-file", default=None, metavar="PATH",
+                   help="file holding the shared handshake secret every"
+                   " hello must present (overrides"
+                   " $PETASTORM_TPU_SERVICE_TOKEN)")
 
-    w = sub.add_parser("worker", help="run one fleet worker")
+    w = sub.add_parser("worker", help="run one fleet worker",
+                       epilog=_TRUST_WARNING)
     w.add_argument("--address", required=True, metavar="HOST:PORT",
                    help="dispatcher address")
     w.add_argument("--capacity", type=int, default=2,
@@ -69,10 +93,28 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--reconnect-attempts", type=int, default=0,
                    help="survive dispatcher restarts: retry registration"
                    " this many times (default 0 = exit with the dispatcher)")
+    w.add_argument("--auth-token-file", default=None, metavar="PATH",
+                   help="file holding the dispatcher's shared handshake"
+                   " secret (overrides $PETASTORM_TPU_SERVICE_TOKEN)")
 
     s = sub.add_parser("stats", help="print one dispatcher stats snapshot")
     s.add_argument("--address", required=True, metavar="HOST:PORT")
+    s.add_argument("--auth-token-file", default=None, metavar="PATH",
+                   help="file holding the dispatcher's shared handshake"
+                   " secret (overrides $PETASTORM_TPU_SERVICE_TOKEN)")
     return parser
+
+
+def _auth_token(args) -> Optional[str]:
+    """The handshake secret for this invocation: --auth-token-file wins,
+    else $PETASTORM_TPU_SERVICE_TOKEN (resolved by each component)."""
+    if args.auth_token_file is None:
+        return None
+    with open(args.auth_token_file, encoding="utf-8") as f:
+        token = f.read().strip()
+    if not token:
+        raise SystemExit(f"auth token file {args.auth_token_file} is empty")
+    return token
 
 
 def _run_dispatcher(args) -> int:
@@ -88,7 +130,8 @@ def _run_dispatcher(args) -> int:
                               if args.max_requeue_attempts is not None
                               else DEFAULT_REQUEUE_ATTEMPTS),
         assignment_deadline_s=args.assignment_deadline,
-        metrics_port=args.metrics_port)
+        metrics_port=args.metrics_port,
+        auth_token=_auth_token(args))
     dispatcher.start()
     print(f"dispatcher listening on {args.host}:{dispatcher.port}",
           flush=True)
@@ -115,18 +158,21 @@ def _run_worker(args) -> int:
         return run_worker(args.address, capacity=args.capacity,
                           name=args.name,
                           shm_size_bytes=args.shm_size_mb * 2 ** 20,
-                          reconnect_attempts=args.reconnect_attempts)
+                          reconnect_attempts=args.reconnect_attempts,
+                          auth_token=_auth_token(args))
     except KeyboardInterrupt:
         return 0
 
 
 def _run_stats(args) -> int:
     from petastorm_tpu.service.protocol import (connect_frames,
-                                                parse_address)
+                                                parse_address,
+                                                resolve_auth_token)
 
     conn = connect_frames(parse_address(args.address))
     try:
-        conn.send({"t": "stats?"})
+        conn.send({"t": "stats?",
+                   "token": resolve_auth_token(_auth_token(args))})
         reply = conn.recv(timeout=10.0)
     finally:
         conn.close()

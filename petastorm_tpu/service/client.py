@@ -38,7 +38,7 @@ from petastorm_tpu.retry import RetryPolicy
 from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
                                             FrameClosedError, FrameSocket,
                                             PayloadDecoder, connect_frames,
-                                            parse_address,
+                                            parse_address, resolve_auth_token,
                                             shm_transport_available)
 
 logger = logging.getLogger(__name__)
@@ -96,12 +96,16 @@ class ServiceExecutor(ExecutorBase):
                  max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
                  window: int = DEFAULT_WINDOW,
                  reconnect_policy: Optional[RetryPolicy] = None,
-                 client_id: Optional[str] = None):
+                 client_id: Optional[str] = None,
+                 auth_token: Optional[str] = None):
         super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
                          max_requeue_attempts=max_requeue_attempts)
         if window < 1:
             raise PetastormTpuError("ServiceExecutor window must be >= 1")
         self._address = parse_address(address)
+        #: handshake secret (default $PETASTORM_TPU_SERVICE_TOKEN); must
+        #: match the dispatcher's when it enforces one
+        self._auth_token = resolve_auth_token(auth_token)
         self._window = int(window)
         self._reconnect_policy = reconnect_policy or RetryPolicy(
             max_attempts=5, initial_backoff_s=0.2, max_backoff_s=2.0)
@@ -118,6 +122,7 @@ class ServiceExecutor(ExecutorBase):
         self._decoder = PayloadDecoder()
         self._factory_blob: Optional[bytes] = None
         self._reconnects = 0
+        self._last_connect_error: Optional[str] = None
         self._bytes_in_folded = 0
         self._starved_s = 0.0
         self._stats_sent_at = 0.0
@@ -164,7 +169,7 @@ class ServiceExecutor(ExecutorBase):
                    "hostname": socket.gethostname(),
                    "shm_ok": shm_transport_available(),
                    "max_requeue": self._max_requeue,
-                   "resume": resume})
+                   "resume": resume, "token": self._auth_token})
         hello = conn.recv(timeout=10.0)
         if not hello or hello.get("t") != "hello_ok":
             conn.close()
@@ -310,11 +315,18 @@ class ServiceExecutor(ExecutorBase):
                 self._connected.clear()
                 if not self._reconnect():
                     self._conn_failed.set()  # release put() waiters first
+                    # the last per-attempt error distinguishes a dead/
+                    # unreachable dispatcher from a deterministic refusal
+                    # (e.g. 'bad auth token' after a dispatcher restart
+                    # with a new secret) - without it the operator debugs
+                    # the network instead of the token
+                    detail = (f" (last attempt: {self._last_connect_error})"
+                              if self._last_connect_error else "")
                     self._results.put(_ConnLost(
                         f"dispatcher connection to"
                         f" {self._address[0]}:{self._address[1]} lost and"
                         f" {self._reconnect_policy.max_attempts} reconnect"
-                        " attempt(s) failed"))
+                        f" attempt(s) failed{detail}"))
                     return
                 continue
             if msg is None:
@@ -382,10 +394,11 @@ class ServiceExecutor(ExecutorBase):
                 time.sleep(_POLL_S)
             try:
                 self._connect(resume=True)
-            except (OSError, PetastormTpuError):
+            except (OSError, PetastormTpuError) as exc:
                 # OSError = refused/unreachable; PetastormTpuError covers a
                 # half-dead accept (FrameClosedError mid-hello: the listener
                 # backlog accepted us, then the dying dispatcher reset)
+                self._last_connect_error = str(exc)
                 backoff *= p.backoff_multiplier
                 continue
             self._reconnects += 1

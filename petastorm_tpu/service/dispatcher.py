@@ -41,12 +41,14 @@ import logging
 import socket
 import threading
 import time
+import zlib
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 from petastorm_tpu.errors import DEFAULT_REQUEUE_ATTEMPTS, PetastormTpuError
 from petastorm_tpu.pool import VentilatedItem
 from petastorm_tpu.service.protocol import (PROTOCOL_VERSION, FrameClosedError,
-                                            FrameSocket)
+                                            FrameSocket, resolve_auth_token,
+                                            token_matches)
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
@@ -136,6 +138,11 @@ class Dispatcher:
     ``max_requeue_attempts``: default per-item budget; each client's hello
     may carry its own (the reader's ``on_error`` policy budget travels with
     the job, keeping service and in-process semantics identical).
+    ``auth_token``: shared handshake secret; defaults to
+    ``$PETASTORM_TPU_SERVICE_TOKEN``.  When set, every hello (worker,
+    client, stats) must present it or the connection is refused.  The wire
+    is pickled frames - see the protocol module's trust-boundary warning:
+    only ever listen on trusted networks.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
@@ -144,7 +151,8 @@ class Dispatcher:
                  client_grace_s: float = 30.0,
                  max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
                  assignment_deadline_s: Optional[float] = None,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 auth_token: Optional[str] = None):
         if assignment_deadline_s is not None and assignment_deadline_s <= 0:
             raise PetastormTpuError(
                 "assignment_deadline_s must be > 0 or None")
@@ -154,6 +162,7 @@ class Dispatcher:
         self._client_grace_s = float(client_grace_s)
         self._assignment_deadline_s = assignment_deadline_s
         self._max_requeue = int(max_requeue_attempts)
+        self._auth_token = resolve_auth_token(auth_token)
         self.telemetry = _resolve_telemetry(telemetry)
         self._lock = threading.RLock()
         self._workers: Dict[str, _WorkerState] = {}
@@ -214,6 +223,15 @@ class Dispatcher:
                 self.telemetry, port=self._metrics_port)
             self.metrics_server.start()
         logger.info("Dispatcher listening on %s:%d", self._host, self.port)
+        if self._auth_token is None and self._host not in (
+                "127.0.0.1", "localhost", "::1"):
+            logger.warning(
+                "Dispatcher is listening on %s with NO auth token: the wire"
+                " protocol is pickled frames, so anyone who can reach this"
+                " port can execute arbitrary code on the dispatcher, the"
+                " fleet, and every client.  Restrict to a trusted network"
+                " and set $PETASTORM_TPU_SERVICE_TOKEN (docs/operations.md"
+                " 'Disaggregated ingest service').", self._host)
         return self
 
     def stop(self) -> None:
@@ -279,6 +297,19 @@ class Dispatcher:
             conn.close()
             return
         kind = hello.get("t")
+        if not token_matches(self._auth_token, hello.get("token")):
+            # auth gate before ANY hello processing: an untokened peer gets
+            # a refusal and a closed socket, never a registered state
+            logger.warning("Refusing %r connection: bad/missing auth token",
+                           kind)
+            if self.telemetry.enabled:
+                self.telemetry.counter("service.auth_rejected").add(1)
+            try:
+                conn.send({"t": "error", "error": "bad auth token"})
+            except OSError:
+                pass
+            conn.close()
+            return
         try:
             if kind == "worker_hello":
                 self._worker_loop(conn, hello)
@@ -353,6 +384,10 @@ class Dispatcher:
         cid, ordinal = msg["client"], msg["ordinal"]
         state.last_heartbeat = time.monotonic()
         duplicate = False
+        # ONE critical section from duplicate check to outcome recording:
+        # splitting them would let _purge_client (grace expiry, bye) pop
+        # the client in between, silently losing the result into an
+        # orphaned _ClientState
         with self._lock:
             state.inflight.discard((cid, ordinal))
             client = self._clients.get(cid)
@@ -361,6 +396,16 @@ class Dispatcher:
                 # delivered first, or the client was purged): drop - the
                 # client-side ledger would drop it anyway
                 duplicate = True
+                conn = None
+            else:
+                out = {"t": "result", "ordinal": ordinal,
+                       "attempt": msg.get("attempt", 0),
+                       "payload": msg["payload"], "rows": msg.get("rows", 0),
+                       "worker": state.name}
+                client.unacked[ordinal] = out
+                client.results += 1
+                client.rows += int(msg.get("rows", 0))
+                conn = client.conn if client.connected else None
         if duplicate:
             # outside the lock: _pump's sends must never run while this
             # thread holds the dispatcher lock (a worker with a full TCP
@@ -369,15 +414,6 @@ class Dispatcher:
             self._stamp_gauges()
             self._pump()
             return
-        with self._lock:
-            out = {"t": "result", "ordinal": ordinal,
-                   "attempt": msg.get("attempt", 0),
-                   "payload": msg["payload"], "rows": msg.get("rows", 0),
-                   "worker": state.name}
-            client.unacked[ordinal] = out
-            client.results += 1
-            client.rows += int(msg.get("rows", 0))
-            conn = client.conn if client.connected else None
         self._m_completed.add(1)
         self._m_rows.add(int(msg.get("rows", 0)))
         if self.telemetry.enabled:
@@ -626,18 +662,35 @@ class Dispatcher:
 
     # -- assignment -----------------------------------------------------------
 
-    def _pick_worker(self, item: VentilatedItem,
-                     free: List[_WorkerState]) -> _WorkerState:
+    def _pick_worker(self, item: VentilatedItem, free: List[_WorkerState],
+                     stable: Optional[List[str]] = None) -> _WorkerState:
         """Rowgroup-affine choice among workers with spare capacity: the
         same rowgroup prefers the same worker (warm-tier locality), falling
-        back to least-loaded."""
+        back to least-loaded.
+
+        The affine worker is ``crc32(path:rowgroup)`` modulo the stable
+        name-sorted list of ALL live workers - a deterministic digest
+        (built-in ``hash()`` is PYTHONHASHSEED-randomized per process) over
+        a membership-stable list (indexing the momentary free list would
+        move the mapping whenever fleet load shifts), so affinity survives
+        dispatcher restarts and load churn.  Only when the affine worker is
+        saturated does the item go to the least-loaded free one.
+
+        ``stable`` lets _pump hoist the sorted name list out of its
+        assignment loop (membership cannot change while it holds the lock).
+        """
         work = getattr(item, "item", None)
         rg = getattr(work, "row_group", None)
         if rg is not None:
-            # every member of `free` has spare capacity (pre-filtered in
-            # _pump), so the affine choice is unconditional among them
-            key = hash((getattr(rg, "path", ""), getattr(rg, "row_group", 0)))
-            return free[key % len(free)]
+            if stable is None:
+                stable = sorted(w.name for w in self._workers.values()
+                                if not w.gone)
+            key = zlib.crc32(
+                f"{getattr(rg, 'path', '')}:{getattr(rg, 'row_group', 0)}"
+                .encode())
+            affine = self._workers.get(stable[key % len(stable)])
+            if affine is not None and affine in free:
+                return affine
         return min(free, key=lambda w: len(w.inflight))
 
     def _pump(self) -> None:
@@ -647,6 +700,8 @@ class Dispatcher:
         requeue path recovers the item."""
         sends: List[Tuple[_WorkerState, Dict]] = []
         with self._lock:
+            stable = sorted(w.name for w in self._workers.values()
+                            if not w.gone)
             while True:
                 free = [w for w in self._workers.values()
                         if not w.gone and len(w.inflight) < w.capacity]
@@ -662,7 +717,7 @@ class Dispatcher:
                 cid = candidates[self._rr % len(candidates)]
                 client = self._clients[cid]
                 item = client.pending.popleft()
-                worker = self._pick_worker(item, free)
+                worker = self._pick_worker(item, free, stable)
                 client.inflight[item.ordinal] = _Assignment(item, worker.name)
                 worker.inflight.add((cid, item.ordinal))
                 if cid not in worker.jobs_sent:

@@ -49,10 +49,22 @@ attaches the arena by name and decodes zero-copy views whose leases free
 the blocks cross-process.  Armed only when both ends share a host AND the
 native transport plane is available (python >= 3.12 PEP 688, like the
 process pool's shm transport).
+
+.. warning:: **Trust boundary.** Frames are pickled python objects and the
+   ``client_hello`` factory is a callable the workers execute: anyone who
+   can complete a handshake can run arbitrary code on the dispatcher, the
+   fleet, and (via forwarded result/failure frames) every trainer client.
+   The service must only ever listen on trusted networks - the dispatcher
+   CLI binds loopback by default - and a shared secret
+   (:data:`AUTH_TOKEN_ENV` / ``auth_token=``) gates the handshake.  The
+   token is an access control for a trusted perimeter, NOT a substitute
+   for one: token holders still get code execution by design.
 """
 
 from __future__ import annotations
 
+import hmac
+import os
 import pickle
 import select
 import socket
@@ -71,6 +83,33 @@ _LEN = struct.Struct("!I")
 #: frames larger than this are refused (a decoded rowgroup batch is tens of
 #: MB; anything approaching this is a corrupt length prefix, not data)
 MAX_FRAME_BYTES = 1 << 30
+#: a peer that cannot drain a frame for this long is declared dead (a
+#: paused/SIGSTOPped trainer with a full TCP buffer must not wedge the
+#: dispatcher thread sending to it - see FrameSocket.send)
+SEND_TIMEOUT_S = 30.0
+#: non-blocking-send flag (0 where unsupported: send then degrades to the
+#: old unbounded blocking behavior rather than breaking)
+_MSG_DONTWAIT = getattr(socket, "MSG_DONTWAIT", 0)
+#: environment variable all parties read their shared handshake secret
+#: from (the CLI's --auth-token-file overrides it)
+AUTH_TOKEN_ENV = "PETASTORM_TPU_SERVICE_TOKEN"
+
+
+def resolve_auth_token(explicit: Optional[str] = None) -> Optional[str]:
+    """The handshake secret: the explicit value if given, else
+    :data:`AUTH_TOKEN_ENV`, else None (auth disabled)."""
+    if explicit is not None:
+        return explicit
+    return os.environ.get(AUTH_TOKEN_ENV) or None
+
+
+def token_matches(expected: Optional[str], presented: Any) -> bool:
+    """Constant-time handshake token check (True when auth is off)."""
+    if expected is None:
+        return True
+    if not isinstance(presented, str):
+        return False
+    return hmac.compare_digest(expected.encode(), presented.encode())
 
 
 class FrameClosedError(PetastormTpuError):
@@ -84,9 +123,15 @@ class FrameSocket:
     reply paths send to the same worker from different threads).  ``recv``
     has a single consumer per socket (each connection gets one reader
     thread) and keeps partial frames across timeouts.
+
+    ``send_timeout_s`` bounds how long one send may block on a peer that
+    stops draining its TCP buffer; expiry declares the peer dead (the
+    socket is closed - a partial frame would desync the stream anyway) and
+    raises OSError, which every caller already treats as a dead peer.
     """
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket,
+                 send_timeout_s: float = SEND_TIMEOUT_S):
         try:
             # small control frames must not sit in Nagle buffers behind a
             # large result frame; best-effort (AF_UNIX sockets refuse it)
@@ -100,13 +145,16 @@ class FrameSocket:
         self._send_lock = threading.Lock()
         self._buf = bytearray()
         self._closed = False
+        self.send_timeout_s = send_timeout_s
         #: cumulative frame bytes (telemetry: service.frame_bytes_*)
         self.bytes_sent = 0
         self.bytes_received = 0
 
     def send(self, msg: Dict[str, Any]) -> int:
-        """Pickle + frame + sendall; returns the frame size in bytes.
-        Raises OSError when the connection is gone."""
+        """Pickle + frame + bounded write; returns the frame size in bytes.
+        Raises OSError when the connection is gone or the peer stops
+        draining for longer than ``send_timeout_s`` (the socket is then
+        closed: a partially-written frame cannot be resumed)."""
         payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
         if len(payload) > MAX_FRAME_BYTES:
             raise PetastormTpuError(
@@ -115,22 +163,68 @@ class FrameSocket:
         with self._send_lock:
             if self._closed:
                 raise OSError("frame socket is closed")
-            self._sock.sendall(frame)
-        self.bytes_sent += len(frame)
+            deadline = (None if self.send_timeout_s is None
+                        else time.monotonic() + self.send_timeout_s)
+            view = memoryview(frame)
+            while view:
+                if deadline is None:
+                    remaining = None
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.close()
+                        raise OSError(
+                            f"peer did not drain a {len(frame)}-byte frame"
+                            f" within {self.send_timeout_s}s; declaring it"
+                            " dead")
+                try:
+                    # non-blocking attempt first, select only on a full
+                    # buffer: AF_UNIX sockets report not-writable long
+                    # before a blocking send would block, so select-first
+                    # would falsely time out on merely-slow local peers
+                    sent = self._sock.send(view, _MSG_DONTWAIT)
+                    view = view[sent:]
+                    if sent and deadline is not None:
+                        # the timeout bounds a DRAIN STALL, not the whole
+                        # frame: a peer accepting bytes - however slowly -
+                        # is alive, so progress re-arms the deadline (a
+                        # tens-of-MB result on a slow link must not be
+                        # declared dead mid-transfer)
+                        deadline = time.monotonic() + self.send_timeout_s
+                except BlockingIOError:
+                    # buffer genuinely full: wait for drain with a deadline
+                    # so a stalled peer blocks HERE boundedly, never inside
+                    # a blocking sendall.  Short slices, because AF_UNIX
+                    # writability is stricter than EAGAIN - a slowly
+                    # draining peer can accept sends while select still
+                    # reports not-writable
+                    wait = 0.05 if remaining is None else min(remaining, 0.05)
+                    try:
+                        select.select([], [self._sock], [], wait)
+                    except ValueError as exc:
+                        # select on a concurrently-closed socket (fd -1)
+                        raise OSError(
+                            f"frame socket closed mid-send: {exc}") from exc
+            self.bytes_sent += len(frame)
         return len(frame)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
         """Next message, or None on timeout (partial frames are kept and
-        completed by later calls).  Raises FrameClosedError on EOF."""
+        completed by later calls).  Raises FrameClosedError on EOF.  One
+        deadline covers header AND body: the call returns within
+        ``timeout`` total, not per fill."""
+        if self._closed:
+            raise FrameClosedError("frame socket is closed")
+        deadline = None if timeout is None else time.monotonic() + timeout
         need = _LEN.size
-        header = self._fill(need, timeout)
+        header = self._fill(need, deadline)
         if header is None:
             return None
         (length,) = _LEN.unpack(bytes(self._buf[:need]))
         if length > MAX_FRAME_BYTES:
             raise PetastormTpuError(
                 f"incoming frame claims {length} bytes (corrupt stream?)")
-        body = self._fill(need + length, timeout)
+        body = self._fill(need + length, deadline)
         if body is None:
             return None
         payload = bytes(self._buf[need:need + length])
@@ -138,17 +232,16 @@ class FrameSocket:
         self.bytes_received += need + length
         return pickle.loads(payload)
 
-    def _fill(self, n: int, timeout: Optional[float]):
-        """Grow the buffer to ``n`` bytes; None on timeout, raises on EOF.
+    def _fill(self, n: int, deadline: Optional[float]):
+        """Grow the buffer to ``n`` bytes; None once ``deadline`` (an
+        absolute monotonic instant) passes, raises on EOF.
 
         Timeouts come from ``select``, NOT ``settimeout``: a socket timeout
         is socket-global, so setting one for recv would also arm it for a
-        concurrent ``sendall`` on another thread - which can then raise
-        after a PARTIAL write of a large frame and permanently desync the
+        concurrent send on another thread - which can then raise after a
+        PARTIAL write of a large frame and permanently desync the
         length-prefixed stream.  The socket stays blocking throughout;
         ``recv`` is only called when select reports readability."""
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
         while len(self._buf) < n:
             if deadline is not None:
                 remaining = deadline - time.monotonic()
@@ -164,6 +257,13 @@ class FrameSocket:
                 chunk = self._sock.recv(min(1 << 20, n - len(self._buf)))
             except OSError as exc:
                 raise FrameClosedError(f"connection lost: {exc}") from exc
+            except ValueError as exc:
+                # select on a locally-closed socket (fd -1, e.g. a
+                # send-timeout death on another thread): same terminal
+                # condition as EOF, and it must map to FrameClosedError so
+                # read loops reconnect instead of crashing on ValueError
+                raise FrameClosedError(
+                    f"frame socket closed locally: {exc}") from exc
             if not chunk:
                 raise FrameClosedError("peer closed the connection")
             self._buf.extend(chunk)

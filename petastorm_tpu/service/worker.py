@@ -42,7 +42,7 @@ from petastorm_tpu.pool import VentilatedItem, _Failure
 from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
                                             FrameClosedError, FrameSocket,
                                             connect_frames, encode_result,
-                                            parse_address,
+                                            parse_address, resolve_auth_token,
                                             shm_transport_available)
 from petastorm_tpu.telemetry import Telemetry
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
@@ -81,10 +81,13 @@ class ServiceWorker:
 
     def __init__(self, address, capacity: int = 2, name: Optional[str] = None,
                  telemetry=None, heartbeat_interval_s: float = 2.0,
-                 shm_size_bytes: int = 0):
+                 shm_size_bytes: int = 0, auth_token: Optional[str] = None):
         if capacity < 1:
             raise PetastormTpuError("ServiceWorker capacity must be >= 1")
         self._address = parse_address(address)
+        #: handshake secret (default $PETASTORM_TPU_SERVICE_TOKEN); must
+        #: match the dispatcher's when it enforces one
+        self._auth_token = resolve_auth_token(auth_token)
         self._capacity = int(capacity)
         self._name = name
         #: a private recorder by default: heartbeat counter deltas must not
@@ -129,7 +132,8 @@ class ServiceWorker:
         try:
             conn.send({"t": "worker_hello", "protocol": PROTOCOL_VERSION,
                        "worker": self._name, "capacity": self._capacity,
-                       "hostname": socket.gethostname(), "pid": os.getpid()})
+                       "hostname": socket.gethostname(), "pid": os.getpid(),
+                       "token": self._auth_token})
             hello = conn.recv(timeout=10.0)
         except (OSError, PetastormTpuError) as exc:
             # a dispatcher mid-restart can accept then reset inside the
@@ -321,7 +325,8 @@ class ServiceWorker:
 def run_worker(address, capacity: int = 2, name: Optional[str] = None,
                shm_size_bytes: int = 0,
                reconnect_attempts: int = 0,
-               reconnect_backoff_s: float = 1.0) -> int:
+               reconnect_backoff_s: float = 1.0,
+               auth_token: Optional[str] = None) -> int:
     """Blocking worker entry (the CLI's ``worker`` subcommand).
 
     ``reconnect_attempts`` > 0 makes the worker survive dispatcher
@@ -331,7 +336,8 @@ def run_worker(address, capacity: int = 2, name: Optional[str] = None,
     attempts_left = reconnect_attempts
     while True:
         worker = ServiceWorker(address, capacity=capacity, name=name,
-                               shm_size_bytes=shm_size_bytes)
+                               shm_size_bytes=shm_size_bytes,
+                               auth_token=auth_token)
         rc = worker.run()
         if attempts_left <= 0:
             return rc

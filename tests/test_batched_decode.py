@@ -395,8 +395,20 @@ def test_decode_split_pixels_match_between_forms(tmp_path):
         expect = _by_label(r)
 
     def read(mode):
+        # ventilation starts inside make_batch_reader, so workers race the
+        # set_decode_split call below: with the default workers_count='auto'
+        # every item of this tiny dataset can decode in the INITIAL (device)
+        # form before the flip lands (and the armed autotune controller
+        # could later move the knob back).  Make the flip deterministic by
+        # throttling: ONE worker with a results bound smaller than one
+        # epoch can decode at most epoch 1 before blocking on the consumer,
+        # and the consumer only starts draining after the flip - so every
+        # epoch-2 item decodes in the requested form, and last-write-wins
+        # below compares exactly those
         out = {}
-        with make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
+        with make_batch_reader(url, shuffle_row_groups=False, num_epochs=2,
+                               workers_count=1, results_queue_size=2,
+                               autotune=False,
                                decode_placement={"image": "auto"}) as r:
             r.set_decode_split(mode)
             with JaxDataLoader(r, batch_size=8) as loader:

@@ -170,6 +170,172 @@ def test_frame_partial_delivery_survives_timeouts():
     fb.close()
 
 
+def test_send_timeout_declares_peer_dead():
+    """A peer that stops draining its buffer must fail the send within the
+    bound (and close the socket - a partial frame cannot be resumed), not
+    block the sending thread forever."""
+    a, b = socket.socketpair()
+    fa = FrameSocket(a, send_timeout_s=0.2)
+    blob = os.urandom(1 << 20)
+    t0 = time.monotonic()
+    with pytest.raises(OSError, match="drain|closed"):
+        for _ in range(256):  # peer never reads: the buffer eventually fills
+            fa.send({"t": "big", "blob": blob})
+    assert time.monotonic() - t0 < 5.0
+    # the timed-out socket is dead for good (stream would be desynced)
+    with pytest.raises(OSError):
+        fa.send({"t": "ping"})
+    # ...and a read loop polling it must see the FrameClosedError it
+    # already handles (reconnect path), not a ValueError from select on
+    # the closed fd (which would crash a service worker's main loop)
+    with pytest.raises(FrameClosedError):
+        fa.recv(timeout=0.1)
+    b.close()
+
+
+def test_send_timeout_rearms_on_progress():
+    """The send timeout bounds a drain STALL: a peer draining slowly but
+    steadily must never be declared dead mid-frame."""
+    a, b = socket.socketpair()
+    fa = FrameSocket(a, send_timeout_s=0.3)
+    stop = threading.Event()
+
+    def slow_drain():
+        while not stop.is_set():
+            time.sleep(0.1)  # stalls shorter than the timeout, repeatedly
+            try:
+                if not b.recv(1 << 16):
+                    return
+            except OSError:
+                return
+
+    t = threading.Thread(target=slow_drain, daemon=True)
+    t.start()
+    try:
+        # several times the socketpair buffer: completes only if progress
+        # re-arms the deadline (total transfer time >> send_timeout_s)
+        fa.send({"t": "big", "blob": os.urandom(1 << 20)})
+    finally:
+        stop.set()
+        fa.close()
+        b.close()
+        t.join(timeout=5.0)
+
+
+def test_recv_timeout_is_total_not_per_fill():
+    """One recv deadline covers header AND body: a frame stuck mid-body
+    must not double the caller's wait."""
+    import pickle
+    import struct
+    a, b = socket.socketpair()
+    fb = FrameSocket(b)
+    raw = pickle.dumps({"t": "x"})
+    a.sendall(struct.pack("!I", len(raw)) + raw[:1])  # header + 1 body byte
+    t0 = time.monotonic()
+    assert fb.recv(timeout=0.3) is None
+    assert time.monotonic() - t0 < 0.55
+    a.close()
+    fb.close()
+
+
+def test_auth_token_gates_every_hello():
+    """A dispatcher with a handshake secret refuses untokened/wrong-token
+    workers, clients, and stats probes - and serves matching ones."""
+    disp = Dispatcher(telemetry=Telemetry(), auth_token="s3cret").start()
+    addr = f"127.0.0.1:{disp.port}"
+    try:
+        # untokened worker: registration refused (exit code 1, no state)
+        assert ServiceWorker(addr, capacity=1, auth_token=None).run() == 1
+        assert disp.stats()["workers"] == {}
+        # wrong-token client: hello refused
+        ex = ServiceExecutor(addr, telemetry=Telemetry(), auth_token="nope")
+        with pytest.raises(OSError, match="refused"):
+            ex.start(EchoFactory())
+        # untokened stats probe: error frame, no snapshot
+        probe = connect_frames(parse_address(addr))
+        probe.send({"t": "stats?"})
+        assert probe.recv(timeout=5.0)["t"] == "error"
+        probe.close()
+        assert disp.stats()["counters"].get(
+            "service.auth_rejected", 0) >= 3
+        # matching tokens: full roundtrip works
+        worker = ServiceWorker(addr, capacity=2, auth_token="s3cret")
+        wt = threading.Thread(target=worker.run, daemon=True)
+        wt.start()
+        _wait_for(lambda: len(disp.stats()["workers"]) == 1)
+        ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4,
+                             auth_token="s3cret")
+        ex.start(EchoFactory())
+        ex.put(VentilatedItem(0, "payload"))
+        assert ex.get(timeout=10.0) == ("echo", "payload", 0)
+        ex.stop()
+        ex.join()
+        worker.stop()
+    finally:
+        disp.stop()
+        disp.join()
+
+
+def test_auth_token_env_var(monkeypatch):
+    """$PETASTORM_TPU_SERVICE_TOKEN is the zero-plumbing path: every party
+    resolves it by default."""
+    from petastorm_tpu.service.protocol import resolve_auth_token
+
+    monkeypatch.delenv("PETASTORM_TPU_SERVICE_TOKEN", raising=False)
+    assert resolve_auth_token(None) is None
+    assert resolve_auth_token("x") == "x"
+    monkeypatch.setenv("PETASTORM_TPU_SERVICE_TOKEN", "tok")
+    assert resolve_auth_token(None) == "tok"
+    assert resolve_auth_token("explicit") == "explicit"
+    disp = Dispatcher(telemetry=Telemetry()).start()
+    addr = f"127.0.0.1:{disp.port}"
+    try:
+        worker = ServiceWorker(addr, capacity=1)  # token from env
+        wt = threading.Thread(target=worker.run, daemon=True)
+        wt.start()
+        _wait_for(lambda: len(disp.stats()["workers"]) == 1)
+        worker.stop()
+        # a party that missed the env var is refused
+        monkeypatch.delenv("PETASTORM_TPU_SERVICE_TOKEN")
+        assert ServiceWorker(addr, capacity=1).run() == 1
+    finally:
+        disp.stop()
+        disp.join()
+
+
+def test_pick_worker_affinity_is_deterministic():
+    """Rowgroup affinity must survive hash randomization and load churn:
+    the same rowgroup maps to the same worker independent of the momentary
+    free list, falling back only when the affine worker is saturated."""
+    import zlib
+
+    import types
+
+    rg = types.SimpleNamespace(path="/data/part-0.parquet", row_group=7)
+    work = types.SimpleNamespace(row_group=rg)
+    disp = Dispatcher(telemetry=Telemetry())  # never started: pure routing
+    a, b = socket.socketpair()
+    conn = FrameSocket(a)
+    from petastorm_tpu.service.dispatcher import _WorkerState
+    workers = {n: _WorkerState(n, conn, 2, "h") for n in ("w1", "w2", "w3")}
+    disp._workers = workers
+    item = VentilatedItem(0, work)
+    key = zlib.crc32(b"/data/part-0.parquet:7")
+    expected = workers[sorted(workers)[key % 3]]
+    free = list(workers.values())
+    for _ in range(5):  # stable across repeated picks and free-list orders
+        assert disp._pick_worker(item, free) is expected
+        free = free[1:] + free[:1]
+    # saturated affine worker -> least-loaded fallback, not a re-route of
+    # the whole mapping
+    others = [w for w in workers.values() if w is not expected]
+    others[0].inflight.add(("c", 1))
+    assert disp._pick_worker(
+        item, others) is others[1]
+    conn.close()
+    b.close()
+
+
 def test_parse_address():
     assert parse_address("host:123") == ("host", 123)
     assert parse_address(("h", 9)) == ("h", 9)
