@@ -1285,6 +1285,55 @@ def bench_service(tmp):
                       " removes most of the tax for co-located workers")
 
 
+# -- config: deterministic delivery -------------------------------------------
+
+def bench_determinism(tmp):
+    """Seed-stable delivery A/B on the imagenet shape (ISSUE 10): the same
+    shuffled multi-worker read with ``deterministic='seed'`` (plan-order
+    reorder stage + stream certificate) vs ``'off'`` (completion order).
+    The ratio prices the reorder-stage tax - mostly head-of-line waiting on
+    the slowest in-flight rowgroup - and is SAME-SESSION anchored
+    (drift-immune).  Interleaved median-of-3 per side; gate: >= 0.85x
+    (tools/bench_compare.py enforces the absolute floor)."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    url = _ensure_imagenet(tmp)
+    n_rows, epochs = 256, 3
+
+    def one(mode):
+        t0 = time.perf_counter()
+        with make_batch_reader(url, reader_pool_type="thread",
+                               workers_count=4, shuffle_row_groups=True,
+                               shuffle_seed=7, deterministic=mode,
+                               num_epochs=epochs) as r:
+            rows = sum(b.num_rows for b in r.iter_batches())
+            digest = r.diagnostics["stream_digest"]["combined"]
+        assert rows == n_rows * epochs, rows
+        return rows / (time.perf_counter() - t0), digest
+
+    one("seed")  # warmup (file cache, thread spinup)
+    pairs = [(one("seed"), one("off")) for _ in range(3)]
+    det = _median([d for (d, _), _ in pairs])
+    off = _median([o for _, (o, _) in pairs])
+    digests = {d for (_, d), _ in pairs}
+    assert len(digests) == 1, f"seed-mode digests diverged: {digests}"
+    _emit("determinism_ingest_samples_per_sec", det, "samples/sec",
+          R2["imagenet_ingest_samples_per_sec"],
+          note="deterministic='seed' (plan-order release + certificate),"
+               " 4 thread workers, shuffled; digest identical across the 3"
+               " rounds")
+    _emit("determinism_off_anchor_samples_per_sec", off, "samples/sec",
+          R2["imagenet_ingest_samples_per_sec"],
+          note="same read, completion-order delivery (the same-session"
+               " anchor the ratio divides by)")
+    return _emit("determinism_vs_off_ratio", det / off, "x", 0.85,
+                 note="reorder-stage tax: head-of-line wait on the slowest"
+                      " in-flight rowgroup (honestly noted - 'off' hands"
+                      " the consumer whatever finished first); gated at"
+                      " an ABSOLUTE >= 0.85x floor by bench_compare, not"
+                      " just baseline drift")
+
+
 # -- config 5: ngram windows --------------------------------------------------
 
 def bench_ngram(tmp):
@@ -1342,7 +1391,7 @@ def main() -> None:
                    bench_cold_floor, bench_mnist, bench_imagenet,
                    bench_imagenet_mixed, bench_converter, bench_ngram,
                    bench_remote_latency, bench_north_star, bench_autotune,
-                   bench_warm_cache, bench_service):
+                   bench_warm_cache, bench_service, bench_determinism):
             try:
                 fn(tmp)
             except Exception:  # noqa: BLE001 - reported, never fatal

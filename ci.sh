@@ -410,6 +410,44 @@ PY
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 200 python "$SVC_SMOKE"
 rm -f "$SVC_SMOKE"
 
+echo "== determinism smoke (seed-stable delivery: identical stream digests across configs) =="
+# two SUBPROCESS runs of petastorm-tpu-diagnose over ONE dataset - different
+# worker counts, the second with a chaos worker kill - must print identical
+# stream_digest lines; a third run with a different seed must differ.  The
+# smoke and operators share one code path: --stream-digest
+# (docs/operations.md "Reproducibility").
+DET_DS="$(mktemp -d /tmp/petastorm_tpu_det_smoke_XXXXXX)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$DET_DS" <<'PY'
+import sys
+import numpy as np
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.schema import Field, Schema
+schema = Schema("DetSmoke", [Field("x", np.int64)])
+write_dataset(sys.argv[1], schema, [{"x": i} for i in range(300)],
+              row_group_size_rows=10)
+PY
+DET_A="$(JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 \
+    python -m petastorm_tpu.tools.diagnose "$DET_DS" --seed 7 \
+    --stream-digest -w 2 --num-epochs 2 | grep '^stream_digest')"
+DET_B="$(JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 \
+    python -m petastorm_tpu.tools.diagnose "$DET_DS" --seed 7 \
+    --stream-digest -w 4 --num-epochs 2 --chaos 'kill_ordinals=3' \
+    | grep '^stream_digest')"
+DET_C="$(JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 \
+    python -m petastorm_tpu.tools.diagnose "$DET_DS" --seed 8 \
+    --stream-digest -w 2 --num-epochs 2 | grep '^stream_digest')"
+rm -rf "$DET_DS"
+echo "  run A (2w):          $DET_A"
+echo "  run B (4w + kill):   $DET_B"
+echo "  run C (other seed):  $DET_C"
+[ -n "$DET_A" ] || { echo "determinism smoke FAILED: no digest line"; exit 1; }
+[ "$DET_A" = "$DET_B" ] || {
+    echo "determinism smoke FAILED: digests differ across configs"; exit 1; }
+[ "$DET_A" != "$DET_C" ] || {
+    echo "determinism smoke FAILED: different seeds produced equal digests"
+    exit 1; }
+echo "determinism smoke OK (2w == 4w+kill, seed 7 != seed 8)"
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

@@ -38,6 +38,9 @@ API = [
                                  "UnionIndexSelector"]),
     ("petastorm_tpu.ngram", ["NGram"]),
     ("petastorm_tpu.weighted_sampling", ["WeightedSamplingReader"]),
+    ("petastorm_tpu.seeding", ["seed_stream", "derive_seed", "StreamDigest",
+                               "reader_buffer_seed",
+                               "resolve_deterministic"]),
     ("petastorm_tpu.shuffle", ["RandomShufflingBuffer", "NoopShufflingBuffer"]),
     ("petastorm_tpu.jax.loader", ["JaxDataLoader", "make_jax_loader"]),
     ("petastorm_tpu.jax.checkpoint", ["make_checkpoint_manager",
@@ -104,9 +107,13 @@ API = [
     ("petastorm_tpu.tools.diagnose", ["run_diagnosis",
                                       "render_autotune_verdict",
                                       "render_liveness_verdict",
+                                      "render_stream_digest",
                                       "render_watch_frame"]),
     ("petastorm_tpu.test_util.chaos", ["ChaosSpec", "ChaosWorker",
                                        "SimulatedWorkerCrash"]),
+    ("petastorm_tpu.test_util.matrix", ["MatrixCell", "CellResult",
+                                        "run_cell", "cell_kwargs",
+                                        "service_fleet"]),
 ]
 
 

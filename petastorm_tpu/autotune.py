@@ -119,6 +119,14 @@ class AutotunePolicy:
     #: that do not show up as queue waits, e.g. GIL contention); reverts
     #: clean up wrong guesses
     explore: bool = True
+    #: knob names the controller must never attach or move ('workers',
+    #: 'results_queue', 'prefetch', 'cache_mem', 'decode_split').  Set by
+    #: make_reader for knobs whose moves would change delivered CONTENT
+    #: rather than just throughput: ``deterministic='seed'`` readers exclude
+    #: 'decode_split' - a mid-epoch host<->device flip changes which wire
+    #: form each rowgroup ships based on WHEN a worker decoded it, which no
+    #: reorder stage can undo (docs/operations.md "Reproducibility")
+    exclude_knobs: frozenset = frozenset()
 
     def __post_init__(self):
         if self.min_workers < 1 or self.max_workers < self.min_workers:
@@ -141,6 +149,9 @@ class AutotunePolicy:
         if not 0.0 < self.revert_threshold < 1.0:
             raise PetastormTpuError(
                 "AutotunePolicy.revert_threshold must be in (0, 1)")
+        if not isinstance(self.exclude_knobs, frozenset):
+            # tolerate lists/sets/tuples from callers
+            self.exclude_knobs = frozenset(self.exclude_knobs)
 
 
 def resolve_autotune(autotune, workers_count,
@@ -226,7 +237,9 @@ class AutotuneController:
 
         p = self.policy
         self._knobs: Dict[str, _Knob] = {}
-        if hasattr(executor, "resize_workers"):
+        if "workers" in p.exclude_knobs:
+            logger.info("autotune: 'workers' knob excluded by policy")
+        elif hasattr(executor, "resize_workers"):
             hi = min(p.max_workers,
                      getattr(executor, "max_resize_workers", p.max_workers))
             cur = int(getattr(executor, "_workers_count", 0))
@@ -252,7 +265,8 @@ class AutotuneController:
                 # first tuning move is replaced instead of silently
                 # shrinking the plane the controller is about to optimize
                 executor.resize_workers(self._knobs["workers"].get())
-        if hasattr(executor, "set_results_bound"):
+        if ("results_queue" not in p.exclude_knobs
+                and hasattr(executor, "set_results_bound")):
             # a bound above the policy ceiling (notably results_queue_size
             # <= 0, implemented as an effectively-unbounded semaphore) must
             # not be tuned: any move would CLAMP it down to max_results_queue,
@@ -295,6 +309,9 @@ class AutotuneController:
         (called by the loader's constructor when it wraps an autotuned
         reader); idempotent per loader, latest loader wins."""
         p = self.policy
+        if "prefetch" in p.exclude_knobs:
+            logger.info("autotune: 'prefetch' knob excluded by policy")
+            return
         if int(loader.prefetch) > p.max_prefetch:
             # same collapse hazard as the workers/results-queue guards: a
             # "grow" from above the ceiling would clamp DOWN to max_prefetch
@@ -329,6 +346,9 @@ class AutotuneController:
         every job on the tier - pin it (docs/operations.md "Warm cache")
         when jobs must not tune each other.
         """
+        if "cache_mem" in self.policy.exclude_knobs:
+            logger.info("autotune: 'cache_mem' knob excluded by policy")
+            return
         if hi_mb < lo_mb or hi_mb < 1:
             return
         self._knobs["cache_mem"] = _Knob(
@@ -349,7 +369,16 @@ class AutotuneController:
         throughput exactly like every other knob; the
         ``autotune.decode_split`` gauge rides the sampled frames, so flight
         records and ``--watch`` carry the split trajectory.
+
+        Never attached under ``deterministic='seed'`` readers (make_reader
+        puts 'decode_split' in ``AutotunePolicy.exclude_knobs``): a live
+        flip changes which wire form each rowgroup ships based on worker
+        timing, breaking the seed-stable stream certificate.
         """
+        if "decode_split" in self.policy.exclude_knobs:
+            logger.info("autotune: 'decode_split' knob excluded by policy"
+                        " (deterministic delivery)")
+            return
         self._knobs["decode_split"] = _Knob(
             "decode_split", get=get, set_=set_, lo=0, hi=1)
         self._stamp_gauges()

@@ -289,6 +289,21 @@ class JaxDataLoader:
         else:
             self._straggler_s = (float(straggler_release_s)
                                  if straggler_release_s else None)
+        if (self._straggler_s is not None
+                and getattr(reader, "deterministic", "off") == "seed"):
+            # seed-stable delivery (docs/operations.md "Reproducibility"):
+            # a straggler release fires on wall-clock timing, so one near an
+            # epoch edge moves rows across a batch boundary between runs -
+            # the exact nondeterminism deterministic='seed' exists to
+            # eliminate.  The reader's reorder stage already prevents the
+            # slow-rowgroup head-of-line blocking the release worked around.
+            logger.warning(
+                "straggler_release_s is a timing-driven floor bypass and is"
+                " disabled under deterministic='seed' delivery (it would"
+                " move rows across batch boundaries between runs); pass"
+                " deterministic='off' to the reader if straggler release"
+                " matters more than bit-identical batches")
+            self._straggler_s = None
         self._m_straggler = self._telemetry.counter(
             "loader.straggler_releases")
         #: transfer-commit policy (see _commit): 'auto' probes the runtime's
@@ -395,6 +410,18 @@ class JaxDataLoader:
                     " single batches. Use the host shuffling buffer"
                     " (shuffling_queue_capacity) instead.")
 
+        # under deterministic='seed' delivery, unseeded buffer RNGs derive
+        # from the reader's seed root (explicit seeds win): with
+        # in-plan-order arrival from the reorder stage, every
+        # shuffle-buffer draw is then a pure function of (seed, retrieval
+        # position) and batch composition is bit-identical across runs
+        from petastorm_tpu.seeding import reader_buffer_seed
+
+        buffer_seed = reader_buffer_seed(reader, "loader.shuffle_buffer",
+                                         buffer_seed)
+        if device_shuffle_capacity:
+            device_shuffle_seed = reader_buffer_seed(
+                reader, "loader.device_shuffle", device_shuffle_seed)
         self._device_buffer = None
         if device_shuffle_capacity:
             if self._host_fields:

@@ -32,6 +32,7 @@ import numpy as np
 
 from petastorm_tpu.errors import NoDataAvailableError, PetastormTpuError
 from petastorm_tpu.etl.metadata import RowGroupRef
+from petastorm_tpu.seeding import seed_stream
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,7 +118,12 @@ class ReadPlan:
         if n == 0:
             return []
         if self._shuffle:
-            order = np.random.default_rng((self._seed, epoch)).permutation(n)
+            # the centralized derivation (petastorm_tpu.seeding): the epoch
+            # permutation is a pure function of (seed, epoch) that is stable
+            # across interpreters, hosts and PYTHONHASHSEED - the root of the
+            # seed-stable delivery invariant (docs/operations.md
+            # "Reproducibility")
+            order = seed_stream(self._seed, epoch, "plan.permutation").permutation(n)
         else:
             order = np.arange(n)
 
@@ -140,7 +146,8 @@ class ReadPlan:
                              for k in range(self._drop_partitions))
         if self._shuffle and self._drop_partitions > 1:
             # re-shuffle so partitions of one rowgroup don't stay adjacent
-            sub = np.random.default_rng((self._seed, epoch, 1)).permutation(len(items))
+            sub = seed_stream(self._seed, epoch,
+                              "plan.drop-shuffle").permutation(len(items))
             items = [items[int(i)] for i in sub]
         return items
 

@@ -513,6 +513,21 @@ class ExecutorBase(ABC):
         raise NotImplementedError(
             f"{type(self).__name__} does not support requeueing")
 
+    def inflight_capacity(self) -> Optional[int]:
+        """Upper bound on distinct work items simultaneously in flight
+        through this executor, or None when unknown.  The reader's
+        deterministic reorder stage uses it as a cheap gate before the
+        exact :meth:`is_inflight` ledger check that tells a straggling
+        ordinal (normal - keep draining) from one in nobody's ledger (a
+        transport bug worth a loud warning)."""
+        return None
+
+    def is_inflight(self, ordinal) -> bool:
+        """True while ``ordinal`` is tracked in the in-flight ledger (a
+        result or attributed failure will still arrive for it)."""
+        with self._inflight_lock:
+            return ordinal in self._inflight
+
     @abstractmethod
     def start(self, worker_factory: WorkerFactory) -> None:
         ...
@@ -592,6 +607,7 @@ class SerialExecutor(ExecutorBase):
                 " thread or process pool for liveness recovery")
         self._items: "queue.Queue[Any]" = queue.Queue(maxsize=in_queue_size)
         self._fn: Optional[Callable] = None
+        self._in_queue_size = in_queue_size
         # per-item watchdog threshold: explicit kwarg (the reader's
         # stall_warn_s - the serial pool is the one flavor whose mid-item
         # stalls the reader-side loop cannot observe) wins over the env var
@@ -607,6 +623,11 @@ class SerialExecutor(ExecutorBase):
 
     def start(self, worker_factory: WorkerFactory) -> None:
         self._fn = worker_factory()
+
+    def inflight_capacity(self) -> int:
+        """Serial work completes in ventilation order already; the reorder
+        stage never holds more than the inline-retry window."""
+        return int(self._in_queue_size) + 8
 
     def put(self, item: Any, cancel_event=None) -> None:
         t0 = time.perf_counter() if self._telemetry.enabled else None
@@ -932,6 +953,16 @@ class ThreadedExecutor(ExecutorBase):
         n = max(1, int(n))
         self._out_slots.set_bound(n)
         return n
+
+    def inflight_capacity(self) -> int:
+        """Upper bound on distinct work items simultaneously in flight
+        across the input queue, worker slots and results plane (plus slack
+        for requeues racing fresh ventilation).  The deterministic reorder
+        stage (Reader) uses this to tell "waiting on a straggler" apart
+        from "the expected ordinal is in nobody's ledger"."""
+        workers = max(len(self._threads), int(self._workers_count))
+        return (int(self._in_slots.bound) + workers
+                + int(self._out_slots.bound) + workers + 8)
 
     def _worker_loop(self, fn: Callable, index: int = 0,
                      profile_this_worker: bool = False) -> None:
@@ -1537,9 +1568,11 @@ class _ProcessExecutor(ExecutorBase):
         self._slot_capacity = max(workers_count,
                                   max_workers if max_workers
                                   else min(4 * workers_count, 32))
-        self._in_queue = self._ctx.Queue(in_queue_size or workers_count + 2)
+        self._in_queue_size = in_queue_size or workers_count + 2
+        self._in_queue = self._ctx.Queue(self._in_queue_size)
         # NOT an mp.Queue: its async feeder thread can wedge every surviving
         # writer when a worker dies abruptly (see _CrashSafeResultsChannel)
+        self._results_queue_size = results_queue_size
         self._out_queue = _CrashSafeResultsChannel(self._ctx,
                                                    results_queue_size)
         self._stop_event = self._ctx.Event()
@@ -1635,6 +1668,15 @@ class _ProcessExecutor(ExecutorBase):
                     self._heartbeats[3 * i + 1] = time.time()
                     self._heartbeats[3 * i] = -1.0
                     self._heartbeats[3 * i + 2] = -1.0
+
+    def inflight_capacity(self) -> int:
+        """Upper bound on distinct work items simultaneously in flight (see
+        ThreadedExecutor.inflight_capacity; same contract for the process
+        plane: input queue + worker slots + results channel + slack)."""
+        workers = max(len(self._procs), int(self._workers_count))
+        results = (self._results_queue_size if self._results_queue_size > 0
+                   else 2 ** 30)
+        return int(self._in_queue_size) + workers + int(results) + workers + 8
 
     def resize_workers(self, n: int) -> int:
         """Grow or shrink the worker-process plane to ``n`` in place
@@ -2108,7 +2150,9 @@ class Ventilator:
     """
 
     def __init__(self, executor: ExecutorBase, plan, num_epochs: Optional[int] = 1,
-                 start_item: int = 0, telemetry=None):
+                 start_item: int = 0, telemetry=None,
+                 release_window: Optional[int] = None,
+                 release_progress=None):
         if num_epochs is not None and num_epochs < 1:
             raise PetastormTpuError("num_epochs must be >= 1 or None (infinite)")
         if start_item < 0:
@@ -2117,6 +2161,18 @@ class Ventilator:
         self._plan = plan
         self._num_epochs = num_epochs
         self._start_item = start_item
+        # deterministic-delivery backpressure (docs/operations.md
+        # "Reproducibility"): with a release window, ordinal v is not handed
+        # to the executor until v < release_progress() + release_window.
+        # The executor's queue bounds alone do NOT bound the reader's
+        # reorder stage - a single straggling rowgroup frees its queue
+        # slots to later items one by one while the reorder stage holds
+        # every completed batch past it, so without this window the held
+        # set could grow toward a whole epoch of decoded batches.  The
+        # window must be at least the executor's in-flight capacity or it
+        # would deadlock the very items the release is waiting on.
+        self._release_window = release_window
+        self._release_progress = release_progress
         self._telemetry = _resolve_telemetry(telemetry)
         if self._telemetry.enabled:
             # visible (as "no samples yet") in reports and --watch frames
@@ -2165,6 +2221,14 @@ class Ventilator:
             for item in self._plan.epoch_items(epoch)[offset:]:
                 if self._stop_event.is_set():
                     return
+                if self._release_window is not None:
+                    # deterministic-delivery window: never run more than one
+                    # window ahead of the reader's release point (bounds the
+                    # reorder stage's memory; see __init__)
+                    while (ordinal >= self._release_progress()
+                           + self._release_window):
+                        if self._stop_event.wait(0.01):
+                            return
                 try:
                     if tele.enabled:
                         # ventilate busy time must EXCLUDE time blocked on a

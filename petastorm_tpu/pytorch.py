@@ -161,9 +161,18 @@ class DataLoader(LoaderBase):
     def _make_buffer(self):
         if self.shuffling_queue_capacity > 0:
             capacity = max(self.shuffling_queue_capacity, self.batch_size)
+            # seed-stable delivery (docs/operations.md "Reproducibility"):
+            # under deterministic='seed' an unseeded buffer derives its RNG
+            # from the reader's seed root, exactly like the jax loader; an
+            # explicit seed wins
+            from petastorm_tpu.seeding import reader_buffer_seed
+
             return RandomShufflingBuffer(
                 capacity=capacity + self.batch_size,
-                min_after_retrieve=capacity // 2, seed=self._seed)
+                min_after_retrieve=capacity // 2,
+                seed=reader_buffer_seed(self.reader,
+                                        "pytorch.shuffle_buffer",
+                                        self._seed))
         return NoopShufflingBuffer()
 
     def _transform_batch(self, batch: Dict):

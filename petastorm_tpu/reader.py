@@ -78,6 +78,7 @@ def make_reader(dataset_url: str,
                 shuffle_row_groups: bool = True,
                 shuffle_row_drop_partitions: int = 1,
                 shuffle_seed: Optional[int] = None,
+                deterministic: Optional[str] = "auto",
                 predicate=None,
                 rowgroup_selector=None,
                 num_epochs: Optional[int] = 1,
@@ -148,6 +149,27 @@ def make_reader(dataset_url: str,
     entropy-decoded; the delivered column (and the reader's output schema)
     has shape ``(h, w[, C])``.  Output is byte-identical to slicing a full
     decode.
+
+    ``deterministic``: seed-stable delivery (docs/operations.md
+    "Reproducibility").  ``'seed'`` inserts a bounded reorder stage between
+    the executor and the consumer that releases batches in PLAN-ordinal
+    order, so a (``shuffle_seed``, epoch) pair yields a bit-identical
+    delivered stream - same visitation order, same batch boundaries -
+    regardless of worker count, executor flavor (thread/process/serial),
+    autotune resizes, chaos kills/requeues, hedge wins, and the
+    ``service_address`` hop.  Every stochastic stage (plan permutation,
+    shuffle buffers, weighted mixing, random decode crops) derives its RNG
+    from one ``seeding.seed_stream`` root, and the reader maintains a
+    running stream certificate - ``Reader.diagnostics['stream_digest']``,
+    the ``stream.digest`` telemetry gauge, and ``state_dict()`` (a
+    quiesce/resume split chains into the same combined digest as an
+    uninterrupted run) - so two runs are diffed in O(1).  ``'off'`` delivers
+    in completion order (faster first-batch latency; digests then certify
+    only what THIS run delivered).  ``'auto'`` (default) = ``'seed'`` when a
+    ``shuffle_seed`` is set, else ``'off'``.  In ``'seed'`` mode the
+    autotune ``decode_split`` knob is excluded (a live host<->device flip
+    depends on worker timing) and ``JaxDataLoader.straggler_release_s``
+    no-ops (a release moves rows across batch boundaries between runs).
 
     ``cache_type``: decoded-rowgroup cache (docs/operations.md "Warm
     cache").  ``'null'`` (default) decodes every read; ``'memory'`` /
@@ -269,6 +291,7 @@ def make_reader(dataset_url: str,
                              shard_mode, cache_type, cache_location, cache_size_limit,
                              transform_spec, storage_options, filesystem,
                              batched_output=False, require_stored_schema=True,
+                             deterministic=deterministic,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
@@ -320,6 +343,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       shuffle_row_groups: bool = True,
                       shuffle_row_drop_partitions: int = 1,
                       shuffle_seed: Optional[int] = None,
+                      deterministic: Optional[str] = "auto",
                       predicate=None,
                       rowgroup_selector=None,
                       num_epochs: Optional[int] = 1,
@@ -355,7 +379,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
     petastorm_tpu metadata exists).
 
     Reference: ``make_batch_reader`` (reader.py:179-290).  Yields one namedtuple of
-    column arrays per decoded rowgroup.  ``io_retries``/``telemetry``/
+    column arrays per decoded rowgroup.  ``deterministic``/``io_retries``/``telemetry``/
     ``on_error``/``item_deadline_s``/``hedge_after_s``/``stall_warn_s``/
     ``stall_abort_s``/``metrics_port``/``flight_record_path``/
     ``sample_interval_s``/``autotune``/``service_address``/``chaos``: see
@@ -368,6 +392,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              shard_mode, cache_type, cache_location, cache_size_limit,
                              transform_spec, storage_options, filesystem,
                              batched_output=True, require_stored_schema=False,
+                             deterministic=deterministic,
                              resume_from=resume_from, ngram=ngram,
                              verify_checksums=verify_checksums,
                              decode_placement=decode_placement,
@@ -393,6 +418,7 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       shard_mode, cache_type, cache_location, cache_size_limit,
                       transform_spec, storage_options, filesystem,
                       batched_output, require_stored_schema,
+                      deterministic: Optional[str] = "auto",
                       resume_from: Optional[dict] = None, ngram=None,
                       verify_checksums: bool = False,
                       decode_placement: Optional[Dict[str, str]] = None,
@@ -410,10 +436,24 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       autotune=None,
                       service_address=None) -> "Reader":
     from petastorm_tpu.autotune import resolve_autotune
+    from petastorm_tpu.seeding import resolve_deterministic
 
     telemetry = _resolve_telemetry(telemetry)
+    deterministic = resolve_deterministic(deterministic, shuffle_seed)
     autotune_policy = resolve_autotune(autotune, workers_count,
                                        reader_pool_type)
+    if deterministic == "seed" and autotune_policy is not None \
+            and "decode_split" not in autotune_policy.exclude_knobs:
+        # resizes/queue-bound/prefetch moves only change TIMING (the reorder
+        # stage absorbs those), but a live host<->device decode-split flip
+        # changes which wire form each rowgroup ships based on when a worker
+        # decoded it - content no reorder stage can make seed-stable.
+        # Exclude that one knob; everything else keeps tuning.
+        import dataclasses as _dc
+
+        autotune_policy = _dc.replace(
+            autotune_policy,
+            exclude_knobs=autotune_policy.exclude_knobs | {"decode_split"})
     if service_address is not None:
         if autotune_policy is not None:
             # the client has no local worker plane to resize; fleet sizing
@@ -701,7 +741,14 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             max_workers=(autotune_policy.max_workers
                          if autotune_policy is not None else None))
     start_item = 0
+    digest_state = None
     if resume_from is not None and "elastic" not in resume_from:
+        # continue the stream-certificate chain across the split: the
+        # resumed run's combined digest then equals an uninterrupted run's
+        # (elastic resume re-deals several old shards' leftovers - their
+        # per-shard chains cannot merge, so the new reader starts a fresh
+        # chain)
+        digest_state = resume_from.get("stream_digest")
         if "elastic_rebased" in resume_from:
             # cursor from an elastically-resumed reader: translate its rebased
             # coordinates back to this (base) plan's absolute item stream
@@ -724,7 +771,9 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                     stall_abort_s=stall_abort_s, metrics_port=metrics_port,
                     flight_record_path=flight_record_path,
                     sample_interval_s=sample_interval_s,
-                    autotune_policy=autotune_policy)
+                    autotune_policy=autotune_policy,
+                    deterministic=deterministic, shuffle_seed=shuffle_seed,
+                    digest_state=digest_state)
     reader.circuit_breaker = circuit_breaker
     #: fields the jax loader decodes on-chip (raw jpeg bytes in host batches)
     reader.device_decode_fields = device_fields
@@ -930,6 +979,17 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
     return device_fields, frozenset(mixed_fields), frozenset(split_fields)
 
 
+class _SkippedItem:
+    """Reorder-stage marker for a policy-skipped ordinal: accounted and
+    digested when the stage reaches its plan position, so two runs that
+    quarantine the same item produce the same certificate."""
+
+    __slots__ = ()
+
+
+_SKIPPED = _SkippedItem()
+
+
 class Reader:
     """Iterator over decoded data; context manager owning the executor.
 
@@ -946,7 +1006,10 @@ class Reader:
                  metrics_port: Optional[int] = None,
                  flight_record_path: Optional[str] = None,
                  sample_interval_s: Optional[float] = None,
-                 autotune_policy=None):
+                 autotune_policy=None,
+                 deterministic: str = "off",
+                 shuffle_seed: Optional[int] = None,
+                 digest_state: Optional[dict] = None):
         #: petastorm_tpu.telemetry recorder shared by the whole pipeline
         #: (no-op unless enabled); ``reader.telemetry.pipeline_report()``
         #: renders the stage-utilization bottleneck summary
@@ -1027,6 +1090,49 @@ class Reader:
         self._prefix = start_item
         self._consumed_ordinals: set = set()
         self._ordinals_seen = False
+
+        # -- seed-stable delivery (docs/operations.md "Reproducibility") ---
+        from petastorm_tpu.seeding import StreamDigest
+
+        #: 'seed' = the reorder stage below releases batches in PLAN order
+        #: (worker timing, hedge wins, requeues, resizes and the service hop
+        #: all collapse to one stream); 'off' = completion-order delivery
+        self.deterministic = deterministic
+        #: the plan seed, re-exposed so downstream stages (JaxDataLoader's
+        #: shuffle buffers) derive their RNGs from the same root via
+        #: seeding.seed_stream
+        self.shuffle_seed = shuffle_seed
+        # reorder stage state: completed batches (and skip markers) held
+        # until every lower plan ordinal has been released.  The stage keeps
+        # draining the results queue while it waits (the pool never stalls
+        # behind it); its memory is bounded by the VENTILATOR's release
+        # window below - queue bounds alone would let one straggling
+        # rowgroup hand the stage a whole epoch of completed batches
+        self._det_held: dict = {}
+        self._det_next = start_item
+        self._det_warned_unordered = False
+        self._det_release_window = None
+        if deterministic == "seed":
+            capacity = getattr(executor, "inflight_capacity", None)
+            capacity = capacity() if callable(capacity) else None
+            if capacity is not None and capacity < (1 << 20):
+                # 2x the executor's own window: a full extra pipeline of
+                # slack (the pacing never costs throughput) while keeping
+                # held memory bounded; effectively-unbounded results queues
+                # (2**30 bound) keep the old unbounded behavior - the user
+                # asked for it
+                self._det_release_window = max(16, 2 * capacity)
+        #: running stream certificate (petastorm_tpu.seeding.StreamDigest):
+        #: maintained on EVERY reader (cheap crc chain); stable across
+        #: configurations only under deterministic='seed'
+        self._digest = StreamDigest(state=digest_state)
+        self._g_digest = self.telemetry.gauge("stream.digest")
+        self._m_reordered = self.telemetry.counter("reader.reordered_batches")
+        # ordinal -> (epoch, WorkItem) lookup cache: the digest needs each
+        # batch's plan-independent work-item identity; epoch item lists are
+        # recomputed once per epoch (two cached epochs cover out-of-order
+        # deliveries straddling an epoch boundary in 'off' mode)
+        self._epoch_items_cache: dict = {}
         self._current: Optional[ColumnBatch] = None
         self._current_pos = 0
         self._row_buffer: list = []
@@ -1080,9 +1186,11 @@ class Reader:
                 self.metrics_server.start()
 
             self._executor.start(worker)
-            self._ventilator = Ventilator(executor, plan, num_epochs,
-                                          start_item=start_item,
-                                          telemetry=self.telemetry)
+            self._ventilator = Ventilator(
+                executor, plan, num_epochs, start_item=start_item,
+                telemetry=self.telemetry,
+                release_window=self._det_release_window,
+                release_progress=self._det_release_progress)
             self._expected_items = self._ventilator.total_items
             self._ventilator.start()
             if autotune_policy is not None:
@@ -1220,6 +1328,33 @@ class Reader:
             if self._all_items_consumed():
                 self.last_row_consumed = True
                 raise StopIteration
+            if self.deterministic == "seed" and self._det_held:
+                # reorder stage: release the next PLAN ordinal if its result
+                # (or skip marker) already arrived; otherwise keep draining
+                # the executor below - holding completed-out-of-order batches
+                # here (bounded: the Ventilator's release window stops new
+                # work more than one window past the release point) is what
+                # makes worker timing, hedge wins, requeues, resizes and the
+                # service hop all collapse to the same delivered stream.
+                # Once degraded (an ordinal-less batch arrived), drain
+                # whatever is held in plan order regardless of gaps - a
+                # missing ordinal must not wedge batches already decoded.
+                key = None
+                if self._det_next in self._det_held:
+                    key = self._det_next
+                elif self._det_warned_unordered:
+                    key = min(self._det_held)
+                if key is not None:
+                    ready = self._det_held.pop(key)
+                    self._det_next = max(self._det_next, key + 1)
+                    if ready is _SKIPPED:
+                        self._digest_skip(key)
+                        self._account_consumed(key)
+                        continue
+                    released = self._deliver_released(ready)
+                    if released is not None:
+                        return released
+                    continue  # empty batch (predicate filtered everything)
             # time blocked inside executor.get = the consumer starving on an
             # empty results queue (the "worker plane is the bottleneck" signal)
             t0 = time.perf_counter() if tele.enabled else None
@@ -1276,13 +1411,31 @@ class Reader:
             last_progress = time.monotonic()
             if self.warm_cache is not None:
                 self._maybe_publish_cache(last_progress)
-            self._account_consumed(batch.ordinal)
-            if batch.num_rows > 0:
-                if self.batched_output and self._all_items_consumed():
-                    # batch path: flag as the final value is returned; the row
-                    # path flags only after the last row is actually popped
-                    self.last_row_consumed = True
-                return batch
+            if self.deterministic == "seed" and batch.ordinal is not None \
+                    and not self._det_warned_unordered:
+                # stash for in-order release at the loop top; release
+                # happens next iteration (possibly immediately, when this
+                # IS the next expected ordinal).  After a degrade the stash
+                # is bypassed - a missing ordinal would hold these forever
+                if batch.ordinal != self._det_next:
+                    self._m_reordered.add(1)
+                self._det_held[batch.ordinal] = batch
+                self._check_reorder_window()
+                continue
+            if self.deterministic == "seed" and batch.ordinal is None \
+                    and not self._det_warned_unordered:
+                # a transport dropped the ventilation ordinals: in-order
+                # release is impossible, degrade loudly to arrival order
+                # (the loop top flushes anything already held, in plan order)
+                self._det_warned_unordered = True
+                logger.warning(
+                    "deterministic='seed' degraded: a batch arrived without"
+                    " a ventilation ordinal (transport dropped it); stream"
+                    " order now follows completion order and the digest is"
+                    " not comparable across configurations")
+            released = self._deliver_released(batch)
+            if released is not None:
+                return released
             # empty batch (predicate filtered everything): keep pulling
 
     def _account_consumed(self, ordinal) -> None:
@@ -1297,6 +1450,133 @@ class Reader:
             while self._prefix in self._consumed_ordinals:
                 self._consumed_ordinals.discard(self._prefix)
                 self._prefix += 1
+
+    # -- seed-stable delivery (docs/operations.md "Reproducibility") ----------
+
+    def _locate_ordinal(self, ordinal: int):
+        """(epoch, index-within-epoch) of an absolute plan ordinal."""
+        plan = self._plan
+        if isinstance(plan, ElasticResumePlan):
+            leftover = plan.leftover_len
+            if ordinal < leftover:
+                return 0, ordinal
+            ipe = plan.base_items_per_epoch
+            if ipe <= 0:
+                return 0, ordinal
+            return 1 + (ordinal - leftover) // ipe, (ordinal - leftover) % ipe
+        ipe = self._ventilator.items_per_epoch
+        if ipe <= 0:
+            return 0, ordinal
+        return ordinal // ipe, ordinal % ipe
+
+    def _work_item_for(self, ordinal):
+        """(epoch, WorkItem or None) behind a delivered ordinal - the digest
+        needs the item's plan-independent identity (rowgroup global index +
+        slice), which the wire does not carry; the deterministic plan
+        recomputes it.  Two epochs of items stay cached (out-of-order
+        deliveries straddle epoch boundaries in 'off' mode)."""
+        if ordinal is None:
+            return 0, None
+        epoch, idx = self._locate_ordinal(int(ordinal))
+        items = self._epoch_items_cache.get(epoch)
+        if items is None:
+            while len(self._epoch_items_cache) >= 2:
+                self._epoch_items_cache.pop(min(self._epoch_items_cache))
+            items = self._plan.epoch_items(epoch)
+            self._epoch_items_cache[epoch] = items
+        if 0 <= idx < len(items):
+            return epoch, items[idx]
+        return epoch, None
+
+    def _digest_deliver(self, batch: ColumnBatch) -> None:
+        """Fold one released batch into the stream certificate."""
+        epoch, item = self._work_item_for(batch.ordinal)
+        if item is not None:
+            start, stop = item.row_slice()
+            self._digest.record_batch(epoch, batch.ordinal,
+                                      item.row_group.global_index,
+                                      item.row_group.row_group,
+                                      start, stop, batch.num_rows)
+        else:
+            self._digest.record_batch(epoch, batch.ordinal, -1, -1, 0, 0,
+                                      batch.num_rows)
+        if self.telemetry.enabled:
+            self._g_digest.set(self._digest.combined)
+
+    def _digest_skip(self, ordinal) -> None:
+        """Fold one policy-skipped work item into the stream certificate."""
+        epoch, item = self._work_item_for(ordinal)
+        self._digest.record_skip(
+            epoch, ordinal,
+            item.row_group.global_index if item is not None else -1,
+            item.row_group.row_group if item is not None else -1)
+        if self.telemetry.enabled:
+            self._g_digest.set(self._digest.combined)
+
+    def _deliver_released(self, batch: ColumnBatch):
+        """Delivery bookkeeping shared by BOTH release paths (the reorder
+        stage's in-plan-order release and direct completion-order delivery):
+        digest fold, epoch accounting, end-of-stream flagging.  Returns the
+        batch when it carries rows, None for an empty one (predicate
+        filtered everything - the caller keeps pulling)."""
+        self._digest_deliver(batch)
+        self._account_consumed(batch.ordinal)
+        if batch.num_rows > 0:
+            if self.batched_output and self._all_items_consumed():
+                # batch path: flag as the final value is returned; the row
+                # path flags only after the last row is actually popped
+                self.last_row_consumed = True
+            return batch
+        return None
+
+    def _det_release_progress(self) -> int:
+        """The reorder stage's release point, read by the Ventilator's
+        release window (consumer-thread writes, ventilator-thread reads: a
+        plain int under the GIL).  In-order release makes the contiguous
+        consumed prefix exactly the released count; after a degrade the
+        window must not gate ventilation on a prefix that ordinal-less
+        batches can no longer advance."""
+        if self._det_warned_unordered:
+            return 1 << 62
+        return self._prefix
+
+    def _check_reorder_window(self) -> None:
+        """One-time warning when the reorder stage holds more batches than
+        the executor can have in flight AND the expected ordinal is in
+        nobody's ledger (a lost-ordinal transport bug - no result will ever
+        release the stream); the stall watchdog, not silent unbounded
+        buffering, is what ends the wait.  A requeued straggler legitimately
+        falls far behind fresh ventilation, so window overflow alone is not
+        the signal - the ledger check is."""
+        if self._det_warned_unordered:
+            return
+        capacity = getattr(self._executor, "inflight_capacity", None)
+        capacity = capacity() if callable(capacity) else None
+        if capacity is None or len(self._det_held) <= capacity:
+            return
+        if self._det_next in self._det_held:
+            return  # just arrived (settled + stashed); releases next loop
+        is_inflight = getattr(self._executor, "is_inflight", None)
+        if callable(is_inflight) and is_inflight(self._det_next):
+            return  # straggling/requeued, not lost: its result will come
+        self._det_warned_unordered = True
+        logger.warning(
+            "deterministic reorder stage holds %d completed batches (past"
+            " the executor's in-flight window of %d) while plan ordinal %d"
+            " is in nobody's ledger - the expected item looks lost; the"
+            " stall watchdog will abort if it never arrives. Pipeline"
+            " state: %s", len(self._det_held), capacity, self._det_next,
+            self.diagnostics)
+
+    @property
+    def stream_digest(self) -> dict:
+        """The stream certificate so far (petastorm_tpu.seeding.StreamDigest
+        summary): per-epoch and combined crc chains over released work items
+        + batch boundaries.  Under ``deterministic='seed'`` two runs with
+        the same (seed, epochs) match bit-for-bit regardless of worker
+        count, executor flavor, chaos or transport; diff it in O(1) instead
+        of diffing delivered tensors."""
+        return self._digest.summary()
 
     def _maybe_publish_cache(self, now: float) -> None:
         """Fold the shared warm tier's cross-process counters into this
@@ -1325,6 +1605,9 @@ class Reader:
                                                          flight_record)
 
             self._flight_record = flight_record(self.sampler, reason=reason)
+            # the certificate up to the failure: two runs' incident records
+            # can be diffed for where their streams diverged
+            self._flight_record["stream_digest"] = self._digest.summary()
             if self._flight_record_path:
                 dump_flight_record(self._flight_record,
                                    self._flight_record_path)
@@ -1379,7 +1662,16 @@ class Reader:
             "Skipping work item %s (rowgroup %s#%s) after %s error: %s",
             exc.ordinal, entry["path"], entry["row_group"], exc.kind,
             entry["error"])
-        self._account_consumed(exc.ordinal)
+        if self.deterministic == "seed" and exc.ordinal is not None:
+            # account + digest when the reorder stage reaches the skip's
+            # plan position (keeps the certificate order-exact); the budget
+            # bookkeeping below stays immediate either way.  (After a
+            # degrade the loop top drains held entries in plan order, so
+            # stashing stays safe there too.)
+            self._det_held[exc.ordinal] = _SKIPPED
+        else:
+            self._digest_skip(exc.ordinal)
+            self._account_consumed(exc.ordinal)
         skipped = len(self._quarantine)
         over = None
         if (policy.max_skipped_rowgroups is not None
@@ -1427,14 +1719,26 @@ class Reader:
         self._consumed_items = 0
         self._prefix = 0
         self._consumed_ordinals.clear()
+        # a reset run is a fresh stream: the reorder stage restarts at
+        # ordinal 0 and the certificate chain starts over (comparing a reset
+        # run to a fresh reader must compare equal)
+        from petastorm_tpu.seeding import StreamDigest
+
+        self._det_held.clear()
+        self._det_next = 0
+        self._det_warned_unordered = False
+        self._epoch_items_cache.clear()
+        self._digest = StreamDigest()
         self._row_buffer = []
         self._row_pos = 0
         self._current = None
         self._current_pos = 0
         self.last_row_consumed = False
-        self._ventilator = Ventilator(self._executor, self._plan,
-                                      self._num_epochs,
-                                      telemetry=self.telemetry)
+        self._ventilator = Ventilator(
+            self._executor, self._plan, self._num_epochs,
+            telemetry=self.telemetry,
+            release_window=self._det_release_window,
+            release_progress=self._det_release_progress)
         self._expected_items = self._ventilator.total_items
         self._ventilator.start()
 
@@ -1470,7 +1774,12 @@ class Reader:
                  # False means batches arrived without ventilation ordinals
                  # (a transport dropped them) and the cursor degraded to the
                  # count-based position - exact only under in-order pools
-                 "ordinal_exact": self._ordinals_seen or self._consumed_items == 0}
+                 "ordinal_exact": self._ordinals_seen or self._consumed_items == 0,
+                 # stream-certificate chain state: resume_from continues the
+                 # chain, so (run A up to quiesce) + (resumed run B) produce
+                 # the same combined digest as one uninterrupted run
+                 # (docs/operations.md "Reproducibility")
+                 "stream_digest": self._digest.state()}
         if isinstance(self._plan, ElasticResumePlan):
             # rebased coordinates: record the translation so this cursor can
             # itself be resumed (plainly or elastically) once past the
@@ -1619,6 +1928,12 @@ class Reader:
                 "items_per_epoch": self._ventilator.items_per_epoch,
                 "consumed_items": self._consumed_items,
                 "expected_items": self._expected_items,
+                # the stream certificate (seed-stable under
+                # deterministic='seed'; see docs/operations.md
+                # "Reproducibility" for capturing and diffing it)
+                "deterministic": self.deterministic,
+                "stream_digest": self._digest.summary(),
+                "reorder_held": len(self._det_held),
                 "skipped_rowgroups": len(self._quarantine),
                 # bounded tail: diagnostics is interpolated into stall
                 # WARNINGs, and a long degraded run must not turn every log

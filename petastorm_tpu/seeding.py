@@ -1,0 +1,232 @@
+"""Centralized seed derivation and stream certificates for reproducible
+delivery.
+
+The reproducibility invariant (ROADMAP item 3, per *Optimizing
+High-Throughput Distributed Data Pipelines for Reproducible Deep Learning at
+Scale*, PAPERS.md): a ``(seed, epoch)`` pair must yield a bit-identical
+visitation order and batch composition regardless of worker count, executor
+flavor, autotune resizes, chaos kills, hedge wins, and the service hop.  Two
+primitives make that checkable instead of aspirational:
+
+* :func:`seed_stream` / :func:`derive_seed` - ONE derivation for every
+  stochastic choice in the pipeline (plan epoch permutation, shuffle-buffer
+  sampling, weighted mixing, random decode crops).  Each call site names a
+  ``domain`` string, so streams never collide and every draw is a pure
+  function of ``(seed, epoch, domain, position)`` - never of arrival order,
+  worker identity, interpreter hash randomization (``PYTHONHASHSEED``), or
+  object addresses.  Ad-hoc per-module seeding (tuple-seeded
+  ``default_rng``, ``hash()``-derived seeds) is what this replaces.
+* :class:`StreamDigest` - a cheap running crc32 chain over the delivered
+  work-item stream (item identity + batch boundaries, per epoch and
+  combined), the O(1)-diffable *certificate* that two runs delivered the
+  same stream.  The reader maintains one always (``deterministic='seed'``
+  makes it stable across configurations); it rides
+  ``Reader.diagnostics['stream_digest']``, the ``stream.digest`` telemetry
+  gauge, flight records, and ``Reader.state_dict()`` (so a quiesce/resume
+  split chains into the same combined digest as an uninterrupted run).
+
+docs/operations.md "Reproducibility" is the operator-facing runbook.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+
+#: domain-separation prefix; bump only with a conscious "all derived streams
+#: change" decision (it invalidates nothing on disk - streams are per-run)
+_DERIVE_VERSION = b"petastorm-tpu-seed-stream-v1"
+
+
+def _mix_part(h, part) -> None:
+    """Fold one extra key part into the hash with a type tag (so ``1`` and
+    ``'1'`` derive different streams) and an unambiguous encoding."""
+    if isinstance(part, (bool, int, np.integer)):
+        h.update(b"i")
+        h.update(struct.pack("<q", int(part)))
+    elif isinstance(part, str):
+        raw = part.encode("utf-8")
+        h.update(b"s")
+        h.update(struct.pack("<q", len(raw)))
+        h.update(raw)
+    elif isinstance(part, bytes):
+        h.update(b"b")
+        h.update(struct.pack("<q", len(part)))
+        h.update(part)
+    else:
+        raise PetastormTpuError(
+            f"seed_stream key parts must be int, str or bytes; got"
+            f" {type(part).__name__} ({part!r})")
+
+
+def derive_seed(seed: Optional[int], epoch: int, domain: str, *extra) -> int:
+    """Derive a 64-bit child seed as a pure function of
+    ``(seed, epoch, domain, *extra)``.
+
+    Stable across interpreters, processes, hosts and ``PYTHONHASHSEED``
+    values (blake2b, never ``hash()``).  ``seed=None`` maps to 0 - the
+    unseeded default stays deterministic so ``deterministic='seed'`` works
+    without requiring an explicit ``shuffle_seed``.  ``domain`` names the
+    consuming stream (e.g. ``'plan.permutation'``, ``'loader.shuffle'``):
+    distinct domains yield independent streams from one user seed.
+    ``extra`` parts (ints / strings / bytes) key per-item streams, e.g. a
+    rowgroup path + slice for per-rowgroup crop offsets.
+    """
+    h = hashlib.blake2b(_DERIVE_VERSION, digest_size=8)
+    _mix_part(h, int(seed) if seed is not None else 0)
+    _mix_part(h, int(epoch))
+    _mix_part(h, str(domain))
+    for part in extra:
+        _mix_part(h, part)
+    # 63-bit so every consumer (numpy SeedSequence, jax PRNGKey, struct
+    # packing) accepts the value as a non-negative int64
+    return int.from_bytes(h.digest(), "little") & (2 ** 63 - 1)
+
+
+def seed_stream(seed: Optional[int], epoch: int, domain: str,
+                *extra) -> np.random.Generator:
+    """A numpy Generator whose draws are a pure function of
+    ``(seed, epoch, domain, *extra)`` - see :func:`derive_seed`.
+
+    The single constructor every stochastic pipeline stage derives its RNG
+    from; a new call site picks a fresh ``domain`` string and never seeds
+    ad hoc.
+    """
+    return np.random.default_rng(derive_seed(seed, epoch, domain, *extra))
+
+
+def reader_buffer_seed(reader, domain: str,
+                       explicit_seed: Optional[int] = None) -> Optional[int]:
+    """The buffer-seed fallback every delivery adapter shares (jax loader,
+    torch DataLoader, future adapters): an ``explicit_seed`` always wins;
+    otherwise, when ``reader`` runs ``deterministic='seed'`` delivery, a
+    seed is derived from the reader's seed root for this adapter's
+    ``domain`` - batch composition is then a pure function of the root
+    seed; otherwise ``None`` (unseeded, each run mixes differently).
+    One helper so the explicit-seed-wins rule cannot drift per adapter.
+    """
+    if explicit_seed is not None:
+        return explicit_seed
+    if getattr(reader, "deterministic", "off") != "seed":
+        return None
+    return derive_seed(getattr(reader, "shuffle_seed", None), 0, domain)
+
+
+#: StreamDigest record kinds (first field of every packed payload)
+_REC_BATCH = 1
+_REC_SKIP = 2
+
+
+class StreamDigest:
+    """Running crc32 chain over a delivered work-item stream - the stream
+    certificate two runs diff in O(1).
+
+    Each delivered batch folds its work-item identity (plan-independent
+    rowgroup ``global_index`` + rowgroup index + row slice - NOT the ordinal
+    alone, which would collapse different-seed plans to equal digests; and
+    NOT the filesystem path, so digests compare across hosts/mounts) and its
+    delivered row count into a per-epoch chain and a combined chain.
+    Policy-skipped items fold a skip marker, so two runs quarantining the
+    same poisoned rowgroup still agree.  The chain is order-sensitive by
+    construction: under ``deterministic='seed'`` delivery the value is a
+    pure function of (seed, epoch); under ``'off'`` it certifies what THIS
+    run actually delivered.
+
+    ``state()`` round-trips through ``Reader.state_dict()`` so a
+    quiesce/resume split continues the chain - the resumed run's combined
+    digest equals an uninterrupted run's.
+    """
+
+    def __init__(self, state: Optional[dict] = None):
+        if state:
+            self._combined = int(state.get("combined", 0))
+            self._epochs: Dict[int, int] = {
+                int(e): int(v) for e, v in state.get("epochs", {}).items()}
+            self._batches = int(state.get("batches", 0))
+            self._rows = int(state.get("rows", 0))
+        else:
+            self._combined = 0
+            self._epochs = {}
+            self._batches = 0
+            self._rows = 0
+
+    def _mix(self, epoch: int, payload: bytes) -> None:
+        self._combined = zlib.crc32(payload, self._combined)
+        self._epochs[epoch] = zlib.crc32(payload, self._epochs.get(epoch, 0))
+
+    def record_batch(self, epoch: int, ordinal: Optional[int],
+                     global_index: int, row_group: int,
+                     start: int, stop: int, num_rows: int) -> None:
+        """Fold one delivered batch: the work item it decodes
+        (``global_index``/``row_group``/row slice) and the delivered row
+        count (a batch boundary marker - row counts AND where batches break
+        are both certified)."""
+        self._mix(int(epoch), struct.pack(
+            "<7q", _REC_BATCH, -1 if ordinal is None else int(ordinal),
+            int(global_index), int(row_group), int(start), int(stop),
+            int(num_rows)))
+        self._batches += 1
+        self._rows += int(num_rows)
+
+    def record_skip(self, epoch: int, ordinal: Optional[int],
+                    global_index: int = -1, row_group: int = -1) -> None:
+        """Fold one policy-skipped work item (``on_error`` quarantine): runs
+        that skip the same item at the same stream position stay equal."""
+        self._mix(int(epoch), struct.pack(
+            "<4q", _REC_SKIP, -1 if ordinal is None else int(ordinal),
+            int(global_index), int(row_group)))
+        self._batches += 1
+
+    @property
+    def combined(self) -> int:
+        """The combined chain value (crc32 int; 0 = nothing recorded)."""
+        return self._combined
+
+    @property
+    def batches(self) -> int:
+        """Stream records folded so far (delivered batches + skips)."""
+        return self._batches
+
+    def summary(self) -> dict:
+        """Human/diagnostics form: hex chain values per epoch + combined,
+        plus record and row totals."""
+        return {"combined": f"{self._combined:08x}",
+                "epochs": {e: f"{v:08x}"
+                           for e, v in sorted(self._epochs.items())},
+                "batches": self._batches,
+                "rows": self._rows}
+
+    def state(self) -> dict:
+        """JSON-serializable chain state for ``Reader.state_dict()``; pass
+        back through ``StreamDigest(state=...)`` to continue the chain
+        across a quiesce/resume split."""
+        return {"combined": self._combined,
+                "epochs": {str(e): v for e, v in self._epochs.items()},
+                "batches": self._batches,
+                "rows": self._rows}
+
+
+def resolve_deterministic(deterministic,
+                          shuffle_seed: Optional[int]) -> str:
+    """Normalize ``make_reader(deterministic=)`` to ``'seed'`` or ``'off'``.
+
+    ``'auto'`` (the default) arms seed-stable delivery exactly when the
+    caller pinned a ``shuffle_seed`` - asking for a reproducible shuffle is
+    asking for a reproducible stream; an unseeded reader keeps the faster
+    completion-order delivery.  ``'seed'`` forces the reorder stage on
+    (``shuffle_seed=None`` then behaves as seed 0); ``'off'`` forces
+    completion-order delivery.
+    """
+    if deterministic in (None, "auto"):
+        return "seed" if shuffle_seed is not None else "off"
+    if deterministic in ("seed", "off"):
+        return deterministic
+    raise PetastormTpuError(
+        f"deterministic must be 'seed', 'off' or 'auto'; got"
+        f" {deterministic!r}")

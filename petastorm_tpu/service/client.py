@@ -90,6 +90,14 @@ class ServiceExecutor(ExecutorBase):
     Liveness note: ``item_deadline_s`` / ``hedge_after_s`` are dispatcher /
     worker-side concerns on the service plane and are not accepted here
     (the reader warns and drops them for service-backed readers).
+
+    Determinism note: results arrive in fleet completion order, but every
+    outcome carries its ventilation ordinal (the VentilatedItem objects ARE
+    the wire objects) and survives requeue-on-death and
+    reconnect-with-replay exactly once - so the reader's
+    ``deterministic='seed'`` reorder stage produces the same delivered
+    stream through the service hop as through an in-process pool
+    (docs/operations.md "Reproducibility").
     """
 
     def __init__(self, address, telemetry=None, stop_on_failure: bool = True,
@@ -419,6 +427,12 @@ class ServiceExecutor(ExecutorBase):
             self._send({"t": "client_stats", "starved_s": starved})
 
     # -- consuming ------------------------------------------------------------
+
+    def inflight_capacity(self) -> int:
+        """Upper bound on distinct items outstanding at the dispatcher: the
+        put window, plus replay slack (reconnect redelivery is deduped by
+        the ledger before it would ever widen the reorder stage)."""
+        return self._window + 8
 
     def get(self, timeout: Optional[float] = None) -> Any:
         """Next completed batch (completion order); raises ``queue.Empty``

@@ -261,6 +261,12 @@ class RandomShufflingBuffer(ShufflingBufferBase):
             raise PetastormTpuError("min_after_retrieve cannot exceed capacity")
         self._capacity = capacity
         self._min_after = min_after_retrieve
+        # seed: an int (preferably seeding.derive_seed output - the
+        # centralized derivation every stochastic stage shares) or None
+        # (each run mixes differently).  With a seed and deterministic
+        # delivery, every retrieve is a pure function of (seed, retrieval
+        # position), never of arrival timing.  default_rng also passes a
+        # pre-built Generator through unchanged.
         self._rng = np.random.default_rng(seed)
         self._columns: Optional[Dict[str, np.ndarray]] = None
         self._size = 0

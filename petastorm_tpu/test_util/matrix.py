@@ -1,0 +1,275 @@
+"""Chaos-matrix determinism harness: run one (seed, epoch) read under an
+arbitrary configuration cell and certify the delivered stream.
+
+The reproducibility invariant (ROADMAP item 3, docs/operations.md
+"Reproducibility") is only real if it is *tested across the whole
+configuration space*: ``tests/test_determinism_matrix.py`` runs the same
+(seed, epochs) read across {worker counts} x {executor flavors} x {chaos
+kinds} x {mid-epoch resize} x {in-process, service transport} x
+{uninterrupted, quiesce/resume split} and asserts every cell produces a
+bit-identical stream - via two independent certificates:
+
+* the reader's own :class:`~petastorm_tpu.seeding.StreamDigest` (cheap,
+  metadata-level: work-item identity + batch boundaries), and
+* ``content_crc`` - a crc chain over the delivered column BYTES in
+  delivery order, computed here in the harness.  This is the adversarial
+  check on the reader's certificate: if delivery were reordered in a way
+  the digest failed to capture (or decoded bytes differed), the content
+  chain would diverge even if the digest lied.
+
+Usable from tests and from ad-hoc triage (run two cells by hand, diff the
+dicts).  Keep this module dependency-light: reader + service plane only,
+no jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import signal
+import subprocess
+import sys
+import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+
+#: chaos kinds a cell may name (see cell_kwargs for the exact injections)
+CHAOS_KINDS = ("none", "kill", "hang", "hedge")
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixCell:
+    """One configuration cell of the determinism matrix."""
+
+    workers: int = 2
+    pool: str = "thread"          # thread | process | serial
+    chaos: str = "none"           # none | kill | hang | hedge
+    resize: bool = False          # mid-epoch executor resize (autotune shape)
+    transport: str = "local"      # local | service
+    split: str = "none"           # none | quiesce (mid-epoch quiesce+resume)
+
+    def __post_init__(self):
+        if self.chaos not in CHAOS_KINDS:
+            raise PetastormTpuError(f"unknown chaos kind {self.chaos!r}")
+        if self.transport not in ("local", "service"):
+            raise PetastormTpuError(f"unknown transport {self.transport!r}")
+        if self.split not in ("none", "quiesce"):
+            raise PetastormTpuError(f"unknown split {self.split!r}")
+
+    def label(self) -> str:
+        """Compact cell name for test ids and triage output, e.g.
+        ``'3w-thread-kill-resize'``."""
+        parts = [f"{self.workers}w", self.pool, self.chaos]
+        if self.resize:
+            parts.append("resize")
+        if self.transport != "local":
+            parts.append(self.transport)
+        if self.split != "none":
+            parts.append(self.split)
+        return "-".join(parts)
+
+
+@dataclasses.dataclass
+class CellResult:
+    """What one cell delivered: both certificates + row accounting."""
+
+    digest: dict        # Reader.diagnostics['stream_digest'] summary
+    content_crc: int    # crc chain over delivered column bytes, in order
+    batch_rows: tuple   # per-delivered-batch row counts (batch boundaries)
+    rows: int
+
+
+def _crc_batch(crc: int, columns: dict) -> int:
+    """Fold one delivered batch's column bytes (sorted field order) into a
+    crc chain - the harness-side, content-level certificate."""
+    for name in sorted(columns):
+        col = columns[name]
+        crc = zlib.crc32(name.encode("utf-8"), crc)
+        arr = np.asarray(col)
+        if arr.dtype == object:
+            # object cells (variable shapes / bytes): hash each element's
+            # repr - stable across runs for the bytes/ndarray payloads the
+            # pipeline ships
+            for cell in arr.ravel():
+                if isinstance(cell, np.ndarray):
+                    crc = zlib.crc32(np.ascontiguousarray(cell).tobytes(), crc)
+                elif isinstance(cell, (bytes, bytearray)):
+                    crc = zlib.crc32(bytes(cell), crc)
+                else:
+                    crc = zlib.crc32(repr(cell).encode("utf-8"), crc)
+        else:
+            crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return crc
+
+
+def cell_kwargs(cell: MatrixCell) -> dict:
+    """``make_batch_reader`` kwargs injecting the cell's chaos flavor.
+
+    Chaos choices are content-preserving on purpose: kills/hangs requeue
+    through the attempt budget and hedges dedup, so EVERY cell must deliver
+    the identical stream - that is the invariant under test.  (Data-error
+    quarantine changes delivered content by design; its determinism is
+    tested separately with the same spec on both sides.)
+    """
+    from petastorm_tpu.test_util.chaos import ChaosSpec
+
+    kwargs: dict = {}
+    if cell.chaos == "kill":
+        kwargs["chaos"] = ChaosSpec(kill_ordinals=(2, 7))
+    elif cell.chaos == "hang":
+        # one permanent first-attempt hang; the deadline kills/abandons the
+        # worker and the requeued attempt completes
+        kwargs["chaos"] = ChaosSpec(hang_ordinals=(3,), hang_s=3600.0)
+        kwargs["item_deadline_s"] = 1.0
+    elif cell.chaos == "hedge":
+        kwargs["chaos"] = ChaosSpec(slow_ordinals=(1, 4), slow_s=0.3)
+        kwargs["hedge_after_s"] = 0.05
+    return kwargs
+
+
+def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
+             num_epochs: int = 2,
+             service_address: Optional[str] = None,
+             action_at_batch: int = 5,
+             reader_kwargs: Optional[dict] = None) -> CellResult:
+    """Run one cell's full read and return its certificates.
+
+    ``action_at_batch``: delivered-batch index at which the cell's mid-epoch
+    action fires (resize up for ``resize=True`` cells - resized back down at
+    ``2 * action_at_batch`` - or quiesce for ``split='quiesce'`` cells).
+    ``service_address`` must point at a running dispatcher for
+    ``transport='service'`` cells (see :func:`service_fleet`).
+    """
+    from petastorm_tpu.reader import make_batch_reader
+
+    kwargs = dict(shuffle_row_groups=True, shuffle_seed=seed,
+                  deterministic="seed", num_epochs=num_epochs)
+    kwargs.update(cell_kwargs(cell))
+    if cell.transport == "service":
+        if service_address is None:
+            raise PetastormTpuError(
+                "transport='service' cells need a service_address")
+        kwargs["service_address"] = service_address
+        # liveness knobs are client-side no-ops on the service plane; the
+        # reader drops them with a warning - drop quietly here
+        kwargs.pop("item_deadline_s", None)
+        kwargs.pop("hedge_after_s", None)
+    else:
+        kwargs["reader_pool_type"] = cell.pool
+        kwargs["workers_count"] = cell.workers
+    kwargs.update(reader_kwargs or {})
+
+    crc = 0
+    batch_rows: list = []
+    rows = 0
+    resumed_digest: Optional[dict] = None
+    state: Optional[dict] = None
+
+    with make_batch_reader(dataset_url, **kwargs) as reader:
+        it = reader.iter_batches()
+        delivered = 0
+        quiesced = False
+        for batch in it:
+            crc = _crc_batch(crc, batch.columns)
+            batch_rows.append(batch.num_rows)
+            rows += batch.num_rows
+            delivered += 1
+            if cell.resize and hasattr(reader._executor, "resize_workers"):
+                # the autotune-shaped perturbation: grow mid-epoch, shrink
+                # back later; delivered order must not notice
+                if delivered == action_at_batch:
+                    reader._executor.resize_workers(cell.workers * 2)
+                elif delivered == 2 * action_at_batch:
+                    reader._executor.resize_workers(max(1, cell.workers - 1))
+            if (cell.split == "quiesce" and not quiesced
+                    and delivered == action_at_batch):
+                # stop issuing work; the already-ventilated tail drains
+                # through the loop, then state_dict() is an exact cursor
+                reader.quiesce()
+                quiesced = True
+        if cell.split == "quiesce":
+            state = reader.state_dict()
+        else:
+            resumed_digest = reader.diagnostics["stream_digest"]
+
+    if cell.split == "quiesce":
+        assert state is not None
+        with make_batch_reader(dataset_url, resume_from=state,
+                               **kwargs) as reader:
+            for batch in reader.iter_batches():
+                crc = _crc_batch(crc, batch.columns)
+                batch_rows.append(batch.num_rows)
+                rows += batch.num_rows
+            # the digest chain continued from the checkpointed state: the
+            # resumed reader's combined value IS the whole-stream value
+            resumed_digest = reader.diagnostics["stream_digest"]
+
+    return CellResult(digest=resumed_digest, content_crc=crc,
+                      batch_rows=tuple(batch_rows), rows=rows)
+
+
+# -- in-process / subprocess service fleets -----------------------------------
+
+@contextlib.contextmanager
+def service_fleet(n_workers: int = 2, subprocess_workers: bool = False,
+                  capacity: int = 2):
+    """A dispatcher + worker fleet for ``transport='service'`` cells; yields
+    ``(dispatcher, address, workers)``.
+
+    ``subprocess_workers=True`` runs each worker as a real
+    ``petastorm-tpu-service worker`` subprocess - required for chaos kill
+    cells (the injection ``os._exit``\\ s the worker process) and for
+    SIGKILL-the-worker tests; ``workers`` is then the list of Popen handles.
+    In-process thread workers (the default) are cheaper for no-kill cells.
+    """
+    import threading
+
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    from petastorm_tpu.service.worker import ServiceWorker
+    from petastorm_tpu.telemetry import Telemetry
+
+    disp = Dispatcher(telemetry=Telemetry(), heartbeat_timeout_s=5.0).start()
+    addr = f"127.0.0.1:{disp.port}"
+    workers: list = []
+    threads: list = []
+    try:
+        if subprocess_workers:
+            for i in range(n_workers):
+                workers.append(subprocess.Popen(
+                    [sys.executable, "-m", "petastorm_tpu.service.cli",
+                     "worker", "--address", addr, "--capacity", str(capacity),
+                     "--name", f"mw{i}"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        else:
+            for i in range(n_workers):
+                w = ServiceWorker(addr, capacity=capacity, name=f"mw{i}")
+                workers.append(w)
+                t = threading.Thread(target=w.run, daemon=True)
+                threads.append(t)
+                t.start()
+        deadline = time.monotonic() + 20.0
+        while len(disp.stats()["workers"]) < n_workers:
+            if time.monotonic() >= deadline:
+                raise PetastormTpuError(
+                    f"service fleet: {n_workers} workers did not register")
+            time.sleep(0.05)
+        yield disp, addr, workers
+    finally:
+        for w in workers:
+            if subprocess_workers:
+                with contextlib.suppress(Exception):
+                    if w.poll() is None:
+                        w.send_signal(signal.SIGTERM)
+                        try:
+                            w.wait(timeout=5)
+                        except subprocess.TimeoutExpired:
+                            w.kill()
+                            w.wait(timeout=5)
+            else:
+                w.stop()
+        disp.stop()
+        disp.join()

@@ -139,6 +139,22 @@ def build_parser() -> argparse.ArgumentParser:
                         help="names the cache tier (same location = same"
                              " shared tier host-wide; also the disk"
                              " directory)")
+    parser.add_argument("--seed", type=int, default=None, metavar="N",
+                        help="shuffle the read with this seed (enables"
+                             " shuffle_row_groups and, via"
+                             " deterministic='auto', seed-stable delivery)")
+    parser.add_argument("--deterministic", default="auto",
+                        choices=("auto", "seed", "off"),
+                        help="delivery-order mode (docs/operations.md"
+                             " 'Reproducibility'): 'seed' releases batches"
+                             " in plan order so the stream digest is"
+                             " bit-identical across configurations")
+    parser.add_argument("--stream-digest", action="store_true",
+                        help="print the run's stream certificate as a"
+                             " machine-parseable 'stream_digest ...' line -"
+                             " run twice (any worker count / pool / chaos)"
+                             " and diff the lines to verify seed-stable"
+                             " delivery; also under --json")
     return parser
 
 
@@ -156,6 +172,8 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                   autotune=False,
                   cache_type: str = "null",
                   cache_location: Optional[str] = None,
+                  shuffle_seed: Optional[int] = None,
+                  deterministic: str = "auto",
                   on_reader=None) -> dict:
     """Read ``dataset_url`` with telemetry enabled; returns a result dict
     with ``rows``, ``batches``, ``snapshot``, ``report``,
@@ -180,7 +198,9 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
     t_start = time.monotonic()
     with factory(dataset_url, reader_pool_type=pool_type,
                  workers_count=workers_count, num_epochs=num_epochs,
-                 shuffle_row_groups=False, telemetry=tele,
+                 shuffle_row_groups=shuffle_seed is not None,
+                 shuffle_seed=shuffle_seed, deterministic=deterministic,
+                 telemetry=tele,
                  chaos=chaos, on_error=on_error,
                  item_deadline_s=item_deadline_s,
                  hedge_after_s=hedge_after_s,
@@ -252,6 +272,11 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
             "liveness": liveness,
             # knob values + decision log when --autotune tuned the run
             "autotune": final_diag.get("autotune"),
+            # the run's stream certificate (docs/operations.md
+            # "Reproducibility"); operators and the CI determinism smoke
+            # share this one code path via --stream-digest
+            "stream_digest": final_diag.get("stream_digest"),
+            "deterministic": final_diag.get("deterministic"),
             "metrics_port": bound_port,
             "telemetry": tele}
 
@@ -404,6 +429,8 @@ def _watch(args, url: str, chaos) -> int:
                 autotune=args.autotune,
                 cache_type=args.cache_type,
                 cache_location=args.cache_location,
+                shuffle_seed=args.seed,
+                deterministic=args.deterministic,
                 on_reader=lambda r: reader_box.update(reader=r))
         except BaseException as exc:  # noqa: BLE001 - reported on main thread
             box["error"] = exc
@@ -501,6 +528,22 @@ def render_autotune_verdict(autotune: dict) -> str:
     return "\n".join(lines)
 
 
+def render_stream_digest(digest: Optional[dict],
+                         deterministic: Optional[str] = None) -> str:
+    """Machine-parseable one-liner for the run's stream certificate - the
+    line the CI determinism smoke (and an operator diffing two runs) greps
+    and compares (docs/operations.md "Reproducibility")."""
+    if not digest:
+        return "stream_digest unavailable"
+    epochs = " ".join(f"e{e}={v}"
+                      for e, v in sorted(digest.get("epochs", {}).items()))
+    return ("stream_digest"
+            + (f" mode={deterministic}" if deterministic else "")
+            + f" combined={digest.get('combined')}"
+            + f" batches={digest.get('batches')} rows={digest.get('rows')}"
+            + (f" {epochs}" if epochs else ""))
+
+
 def render_liveness_verdict(liveness: dict) -> str:
     """One-line liveness triage verdict from ``run_diagnosis``'s
     ``liveness`` dict - the answer to "is this pipeline wedged, and on
@@ -578,7 +621,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                sample_interval_s=args.interval,
                                autotune=args.autotune,
                                cache_type=args.cache_type,
-                               cache_location=args.cache_location)
+                               cache_location=args.cache_location,
+                               shuffle_seed=args.seed,
+                               deterministic=args.deterministic)
         if args.trace_out:
             result["telemetry"].export_chrome_trace(args.trace_out)
         if args.json:
@@ -589,6 +634,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   result["quarantined_rowgroups"],
                               "liveness": result["liveness"],
                               "autotune": result["autotune"],
+                              "stream_digest": result["stream_digest"],
+                              "deterministic": result["deterministic"],
                               "snapshot": result["snapshot"]}))
         else:
             what = "synthetic dataset" if tmpdir else url
@@ -598,6 +645,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   + f" from {what}")
             print(result["report"])
             print(render_liveness_verdict(result["liveness"]))
+            if args.stream_digest:
+                print(render_stream_digest(result["stream_digest"],
+                                           result["deterministic"]))
             if result.get("autotune"):
                 print(render_autotune_verdict(result["autotune"]))
             for entry in result["quarantined_rowgroups"]:

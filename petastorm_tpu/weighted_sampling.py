@@ -16,6 +16,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.seeding import seed_stream
 
 
 class WeightedSamplingReader:
@@ -34,7 +35,11 @@ class WeightedSamplingReader:
             raise PetastormTpuError(f"Invalid probabilities {probabilities}")
         self._p = p / p.sum()
         self._readers = list(readers)
-        self._rng = np.random.default_rng(seed)
+        # centralized derivation (petastorm_tpu.seeding): a seeded mix draws
+        # a PYTHONHASHSEED-stable stream independent of every other seeded
+        # stage; None keeps the unseeded each-run-differs behavior
+        self._rng = (seed_stream(seed, 0, "weighted_sampling")
+                     if seed is not None else np.random.default_rng())
         # readers not yet exhausted by __next__; persists across calls so dead
         # readers are not re-drawn/re-polled on every remaining row
         self._alive: List[int] = list(range(len(self._readers)))

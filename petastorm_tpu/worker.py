@@ -373,10 +373,17 @@ class RowGroupDecoderWorker:
         if spec[0] == "random":
             _, crop_h, crop_w = spec
             lo, hi = item.row_slice()
-            seed = int(hashlib.md5(
-                f"{item.row_group.path}:{item.row_group.row_group}:{lo}"
-                .encode()).hexdigest()[:8], 16)
-            rng = np.random.default_rng(seed)
+            # centralized derivation (petastorm_tpu.seeding): keyed by the
+            # work item's MOUNT-INDEPENDENT identity (the dataset-global
+            # rowgroup index + row slice - never the filesystem path, whose
+            # prefix differs across hosts/mounts; never the ordinal or
+            # attempt), so every plan position, requeue, hedge copy,
+            # resumed read AND remounted host decodes the same crops -
+            # matching the stream certificate's own location independence
+            from petastorm_tpu.seeding import seed_stream
+
+            rng = seed_stream(0, 0, "worker.decode_roi",
+                              item.row_group.global_index, lo)
             ys = rng.integers(0, full_h - crop_h + 1, n, dtype=np.int32)
             xs = rng.integers(0, full_w - crop_w + 1, n, dtype=np.int32)
             return (ys, xs, crop_h, crop_w)

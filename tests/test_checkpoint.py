@@ -122,17 +122,22 @@ def test_drain_to_cursor_exact_resume(ds):
     import collections
 
     # enough rowgroups that the in-flight window cannot swallow the whole
-    # dataset before quiesce (bounded results queue keeps the reader behind)
+    # dataset before quiesce: with a seeded reader deterministic delivery is
+    # auto-armed and its ventilation RELEASE WINDOW (~2x the executor's
+    # in-flight capacity, ~52 items here) structurally caps how far the
+    # pipeline runs ahead of the release point - 256 items keeps
+    # "drain stopped mid-stream" guaranteed, not a timing race (128 items
+    # could fully ventilate before quiesce on a fast run)
     url = ds + "_drain"
     import os
     if not os.path.exists(url):
         rng = np.random.default_rng(1)
         write_dataset(url, SCHEMA,
                       [{"id": i, "x": rng.standard_normal(4).astype(np.float32)}
-                       for i in range(128)],
+                       for i in range(512)],
                       row_group_size_rows=2)
     ds = url
-    n_rows = 128
+    n_rows = 512
 
     seen = []
     with make_batch_reader(ds, reader_pool_type="thread", workers_count=4,

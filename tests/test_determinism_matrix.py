@@ -1,0 +1,449 @@
+"""Chaos-matrix determinism (ISSUE 10 / ROADMAP item 3): a (seed, epoch)
+pair delivers a bit-identical stream - visitation order AND batch
+composition - across worker counts, executor flavors, chaos kills, hangs,
+hedges, mid-epoch resizes, the service transport, and a quiesce/resume
+split.  Certified two ways per cell: the reader's StreamDigest and the
+harness's independent crc over delivered column bytes
+(test_util/matrix.py).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.seeding import StreamDigest, derive_seed, seed_stream
+from petastorm_tpu.test_util.matrix import (CellResult, MatrixCell, run_cell,
+                                            service_fleet)
+
+SEED = 7
+EPOCHS = 2
+
+
+@pytest.fixture(scope="module")
+def matrix_dataset(tmp_path_factory):
+    """200 int rows in 20 rowgroups: small enough for many cells, enough
+    rowgroups for real out-of-order completion."""
+    url = str(tmp_path_factory.mktemp("det_matrix") / "ds")
+    schema = Schema("DetMatrix", [Field("x", np.int64)])
+    write_dataset(url, schema, [{"x": i} for i in range(200)],
+                  row_group_size_rows=10)
+    return url
+
+
+@pytest.fixture(scope="module")
+def baseline(matrix_dataset) -> CellResult:
+    """The reference stream: 2 thread workers, no chaos."""
+    return run_cell(matrix_dataset, SEED,
+                    MatrixCell(workers=2, pool="thread"), num_epochs=EPOCHS)
+
+
+def _assert_matches(result: CellResult, base: CellResult, label: str) -> None:
+    assert result.rows == base.rows, label
+    assert result.batch_rows == base.batch_rows, \
+        f"{label}: batch boundaries differ"
+    assert result.digest["combined"] == base.digest["combined"], \
+        f"{label}: stream digest differs ({result.digest} vs {base.digest})"
+    assert result.digest["epochs"] == base.digest["epochs"], label
+    assert result.content_crc == base.content_crc, \
+        f"{label}: delivered bytes differ despite equal digests"
+
+
+# -- the matrix ---------------------------------------------------------------
+
+LOCAL_CELLS = [
+    MatrixCell(workers=1, pool="thread"),
+    MatrixCell(workers=4, pool="thread"),
+    MatrixCell(workers=2, pool="serial"),
+    MatrixCell(workers=3, pool="thread", chaos="kill"),
+    MatrixCell(workers=3, pool="thread", chaos="hang"),
+    MatrixCell(workers=3, pool="thread", chaos="hedge"),
+    MatrixCell(workers=2, pool="thread", resize=True),
+    MatrixCell(workers=4, pool="thread", chaos="kill", resize=True),
+    MatrixCell(workers=2, pool="thread", split="quiesce"),
+    MatrixCell(workers=3, pool="thread", chaos="kill", split="quiesce"),
+]
+
+
+@pytest.mark.parametrize("cell", LOCAL_CELLS, ids=lambda c: c.label())
+def test_local_cells_bit_identical(matrix_dataset, baseline, cell):
+    """Every local-transport cell delivers the baseline's exact stream."""
+    result = run_cell(matrix_dataset, SEED, cell, num_epochs=EPOCHS)
+    _assert_matches(result, baseline, cell.label())
+
+
+PROCESS_CELLS = [
+    MatrixCell(workers=2, pool="process"),
+    MatrixCell(workers=3, pool="process", chaos="kill"),
+    MatrixCell(workers=2, pool="process", resize=True),
+    MatrixCell(workers=2, pool="process", split="quiesce"),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("cell", PROCESS_CELLS, ids=lambda c: c.label())
+def test_process_cells_bit_identical(matrix_dataset, baseline, cell):
+    """Process-pool cells (spawn cost makes these slow-marked): real
+    worker processes, real os._exit kills - same stream."""
+    result = run_cell(matrix_dataset, SEED, cell, num_epochs=EPOCHS)
+    _assert_matches(result, baseline, cell.label())
+
+
+def test_process_cell_smoke(matrix_dataset, baseline):
+    """One process-pool cell stays in the tier-1 (not-slow) run: the
+    cross-executor half of the invariant must not rot between slow runs."""
+    result = run_cell(matrix_dataset, SEED,
+                      MatrixCell(workers=2, pool="process"),
+                      num_epochs=EPOCHS)
+    _assert_matches(result, baseline, "2w-process")
+
+
+def test_service_cells_bit_identical(matrix_dataset, baseline):
+    """The service hop delivers the identical stream - plain, and across a
+    mid-epoch quiesce/resume split (one fleet serves both cells)."""
+    with service_fleet(n_workers=2) as (_disp, addr, _workers):
+        plain = run_cell(matrix_dataset, SEED,
+                         MatrixCell(transport="service"),
+                         num_epochs=EPOCHS, service_address=addr)
+        _assert_matches(plain, baseline, "service")
+        split = run_cell(matrix_dataset, SEED,
+                         MatrixCell(transport="service", split="quiesce"),
+                         num_epochs=EPOCHS, service_address=addr)
+        _assert_matches(split, baseline, "service-quiesce")
+
+
+@pytest.mark.slow
+def test_service_sigkill_quiesce_resume_digest(matrix_dataset, baseline):
+    """Satellite: quiesce a service reader mid-epoch while a REAL worker
+    subprocess is SIGKILLed, resume, and the combined stream digest equals
+    an uninterrupted run's (the dispatcher requeues the killed worker's
+    in-flight items; the reorder stage + digest chain absorb the rest)."""
+    with service_fleet(n_workers=2, subprocess_workers=True) \
+            as (disp, addr, procs):
+        kwargs = dict(service_address=addr, shuffle_row_groups=True,
+                      shuffle_seed=SEED, deterministic="seed",
+                      num_epochs=EPOCHS)
+        crc_rows = []
+        with make_batch_reader(matrix_dataset, **kwargs) as reader:
+            it = reader.iter_batches()
+            for _ in range(4):
+                crc_rows.extend(next(it).columns["x"])
+            # kill a worker holding in-flight work, then quiesce mid-epoch
+            procs[0].send_signal(signal.SIGKILL)
+            for _ in range(2):
+                crc_rows.extend(next(it).columns["x"])
+            reader.quiesce()
+            crc_rows.extend(x for b in it for x in b.columns["x"])
+            state = reader.state_dict()
+        assert state["ordinal_exact"]
+        assert disp.stats()["counters"].get("service.requeued_items", 0) >= 0
+        with make_batch_reader(matrix_dataset, resume_from=state,
+                               **kwargs) as reader:
+            crc_rows.extend(x for b in reader.iter_batches()
+                            for x in b.columns["x"])
+            resumed = reader.diagnostics["stream_digest"]
+    assert resumed["combined"] == baseline.digest["combined"], \
+        (resumed, baseline.digest)
+    assert resumed["rows"] == baseline.rows
+
+
+# -- seed sensitivity ---------------------------------------------------------
+
+def test_different_seed_different_digest(matrix_dataset, baseline):
+    """The certificate is seed-SENSITIVE: ordinals alone would collapse
+    different plans to equal digests; item identity must not."""
+    other = run_cell(matrix_dataset, SEED + 1, MatrixCell(), num_epochs=EPOCHS)
+    assert other.rows == baseline.rows
+    assert other.digest["combined"] != baseline.digest["combined"]
+    assert other.content_crc != baseline.content_crc
+
+
+def test_deterministic_off_still_certifies(matrix_dataset):
+    """'off' keeps the digest as a per-run certificate (batch/row totals
+    exact) without the ordering guarantee."""
+    with make_batch_reader(matrix_dataset, workers_count=3,
+                           shuffle_row_groups=True, shuffle_seed=SEED,
+                           deterministic="off", num_epochs=1) as reader:
+        rows = sum(b.num_rows for b in reader.iter_batches())
+        dig = reader.diagnostics["stream_digest"]
+        assert reader.deterministic == "off"
+    assert rows == 200
+    assert dig["batches"] == 20 and dig["rows"] == 200
+
+
+def test_deterministic_auto_resolution(matrix_dataset):
+    """'auto' = 'seed' exactly when a shuffle_seed is pinned."""
+    with make_batch_reader(matrix_dataset, shuffle_seed=3,
+                           num_epochs=1) as reader:
+        assert reader.deterministic == "seed"
+        list(reader.iter_batches())
+    with make_batch_reader(matrix_dataset, num_epochs=1) as reader:
+        assert reader.deterministic == "off"
+        list(reader.iter_batches())
+
+
+# -- PYTHONHASHSEED stability (satellite: centralized seed derivation) --------
+
+_HASHSEED_SCRIPT = """
+import sys
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.seeding import derive_seed
+
+with make_batch_reader(sys.argv[1], workers_count=2, shuffle_row_groups=True,
+                       shuffle_seed=7, deterministic="seed",
+                       num_epochs=1) as reader:
+    rows = [int(x) for b in reader.iter_batches() for x in b.columns["x"]]
+    dig = reader.diagnostics["stream_digest"]["combined"]
+print(dig)
+print(derive_seed(7, 0, "loader.shuffle_buffer"))
+print(rows[:20])
+"""
+
+
+def test_digest_stable_across_pythonhashseed(matrix_dataset, tmp_path):
+    """Seed derivation must never route through hash(): the same read under
+    different PYTHONHASHSEED values produces identical digests, derived
+    seeds and row streams (the exact failure mode that silently defeated
+    cross-process cache sharing in PR 7)."""
+    script = tmp_path / "hashseed_probe.py"
+    script.write_text(_HASHSEED_SCRIPT)
+    outputs = []
+    for hashseed in ("0", "424242"):
+        env = dict(os.environ, PYTHONHASHSEED=hashseed,
+                   JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        proc = subprocess.run(
+            [sys.executable, str(script), matrix_dataset],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 0, proc.stderr
+        outputs.append(proc.stdout)
+    assert outputs[0] == outputs[1], \
+        f"PYTHONHASHSEED changed the stream:\n{outputs[0]}\nvs\n{outputs[1]}"
+
+
+# -- seeding unit behavior ----------------------------------------------------
+
+def test_seed_stream_properties():
+    a = seed_stream(1, 0, "d").integers(0, 1 << 30, 8)
+    assert (a == seed_stream(1, 0, "d").integers(0, 1 << 30, 8)).all()
+    # seed, epoch, domain and extra parts all separate streams
+    for other in (seed_stream(2, 0, "d"), seed_stream(1, 1, "d"),
+                  seed_stream(1, 0, "e"), seed_stream(1, 0, "d", 1),
+                  seed_stream(1, 0, "d", "x")):
+        assert not (a == other.integers(0, 1 << 30, 8)).all()
+    # None == 0 (deterministic default), int/str parts are type-tagged
+    assert derive_seed(None, 0, "d") == derive_seed(0, 0, "d")
+    assert derive_seed(0, 0, "d", 1) != derive_seed(0, 0, "d", "1")
+
+
+def test_stream_digest_chain_and_state_roundtrip():
+    a = StreamDigest()
+    a.record_batch(0, 0, 5, 1, 0, 10, 10)
+    a.record_skip(0, 1, 6, 2)
+    a.record_batch(1, 2, 7, 0, 0, 10, 10)
+    # state round-trip continues the chain exactly
+    b = StreamDigest(state=a.state())
+    c = StreamDigest()
+    for d in (a, b):
+        d.record_batch(1, 3, 8, 1, 0, 10, 10)
+    c.record_batch(0, 0, 5, 1, 0, 10, 10)
+    c.record_skip(0, 1, 6, 2)
+    c.record_batch(1, 2, 7, 0, 0, 10, 10)
+    c.record_batch(1, 3, 8, 1, 0, 10, 10)
+    assert a.summary() == b.summary() == c.summary()
+    assert set(a.summary()["epochs"]) == {0, 1}
+    # order sensitivity
+    d = StreamDigest()
+    d.record_batch(1, 2, 7, 0, 0, 10, 10)
+    d.record_batch(0, 0, 5, 1, 0, 10, 10)
+    d.record_skip(0, 1, 6, 2)
+    d.record_batch(1, 3, 8, 1, 0, 10, 10)
+    assert d.summary()["combined"] != a.summary()["combined"]
+
+
+def test_straggler_release_noop_under_deterministic(matrix_dataset, caplog):
+    """Satellite: straggler_release_s is a timing-driven floor bypass; under
+    deterministic='seed' the loader disarms it with one warning."""
+    pytest.importorskip("jax")
+    from petastorm_tpu.jax.loader import JaxDataLoader
+
+    with make_batch_reader(matrix_dataset, workers_count=2,
+                           shuffle_row_groups=True, shuffle_seed=SEED,
+                           num_epochs=1) as reader:
+        import logging
+
+        with caplog.at_level(logging.WARNING,
+                             logger="petastorm_tpu.jax.loader"):
+            loader = JaxDataLoader(reader, batch_size=16,
+                                   shuffling_queue_capacity=64,
+                                   straggler_release_s=1.0)
+        with loader:
+            assert loader._straggler_s is None
+            assert any("straggler_release_s" in r.message
+                       for r in caplog.records)
+            rows = 0
+            for batch in loader:
+                rows += int(np.asarray(batch["x"]).shape[0])
+    assert rows == 192  # 200 rows, batch 16, drop_last
+
+
+def test_loader_batches_bit_identical_across_workers(matrix_dataset):
+    """End-to-end through the jax loader: shuffle-buffer composition is a
+    pure function of the seed root - two worker counts deliver identical
+    batch sequences (the 'batch composition' half of the invariant)."""
+    pytest.importorskip("jax")
+    from petastorm_tpu.jax.loader import JaxDataLoader
+
+    def run(workers):
+        out = []
+        with make_batch_reader(matrix_dataset, workers_count=workers,
+                               shuffle_row_groups=True, shuffle_seed=SEED,
+                               num_epochs=1) as reader:
+            with JaxDataLoader(reader, batch_size=16,
+                               shuffling_queue_capacity=64) as loader:
+                for batch in loader:
+                    out.append(np.asarray(batch["x"]).tolist())
+        return out
+
+    assert run(1) == run(4)
+
+
+def test_autotune_excludes_decode_split_when_deterministic():
+    """The decode_split knob is content-changing and must never attach
+    under a deterministic policy exclusion."""
+    from petastorm_tpu.autotune import AutotuneController, AutotunePolicy
+    from petastorm_tpu.telemetry import Telemetry
+
+    class _FakeSampler:
+        def series(self):
+            return []
+
+    tele = Telemetry()
+    policy = AutotunePolicy(exclude_knobs=frozenset({"decode_split"}))
+    controller = AutotuneController(object(), _FakeSampler(), tele,
+                                    policy=policy)
+    controller.attach_decode_split(get=lambda: 1, set_=lambda v: v)
+    assert "decode_split" not in controller._knobs
+
+
+def test_ordinal_less_batch_degrades_without_wedging(matrix_dataset):
+    """A transport that drops a ventilation ordinal mid-stream must degrade
+    to arrival order (one warning) and FLUSH the already-held batches - not
+    wedge the epoch waiting on an ordinal that will never release."""
+    import dataclasses
+    import logging
+
+    with make_batch_reader(matrix_dataset, workers_count=4,
+                           shuffle_row_groups=True, shuffle_seed=SEED,
+                           deterministic="seed", num_epochs=1) as reader:
+        real_get = reader._executor.get
+        stripped = {"n": 0}
+
+        def stripping_get(timeout=None):
+            batch = real_get(timeout=timeout)
+            stripped["n"] += 1
+            if stripped["n"] == 3:  # drop the THIRD arrival's ordinal
+                return dataclasses.replace(batch, ordinal=None)
+            return batch
+
+        reader._executor.get = stripping_get
+        logger = logging.getLogger("petastorm_tpu.reader")
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        logger.addHandler(handler)
+        try:
+            rows = sorted(x for b in reader.iter_batches()
+                          for x in b.columns["x"])
+        finally:
+            logger.removeHandler(handler)
+        assert reader._det_warned_unordered
+        assert not reader._det_held  # everything held was flushed
+        # reset() restores full seed-stable delivery: the degrade flag
+        # clears, and the reset run's digest equals a FRESH reader's
+        reader._executor.get = real_get
+        reader.reset()
+        assert not reader._det_warned_unordered
+        reset_rows = [int(x) for b in reader.iter_batches()
+                      for x in b.columns["x"]]
+        reset_digest = reader.diagnostics["stream_digest"]["combined"]
+    with make_batch_reader(matrix_dataset, workers_count=2,
+                           shuffle_row_groups=True, shuffle_seed=SEED,
+                           deterministic="seed", num_epochs=1) as fresh:
+        fresh_rows = [int(x) for b in fresh.iter_batches()
+                      for x in b.columns["x"]]
+        fresh_digest = fresh.diagnostics["stream_digest"]["combined"]
+    assert reset_rows == fresh_rows
+    assert reset_digest == fresh_digest
+    assert rows == list(range(200))  # exact multiset despite the degrade
+    assert sum("degraded" in r.getMessage() for r in records) == 1
+
+
+def test_ventilator_release_window_paces_and_resumes():
+    """The deterministic release window: ventilation pauses one window past
+    the release point and resumes as releases advance - the bound that
+    keeps the reorder stage's memory finite under a straggling rowgroup."""
+    import threading
+    import time as _time
+
+    from petastorm_tpu.pool import Ventilator
+
+    class _Plan:
+        def epoch_items(self, epoch):
+            return list(range(50))
+
+        def total_items(self, n):
+            return 50 * n
+
+    class _RecordingExecutor:
+        def __init__(self):
+            self.puts = []
+
+        def put(self, item, cancel_event=None):
+            self.puts.append(item.ordinal)
+
+    released = {"n": 0}
+    ex = _RecordingExecutor()
+    vent = Ventilator(ex, _Plan(), num_epochs=1, release_window=10,
+                      release_progress=lambda: released["n"])
+    vent.start()
+    deadline = _time.monotonic() + 5
+    while len(ex.puts) < 10 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    _time.sleep(0.1)  # would overshoot here without the window
+    assert len(ex.puts) == 10, ex.puts  # paused exactly one window ahead
+    released["n"] = 25  # consumer released a prefix
+    deadline = _time.monotonic() + 5
+    while len(ex.puts) < 35 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert len(ex.puts) == 35  # resumed up to the new window edge
+    released["n"] = 50
+    vent.join()
+    assert ex.puts == list(range(50))
+    assert threading.active_count() >= 1  # ventilator thread exited cleanly
+
+
+def test_reorder_telemetry_counters(matrix_dataset):
+    """Reordered deliveries are observable: the reader counts batches that
+    arrived out of plan order and exposes the digest gauge."""
+    from petastorm_tpu.telemetry import Telemetry
+
+    tele = Telemetry()
+    with make_batch_reader(matrix_dataset, workers_count=4,
+                           shuffle_row_groups=True, shuffle_seed=SEED,
+                           deterministic="seed", num_epochs=2,
+                           telemetry=tele) as reader:
+        rows = sum(b.num_rows for b in reader.iter_batches())
+        expected = reader.diagnostics["stream_digest"]
+    assert rows == 400
+    snap = tele.snapshot()
+    assert snap["gauges"]["stream.digest"] == int(expected["combined"], 16)
+    assert "reader.reordered_batches" in snap["counters"]

@@ -45,6 +45,13 @@ in-process thread pool in the same session; their quotient
 member - it prices the wire-transport tax (r08: 0.36x on ~5MB pixel
 batches), so a drop in the RATIO means the service plane itself regressed
 even when both absolute rates moved with the host.
+
+Determinism metrics (BENCH_r09+, docs/operations.md "Reproducibility"):
+``determinism_vs_off_ratio`` prices the ``deterministic='seed'`` reorder
+stage against completion-order delivery, same-session anchored.  It also
+carries an ABSOLUTE floor (see ``ABSOLUTE_FLOORS``): any candidate below
+0.85x fails an armed gate even if the baseline file was already below it -
+the ISSUE 10 acceptance bar is absolute, not relative.
 """
 
 from __future__ import annotations
@@ -58,6 +65,14 @@ from typing import Dict, List, Optional
 #: percentages, latency ratios); everything else is treated as a rate
 LOWER_IS_BETTER_MARKERS = ("idle_pct", "stall_pct", "latency",
                            "latent_vs_local")
+
+#: metric -> minimum acceptable value: an armed gate fails a candidate
+#: BELOW the floor regardless of the baseline (absolute acceptance bars,
+#: immune to a baseline that was itself captured in a bad session)
+ABSOLUTE_FLOORS = {
+    # ISSUE 10: deterministic-mode throughput >= 0.85x completion-order
+    "determinism_vs_off_ratio": 0.85,
+}
 
 
 def lower_is_better(name: str) -> bool:
@@ -180,10 +195,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     # weather-flagged metrics report but never gate: a capture taken while
     # the tunnel/runtime weather probe said "degraded" measures the weather,
     # not the code (VERDICT r5) - skipping beats a false regression alarm
+    for r in rows:
+        floor = ABSOLUTE_FLOORS.get(r["metric"])
+        if floor is not None and r["new"] is not None and r["new"] < floor:
+            r["below_floor"] = floor
     failures = [r for r in rows
                 if args.fail_threshold is not None
                 and r["metric"] not in weather_flagged
-                and r.get("regression_pct", 0.0) > args.fail_threshold]
+                and (r.get("regression_pct", 0.0) > args.fail_threshold
+                     or "below_floor" in r)]
     skipped = [r for r in rows
                if args.fail_threshold is not None
                and r["metric"] in weather_flagged
@@ -205,6 +225,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             note = " (lower is better)" if r["lower_is_better"] else ""
             if r.get("weather"):
                 note += " [degraded weather - gate skipped]"
+            if "below_floor" in r:
+                note += f" [below absolute floor {r['below_floor']:g}]"
             flag = "  << REGRESSION" if r in failures else ""
             print(f"{r['metric']:<{width}} {old_s:>14} {new_s:>14}"
                   f" {delta_s}{note}{flag}")
