@@ -448,6 +448,52 @@ echo "  run C (other seed):  $DET_C"
     exit 1; }
 echo "determinism smoke OK (2w == 4w+kill, seed 7 != seed 8)"
 
+echo "== sequence smoke (token pipeline: packed 2-corpus mixture digest stable across configs) =="
+# two SUBPROCESS runs over one 2-corpus token mixture - different worker
+# counts, the second with a chaos worker kill - must print identical
+# packed-stream + mixture digests; a third run with a different seed must
+# differ.  Packing fill-rate must clear the ISSUE 11 floor (>= 0.85).
+SEQ_DS="$(mktemp -d /tmp/petastorm_tpu_seq_smoke_XXXXXX)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$SEQ_DS" <<'PY'
+import sys
+from petastorm_tpu.test_util.synthetic import write_token_corpus
+for i in range(2):
+    write_token_corpus(f"{sys.argv[1]}/c{i}", n_docs=120, rows_per_rg=10,
+                       mean_len=24, max_len=100, seed=90 + i)
+PY
+SEQ_SMOKE="$(mktemp /tmp/petastorm_tpu_seq_smoke_XXXXXX.py)"
+cat > "$SEQ_SMOKE" <<'PY'
+import sys
+
+from petastorm_tpu.test_util.matrix import MatrixCell, run_sequence_cell
+
+base, workers, chaos, seed = sys.argv[1:5]
+urls = [f"{base}/c0", f"{base}/c1"]
+cell = MatrixCell(workers=int(workers), pool="thread", chaos=chaos)
+r = run_sequence_cell(urls, int(seed), cell, num_epochs=2)
+assert r.fill_rate >= 0.85, f"fill-rate {r.fill_rate} below the 0.85 floor"
+print(f"packed_digest {r.packed_crc:08x}"
+      f" mixture={r.mixture['combined']} tokens={r.tokens}")
+PY
+SEQ_A="$(JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 \
+    python "$SEQ_SMOKE" "$SEQ_DS" 2 none 7 | grep '^packed_digest')"
+SEQ_B="$(JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 \
+    python "$SEQ_SMOKE" "$SEQ_DS" 4 kill 7 2>/dev/null | grep '^packed_digest')"
+SEQ_C="$(JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 120 \
+    python "$SEQ_SMOKE" "$SEQ_DS" 2 none 8 | grep '^packed_digest')"
+rm -rf "$SEQ_DS" "$SEQ_SMOKE"
+echo "  run A (2w):          $SEQ_A"
+echo "  run B (4w + kill):   $SEQ_B"
+echo "  run C (other seed):  $SEQ_C"
+[ -n "$SEQ_A" ] || { echo "sequence smoke FAILED: no digest line"; exit 1; }
+[ "$SEQ_A" = "$SEQ_B" ] || {
+    echo "sequence smoke FAILED: packed digests differ across configs"
+    exit 1; }
+[ "$SEQ_A" != "$SEQ_C" ] || {
+    echo "sequence smoke FAILED: different seeds produced equal packed digests"
+    exit 1; }
+echo "sequence smoke OK (2w == 4w+kill, seed 7 != seed 8, fill >= 0.85)"
+
 echo "== driver entry compile-check =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py 8

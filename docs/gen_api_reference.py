@@ -38,6 +38,17 @@ API = [
                                  "UnionIndexSelector"]),
     ("petastorm_tpu.ngram", ["NGram"]),
     ("petastorm_tpu.weighted_sampling", ["WeightedSamplingReader"]),
+    ("petastorm_tpu.sequence.dataset", ["token_field", "is_sequence_field",
+                                        "make_sequence_reader",
+                                        "iter_documents"]),
+    ("petastorm_tpu.sequence.packing", ["SequencePacker", "iter_packed_rows",
+                                        "iter_packed_blocks",
+                                        "iter_ragged_batches",
+                                        "packed_stream_digest"]),
+    ("petastorm_tpu.sequence.mixing", ["make_mixed_sequence_reader",
+                                       "corpus_seed"]),
+    ("petastorm_tpu.sequence.loader", ["PackedSequenceReader",
+                                       "make_packed_sequence_loader"]),
     ("petastorm_tpu.seeding", ["seed_stream", "derive_seed", "StreamDigest",
                                "reader_buffer_seed",
                                "resolve_deterministic"]),

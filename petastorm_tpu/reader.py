@@ -810,6 +810,18 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     return reader
 
 
+def _is_sequence_like(field) -> bool:
+    """A variable-length 1-D column (token documents and other list data):
+    image-only knobs (decode_roi, decode_placement) must refuse these with
+    guidance instead of an image-centric shape error.  ONE definition -
+    the sequence package's - so the two layers never classify a field
+    differently (lazy import; sequence.dataset does not import reader at
+    module level)."""
+    from petastorm_tpu.sequence.dataset import is_sequence_field
+
+    return is_sequence_field(field)
+
+
 _ROI_MODES = ("center", "random")
 
 
@@ -857,6 +869,14 @@ def _validate_decode_roi(decode_roi, schema, read_fields, decode_placement,
                 f"{decode_placement[name]!r}: coefficient planes carry the"
                 " full image (crop on-device instead, ops/augment.py)")
         field = schema[name]
+        if _is_sequence_like(field):
+            raise PetastormTpuError(
+                f"decode_roi field {name!r} is a variable-length sequence"
+                f" field (shape {field.shape}, codec {field.codec!r}):"
+                " decode_roi is a partial IMAGE decode and does not apply to"
+                " token columns. Filter documents with a predicate (pushed"
+                " down before decode) or slice tokens in the packer"
+                " (petastorm_tpu.sequence).")
         if not (field.is_fixed_shape and field.dtype == np.dtype("uint8")
                 and isinstance(field.codec, CompressedImageCodec)
                 and len(field.shape) in (2, 3)):
@@ -925,6 +945,14 @@ def _validate_decode_placement(decode_placement, schema, read_fields,
                                     f" schema {[f.name for f in schema]}")
         if place == "host":
             continue
+        if _is_sequence_like(schema[name]):
+            raise PetastormTpuError(
+                f"decode_placement field {name!r} is a variable-length"
+                f" sequence field (shape {schema[name].shape}, codec"
+                f" {schema[name].codec!r}): device decode placement is for"
+                " jpeg image columns (the worker ships coefficient planes)."
+                " Token columns decode host-side; deliver them through"
+                " petastorm_tpu.sequence (packing + JaxDataLoader).")
         if not native_image.available():
             raise PetastormTpuError(
                 f"decode_placement={place!r} needs the native image library"

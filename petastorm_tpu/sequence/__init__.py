@@ -1,0 +1,42 @@
+"""Token/sequence pipeline: tokenized-text datasets, deterministic sequence
+packing, ragged delivery, and seeded multi-corpus mixture scheduling -
+the LLM ingest workload on the same plan/executor/service machinery the
+image pipeline runs on (ROADMAP item 4; docs/operations.md "Token
+pipelines").
+
+Layers (each usable alone):
+
+* :mod:`~petastorm_tpu.sequence.dataset` - token corpora as
+  variable-length list columns; validated readers; the document stream.
+* :mod:`~petastorm_tpu.sequence.packing` - first-fit-shrinking packing
+  into dense ``(batch, seq_len)`` blocks with segment IDs / positions /
+  loss masks, ragged delivery, and the packed-stream digest.
+* :mod:`~petastorm_tpu.sequence.mixing` - N corpora mixed by weight, the
+  whole mixture a pure function of one seed, draw sequence certified.
+* :mod:`~petastorm_tpu.sequence.loader` - JaxDataLoader integration
+  delivering ``(tokens, segment_ids, positions, loss_mask)`` device
+  arrays.
+"""
+
+from petastorm_tpu.sequence.dataset import (is_sequence_field,
+                                            iter_documents,
+                                            make_sequence_reader,
+                                            token_field)
+from petastorm_tpu.sequence.loader import (PackedSequenceReader,
+                                           make_packed_sequence_loader)
+from petastorm_tpu.sequence.mixing import (corpus_seed,
+                                           make_mixed_sequence_reader)
+from petastorm_tpu.sequence.packing import (PACKED_FIELDS, SequencePacker,
+                                            iter_packed_blocks,
+                                            iter_packed_rows,
+                                            iter_ragged_batches,
+                                            packed_stream_digest)
+
+__all__ = [
+    "token_field", "is_sequence_field", "make_sequence_reader",
+    "iter_documents",
+    "SequencePacker", "iter_packed_rows", "iter_packed_blocks",
+    "iter_ragged_batches", "packed_stream_digest", "PACKED_FIELDS",
+    "make_mixed_sequence_reader", "corpus_seed",
+    "PackedSequenceReader", "make_packed_sequence_loader",
+]

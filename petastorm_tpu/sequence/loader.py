@@ -1,0 +1,213 @@
+"""Device delivery for packed token streams: the JaxDataLoader bridge.
+
+:class:`PackedSequenceReader` adapts any token source (a single
+:func:`~petastorm_tpu.sequence.dataset.make_sequence_reader` reader or a
+:func:`~petastorm_tpu.sequence.mixing.make_mixed_sequence_reader` mixture)
+into a reader-shaped object whose delivered "rows" are PACKED sequences:
+fixed-shape ``(seq_len,)`` ``tokens`` / ``segment_ids`` / ``positions`` /
+``loss_mask`` columns.  Because the packed rows are ordinary fixed-shape
+numeric columns, the whole jax delivery layer applies unchanged -
+``JaxDataLoader`` assembles ``(batch, seq_len)`` device arrays, shards them
+over a mesh, prefetches, and its seed-root-derived shuffle buffers stay
+bit-identical across runs (docs/operations.md "Token pipelines").
+
+:func:`make_packed_sequence_loader` is the one-call path: corpora ->
+seeded mixture -> deterministic packing -> ``(tokens, segment_ids,
+positions, loss_mask)`` device arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.sequence.dataset import iter_documents
+from petastorm_tpu.sequence.packing import (SequencePacker,
+                                            iter_packed_blocks)
+
+
+class PackedSequenceReader:
+    """Reader-shaped adapter: a token source packed into fixed-shape rows.
+
+    Wraps a batched reader (or a :class:`~petastorm_tpu.weighted_sampling.
+    WeightedSamplingReader` mixture) and exposes the reader protocol the
+    delivery layer consumes - ``schema`` / ``output_schema`` (four
+    fixed-shape ``(seq_len,)`` fields), ``iter_batches()`` (ColumnBatches
+    of ``rows_per_batch`` packed rows), ``deterministic`` /
+    ``shuffle_seed`` passthrough (so ``JaxDataLoader``'s buffer seeds still
+    derive from the source's seed root), and ``stop()``/``join()``.
+
+    The packed stream inherits the source's determinism: with
+    ``deterministic='seed'`` sources the packer consumes documents in plan
+    order, so packed rows - and every batch the loader assembles from them
+    - are bit-identical across worker counts, executor flavors, chaos
+    kills and the service hop (certified by the chaos-matrix token cells).
+
+    ``diagnostics`` carries the packer stats (fill rate, docs, splits)
+    plus the source's own diagnostics/mixture digest.
+    """
+
+    def __init__(self, source, seq_len: int, tokens_field: str = "tokens",
+                 rows_per_batch: int = 64, open_bins: int = 8,
+                 long_docs: str = "split", tokens_dtype=np.int32,
+                 mask_dtype=np.float32, pad_token: int = 0):
+        if rows_per_batch < 1:
+            raise PetastormTpuError("rows_per_batch must be >= 1")
+        self._source = source
+        self._tokens_field = tokens_field
+        self._rows_per_batch = int(rows_per_batch)
+        self._tokens_dtype = np.dtype(tokens_dtype)
+        self.packer = SequencePacker(
+            seq_len, open_bins=open_bins, long_docs=long_docs,
+            tokens_dtype=tokens_dtype, mask_dtype=mask_dtype,
+            pad_token=pad_token,
+            telemetry=getattr(source, "telemetry", None))
+        self.seq_len = int(seq_len)
+        self.schema = Schema("PackedSequence", [
+            Field("tokens", self._tokens_dtype, (self.seq_len,)),
+            Field("segment_ids", np.int32, (self.seq_len,)),
+            Field("positions", np.int32, (self.seq_len,)),
+            Field("loss_mask", np.dtype(mask_dtype), (self.seq_len,)),
+        ])
+        self.output_schema = self.schema
+        self.batched_output = True
+        self.ngram = None
+        #: passthrough so downstream stages (JaxDataLoader buffer seeds)
+        #: derive from the SOURCE's seed root - packed batch composition is
+        #: then a pure function of it
+        self.deterministic = getattr(source, "deterministic", "off")
+        self.shuffle_seed = getattr(source, "shuffle_seed", None)
+        # the packed stream carries pixels-free fixed-shape columns only
+        self.device_decode_fields: list = []
+        self.device_decode_mixed: frozenset = frozenset()
+        self.device_decode_split: frozenset = frozenset()
+        self.last_row_consumed = False
+        self._iterating = False
+
+    @property
+    def telemetry(self):
+        """The source's telemetry recorder (packer counters land there)."""
+        from petastorm_tpu.telemetry import resolve as _resolve
+
+        return _resolve(getattr(self._source, "telemetry", None))
+
+    @property
+    def diagnostics(self) -> Dict:
+        """Packing stats + the wrapped source's diagnostics (incl. the
+        mixture digest for mixed sources)."""
+        out: Dict = {"packing": self.packer.stats()}
+        sub = getattr(self._source, "diagnostics", None)
+        if isinstance(sub, dict):
+            out["source"] = sub
+        return out
+
+    def iter_batches(self) -> Iterator[ColumnBatch]:
+        """Packed rows as ColumnBatches of ``rows_per_batch`` rows (the
+        final batch may be smaller).  One pass over the source; do not call
+        twice concurrently."""
+        if self._iterating:
+            raise PetastormTpuError(
+                "PackedSequenceReader.iter_batches is single-pass; a second"
+                " concurrent iteration would interleave packer state")
+        self._iterating = True
+        try:
+            for block in iter_packed_blocks(
+                    iter_documents(self._source, self._tokens_field,
+                                   tokens_dtype=self._tokens_dtype),
+                    self.seq_len, self._rows_per_batch, packer=self.packer):
+                yield ColumnBatch(dict(block), len(block["tokens"]))
+            self.last_row_consumed = True
+        finally:
+            self._iterating = False
+
+    # -- reader protocol passthrough ------------------------------------------
+
+    def stop(self) -> None:
+        """Stop the wrapped source."""
+        self._source.stop()
+
+    def join(self) -> None:
+        """Join the wrapped source (after stop())."""
+        self._source.join()
+
+    def quiesce(self):
+        """Unsupported: the packer holds open bins a mid-stream cursor
+        cannot express - checkpoint at epoch boundaries instead (re-open
+        the source with the next epoch's seed).  Raises always."""
+        raise PetastormTpuError(
+            "PackedSequenceReader does not support quiesce/state_dict: the"
+            " packer holds open bins that a mid-stream cursor cannot"
+            " express. Checkpoint at epoch boundaries (re-open the source"
+            " with the next epoch's seed) instead.")
+
+    #: same contract (and the same refusal) as :meth:`quiesce`
+    state_dict = quiesce
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        self.join()
+
+
+def make_packed_sequence_loader(dataset_urls, batch_size: int,
+                                seq_len: int,
+                                weights: Optional[Sequence[float]] = None,
+                                seed: Optional[int] = None,
+                                tokens_field: str = "tokens",
+                                open_bins: int = 8,
+                                long_docs: str = "split",
+                                tokens_dtype=np.int32,
+                                pad_token: int = 0,
+                                loader_kwargs: Optional[dict] = None,
+                                **reader_kwargs):
+    """Corpora -> seeded mixture -> deterministic packing -> device arrays.
+
+    The one-call LLM ingest path: each delivered batch is a dict of
+    ``(batch_size, seq_len)`` jax arrays - ``tokens``, ``segment_ids``,
+    ``positions``, ``loss_mask`` - assembled by :class:`~petastorm_tpu.jax.
+    loader.JaxDataLoader` (so ``mesh``/``shardings``/``prefetch``/... via
+    ``loader_kwargs`` work exactly as for image pipelines).
+
+    ``dataset_urls``: one corpus URL (str) or a sequence of N mixed by
+    ``weights`` (see :func:`~petastorm_tpu.sequence.mixing.
+    make_mixed_sequence_reader`); ``seed`` makes the whole stream - corpus
+    plans, mixture draws, packing - a pure function of it.  Remaining
+    kwargs go to every corpus reader (``workers_count``, ``predicate``,
+    ``cache_type``, ``service_address``, ...).
+
+    Use as a context manager; closing the loader closes the readers.
+    """
+    from petastorm_tpu.jax.loader import JaxDataLoader
+    from petastorm_tpu.sequence.dataset import make_sequence_reader
+    from petastorm_tpu.sequence.mixing import make_mixed_sequence_reader
+
+    if isinstance(dataset_urls, str):
+        if "shuffle_seed" in reader_kwargs:
+            raise PetastormTpuError(
+                "pass seed= to make_packed_sequence_loader, not"
+                " shuffle_seed= (one seed drives plans, mixing and packing)")
+        source = make_sequence_reader(
+            dataset_urls, tokens_field=tokens_field,
+            shuffle_seed=seed, **reader_kwargs)
+    else:
+        source = make_mixed_sequence_reader(
+            dataset_urls, weights=weights, seed=seed,
+            tokens_field=tokens_field, **reader_kwargs)
+    try:
+        packed = PackedSequenceReader(
+            source, seq_len, tokens_field=tokens_field,
+            rows_per_batch=max(batch_size, 1), open_bins=open_bins,
+            long_docs=long_docs, tokens_dtype=tokens_dtype,
+            pad_token=pad_token)
+        return JaxDataLoader(packed, batch_size=batch_size,
+                             **(loader_kwargs or {}))
+    except BaseException:
+        source.stop()
+        source.join()
+        raise

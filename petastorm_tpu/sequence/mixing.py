@@ -1,0 +1,93 @@
+"""Deterministic multi-corpus mixture scheduling for token pipelines.
+
+Real LLM ingest mixes N corpora by weight, and that mixing is the least
+reproducible stage of the pipeline (the reproducible-pipelines paper,
+PAPERS.md) - a run is only replayable if *which corpus each batch came
+from* is as deterministic as each corpus's own stream.  This module layers
+the token-corpus entry point on the two pieces built for exactly that:
+
+* every corpus reader runs ``deterministic='seed'`` delivery with a
+  per-corpus seed derived from ONE mixture seed
+  (``seeding.derive_seed(seed, 0, 'sequence.corpus', i)``) - corpora never
+  share a permutation stream, yet the whole mixture is a pure function of
+  the single seed;
+* the draw sequence rides the mixer's certificate
+  (:attr:`~petastorm_tpu.weighted_sampling.WeightedSamplingReader.mixture_digest`),
+  so a mixed N-corpus run diffs in O(1) like a single-reader one - the
+  chaos matrix certifies the packed mixed stream bit-identical across
+  worker counts, executor flavors, chaos kills and the service hop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.seeding import derive_seed
+from petastorm_tpu.sequence.dataset import make_sequence_reader
+from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+
+def corpus_seed(seed: Optional[int], corpus_index: int) -> Optional[int]:
+    """The per-corpus shuffle seed a mixture derives from its one root seed
+    (``None`` stays ``None`` - unseeded corpora keep unseeded plans)."""
+    if seed is None:
+        return None
+    return derive_seed(seed, 0, "sequence.corpus", corpus_index)
+
+
+def make_mixed_sequence_reader(dataset_urls: Sequence[str],
+                               weights: Optional[Sequence[float]] = None,
+                               seed: Optional[int] = None,
+                               tokens_field: str = "tokens",
+                               **reader_kwargs) -> WeightedSamplingReader:
+    """Open N token corpora and mix them by weight, deterministically.
+
+    One ``seed`` drives everything: corpus ``i`` reads with
+    ``shuffle_seed=``:func:`corpus_seed`\\ ``(seed, i)`` (arming
+    ``deterministic='seed'`` delivery via the reader's ``'auto'``
+    resolution) and the mixer draws from
+    ``seed_stream(derive_seed(seed, 0, 'sequence.mixture'), ...)`` - so the
+    mixed document stream, and therefore the packed stream, is a pure
+    function of ``(seed, weights, corpora)``.  ``seed=None`` keeps every
+    stage unseeded (each run differs).
+
+    ``weights`` defaults to uniform.  All other kwargs go to every
+    corpus's :func:`~petastorm_tpu.sequence.dataset.make_sequence_reader`
+    verbatim (``workers_count``, ``predicate``, ``cache_type``,
+    ``service_address``, ...).  An explicit ``shuffle_seed`` kwarg is
+    refused: per-corpus seeds must differ or corpora would share one
+    permutation stream - pass ``seed=`` instead.
+
+    Returns the :class:`WeightedSamplingReader`; consume via
+    ``iter_batches()`` + :func:`~petastorm_tpu.sequence.dataset.iter_documents`
+    + the packer, or hand it to
+    :class:`~petastorm_tpu.sequence.loader.PackedSequenceReader`.
+    """
+    if not dataset_urls:
+        raise PetastormTpuError("dataset_urls must name at least one corpus")
+    if "shuffle_seed" in reader_kwargs:
+        raise PetastormTpuError(
+            "pass seed= to make_mixed_sequence_reader, not shuffle_seed=:"
+            " per-corpus seeds are derived from the one mixture seed"
+            " (corpora must not share a permutation stream)")
+    if weights is None:
+        weights = [1.0] * len(dataset_urls)
+    if len(weights) != len(dataset_urls):
+        raise PetastormTpuError(
+            f"{len(dataset_urls)} corpora but {len(weights)} weights")
+    readers = []
+    try:
+        for i, url in enumerate(dataset_urls):
+            readers.append(make_sequence_reader(
+                url, tokens_field=tokens_field,
+                shuffle_seed=corpus_seed(seed, i), **reader_kwargs))
+        mixer_seed = (derive_seed(seed, 0, "sequence.mixture")
+                      if seed is not None else None)
+        return WeightedSamplingReader(readers, weights, seed=mixer_seed)
+    except BaseException:
+        for r in readers:
+            r.stop()
+        for r in readers:
+            r.join()
+        raise

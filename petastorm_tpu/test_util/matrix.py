@@ -131,6 +131,28 @@ def cell_kwargs(cell: MatrixCell) -> dict:
     return kwargs
 
 
+def _cell_transport_kwargs(cell: MatrixCell,
+                           service_address: Optional[str]) -> dict:
+    """The cell's chaos + transport reader kwargs - shared by
+    :func:`run_cell` and :func:`run_sequence_cell` so a new cell knob
+    (or a new client-side-no-op to drop on the service plane) is handled
+    in ONE place."""
+    kwargs = cell_kwargs(cell)
+    if cell.transport == "service":
+        if service_address is None:
+            raise PetastormTpuError(
+                "transport='service' cells need a service_address")
+        kwargs["service_address"] = service_address
+        # liveness knobs are client-side no-ops on the service plane; the
+        # reader drops them with a warning - drop quietly here
+        kwargs.pop("item_deadline_s", None)
+        kwargs.pop("hedge_after_s", None)
+    else:
+        kwargs["reader_pool_type"] = cell.pool
+        kwargs["workers_count"] = cell.workers
+    return kwargs
+
+
 def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
              num_epochs: int = 2,
              service_address: Optional[str] = None,
@@ -148,19 +170,7 @@ def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
 
     kwargs = dict(shuffle_row_groups=True, shuffle_seed=seed,
                   deterministic="seed", num_epochs=num_epochs)
-    kwargs.update(cell_kwargs(cell))
-    if cell.transport == "service":
-        if service_address is None:
-            raise PetastormTpuError(
-                "transport='service' cells need a service_address")
-        kwargs["service_address"] = service_address
-        # liveness knobs are client-side no-ops on the service plane; the
-        # reader drops them with a warning - drop quietly here
-        kwargs.pop("item_deadline_s", None)
-        kwargs.pop("hedge_after_s", None)
-    else:
-        kwargs["reader_pool_type"] = cell.pool
-        kwargs["workers_count"] = cell.workers
+    kwargs.update(_cell_transport_kwargs(cell, service_address))
     kwargs.update(reader_kwargs or {})
 
     crc = 0
@@ -210,6 +220,75 @@ def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
 
     return CellResult(digest=resumed_digest, content_crc=crc,
                       batch_rows=tuple(batch_rows), rows=rows)
+
+
+# -- token-dataset cell family (sequence pipeline) ----------------------------
+
+@dataclasses.dataclass
+class SequenceCellResult:
+    """What one token cell's packed read delivered: the packed-stream
+    certificate + packing accounting (+ the mixture certificate for mixed
+    cells)."""
+
+    packed_crc: int     # crc chain over the packed block stream, in order
+    rows: int           # packed (seq_len,) rows emitted
+    tokens: int         # real tokens packed
+    fill_rate: float
+    mixture: Optional[dict]   # WeightedSamplingReader.mixture_digest or None
+
+
+def run_sequence_cell(dataset_urls, seed: int, cell: MatrixCell,
+                      seq_len: int = 128, rows_per_block: int = 4,
+                      num_epochs: int = 1,
+                      service_address: Optional[str] = None,
+                      weights=None,
+                      reader_kwargs: Optional[dict] = None
+                      ) -> SequenceCellResult:
+    """Run one token-pipeline cell: read (one corpus or an N-corpus seeded
+    mixture) under the cell's configuration, pack deterministically, and
+    return the packed-stream certificate.
+
+    The invariant under test (ISSUE 11): the PACKED stream - not just the
+    raw rowgroup stream - is bit-identical across worker counts, executor
+    flavors, chaos kills and the service hop, because the packer is a pure
+    function of the plan-ordered document stream.  ``split='quiesce'``
+    cells are not part of this family (the packer's open bins have no
+    mid-stream cursor).
+    """
+    from petastorm_tpu.sequence.dataset import (iter_documents,
+                                                make_sequence_reader)
+    from petastorm_tpu.sequence.mixing import make_mixed_sequence_reader
+    from petastorm_tpu.sequence.packing import (SequencePacker,
+                                                iter_packed_blocks,
+                                                packed_stream_digest)
+
+    if cell.split != "none":
+        raise PetastormTpuError(
+            "token cells do not support quiesce/resume splits (the packer"
+            " holds open bins a cursor cannot express)")
+    urls = ([dataset_urls] if isinstance(dataset_urls, str)
+            else list(dataset_urls))
+    kwargs = dict(shuffle_row_groups=True, num_epochs=num_epochs)
+    kwargs.update(_cell_transport_kwargs(cell, service_address))
+    kwargs.update(reader_kwargs or {})
+
+    if len(urls) == 1:
+        source = make_sequence_reader(urls[0], shuffle_seed=seed,
+                                      deterministic="seed", **kwargs)
+    else:
+        source = make_mixed_sequence_reader(urls, weights=weights, seed=seed,
+                                            **kwargs)
+    with source:
+        packer = SequencePacker(seq_len)
+        crc = packed_stream_digest(iter_packed_blocks(
+            iter_documents(source, "tokens"), seq_len, rows_per_block,
+            packer=packer))
+        stats = packer.stats()
+        mixture = (source.mixture_digest
+                   if hasattr(source, "mixture_digest") else None)
+    return SequenceCellResult(packed_crc=crc, rows=stats["rows"],
+                              tokens=stats["tokens"],
+                              fill_rate=stats["fill_rate"], mixture=mixture)
 
 
 # -- in-process / subprocess service fleets -----------------------------------

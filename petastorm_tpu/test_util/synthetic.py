@@ -115,6 +115,48 @@ def create_test_dataset(url: str,
     return rows
 
 
+def write_token_corpus(url: str, n_docs: int = 400,
+                       rows_per_rg: int = 32, vocab: int = 32000,
+                       mean_len: float = 48.0, min_len: int = 1,
+                       max_len: int = 512, seed: int = 0,
+                       label_field: Optional[str] = "lang",
+                       tokens_dtype=None, **write_kwargs) -> int:
+    """A north-star-shaped token corpus: ``doc_id`` + ``n_tokens`` scalars,
+    a ``tokens`` variable-length int32 column (lognormal document lengths -
+    the long-tail shape real corpora have), and an optional small-cardinality
+    ``label_field`` for predicate tests.  Shared by the chaos-matrix token
+    cells, the ci.sh sequence smoke and ``bench.py bench_sequence_packing``
+    so all three measure the same corpus shape.  Returns total tokens."""
+    import numpy as np
+
+    from petastorm_tpu.sequence.dataset import token_field
+
+    tokens_dtype = np.dtype(tokens_dtype or np.int32)
+    fields = [Field("doc_id", np.int64), Field("n_tokens", np.int32),
+              token_field("tokens", dtype=tokens_dtype)]
+    if label_field:
+        fields.append(Field(label_field, np.dtype("object")))
+    schema = Schema("TokenCorpus", fields)
+    rng = np.random.default_rng(seed)
+    sigma = 0.75
+    lengths = np.clip(rng.lognormal(np.log(mean_len) - sigma ** 2 / 2,
+                                    sigma, n_docs),
+                      min_len, max_len).astype(np.int64)
+    rows = []
+    total = 0
+    for i in range(n_docs):
+        n = int(lengths[i])
+        total += n
+        row = {"doc_id": i, "n_tokens": n,
+               "tokens": rng.integers(0, vocab, n, dtype=tokens_dtype)}
+        if label_field:
+            row[label_field] = f"l{int(rng.integers(0, 4))}"
+        rows.append(row)
+    write_dataset(url, schema, rows, row_group_size_rows=rows_per_rg,
+                  **write_kwargs)
+    return total
+
+
 def write_wide_dataset(url: str, n_cols: int = 8, n_rowgroups: int = 8,
                        rows_per_rg: int = 32, vec_len: int = 16,
                        seed: int = 0) -> None:

@@ -5,18 +5,32 @@ WeightedSamplingReader draws the next element from reader i with probability
 probabilities[i], with schema/ngram/batched compatibility checks
 (weighted_sampling_reader.py:26-92).
 
-Difference: the draw is seeded (reproducible mixing) and ``iter_batches`` mixing
-is supported for the columnar path.
+Differences: the draw is seeded (reproducible mixing), ``iter_batches``
+mixing is supported for the columnar path, and the mixer participates in
+the stream-certificate layer (docs/operations.md "Reproducibility"): every
+draw folds into an order-sensitive **mixture digest**, so a mixed N-corpus
+run diffs in O(1) exactly like a single-reader one - the draw sequence is
+certified alongside each sub-reader's own StreamDigest
+(:meth:`WeightedSamplingReader.diagnostics`).  Multi-corpus sampling is the
+least reproducible stage of real LLM ingest (the reproducible-pipelines
+paper, PAPERS.md); ``deterministic='auto'`` therefore derives a mixer seed
+from the first reader's seed root whenever every sub-reader already runs
+seed-stable delivery but the mixer itself was left unseeded.
 """
 
 from __future__ import annotations
 
+import logging
+import struct
+import zlib
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from petastorm_tpu.errors import PetastormTpuError
-from petastorm_tpu.seeding import seed_stream
+from petastorm_tpu.seeding import derive_seed, seed_stream
+
+logger = logging.getLogger(__name__)
 
 
 class WeightedSamplingReader:
@@ -24,17 +38,74 @@ class WeightedSamplingReader:
     row/batch from reader ``i`` with probability ``probabilities[i]``
     (normalized; seeded for reproducibility).  Schemas must agree on the
     delivered fields; exhausted readers drop out and the remaining weights
-    renormalize (reference weighted_sampling_reader semantics)."""
+    renormalize (reference weighted_sampling_reader semantics).
+
+    ``deterministic`` (the mixer-side analog of ``make_reader``'s knob):
+    under ``'auto'`` (default), when EVERY sub-reader runs
+    ``deterministic='seed'`` delivery but ``seed`` is None, an unseeded
+    mixer would be the one stage defeating stream reproducibility - so the
+    mixer seed is derived from the first reader's ``shuffle_seed``
+    (``seeding.derive_seed``, domain ``'weighted_sampling.auto'``), with
+    one warning naming the derivation.  ``'off'`` keeps ``seed=None``
+    unseeded (each run mixes differently) and warns once that the mix
+    defeats reproducibility when the sub-readers were all seeded.  An
+    explicit ``seed`` always wins and silences both.
+
+    Every draw (including the draws that discover an exhausted reader)
+    folds into the **mixture digest** - see :attr:`diagnostics`.
+    """
 
     def __init__(self, readers: Sequence, probabilities: Sequence[float],
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, deterministic: str = "auto"):
         if len(readers) != len(probabilities) or not readers:
             raise PetastormTpuError("readers and probabilities must be same non-zero length")
+        if deterministic not in ("auto", "off"):
+            raise PetastormTpuError(
+                f"deterministic must be 'auto' or 'off'; got"
+                f" {deterministic!r}")
         p = np.asarray(probabilities, dtype=np.float64)
         if (p < 0).any() or p.sum() <= 0:
             raise PetastormTpuError(f"Invalid probabilities {probabilities}")
         self._p = p / p.sum()
         self._readers = list(readers)
+        all_seeded = all(getattr(r, "deterministic", "off") == "seed"
+                         for r in self._readers)
+        if seed is None and all_seeded:
+            if deterministic == "auto":
+                # the sub-readers each deliver a seed-stable stream; an
+                # unseeded mixer would be the single stage making the MIXED
+                # stream irreproducible.  Derive the mixer seed from the
+                # first reader's seed root so the whole mix is a pure
+                # function of it (pass an explicit seed to pin, or
+                # deterministic='off' to keep unseeded mixing).
+                root = getattr(self._readers[0], "shuffle_seed", None)
+                seed = derive_seed(root, 0, "weighted_sampling.auto")
+                logger.warning(
+                    "WeightedSamplingReader: every sub-reader runs"
+                    " deterministic='seed' delivery but the mixer got"
+                    " seed=None, which would defeat stream reproducibility;"
+                    " deriving the mixer seed from the first reader's"
+                    " shuffle_seed (%r). Pass seed=... to pin it, or"
+                    " deterministic='off' to keep unseeded mixing.", root)
+            else:
+                logger.warning(
+                    "WeightedSamplingReader: every sub-reader runs"
+                    " deterministic='seed' delivery but the mix is unseeded"
+                    " (seed=None, deterministic='off') - the MIXED stream"
+                    " differs every run, defeating stream reproducibility."
+                    " Pass seed=... for a reproducible mixture.")
+        #: the resolved mixer seed (None = unseeded); diagnostics surface it
+        self.seed = seed
+        #: downstream-adapter surface, mirroring Reader's: delivery through
+        #: this mixer is seed-stable exactly when the mixer is seeded AND
+        #: every sub-reader runs seed-stable delivery.  ``shuffle_seed`` is
+        #: the seed ROOT adapters derive their buffer RNGs from
+        #: (seeding.reader_buffer_seed) - without these, a JaxDataLoader
+        #: over a fully-seeded mixture would silently fall back to
+        #: unseeded shuffle buffers
+        self.deterministic = ("seed" if seed is not None and all_seeded
+                              else "off")
+        self.shuffle_seed = seed if self.deterministic == "seed" else None
         # centralized derivation (petastorm_tpu.seeding): a seeded mix draws
         # a PYTHONHASHSEED-stable stream independent of every other seeded
         # stage; None keeps the unseeded each-run-differs behavior
@@ -43,6 +114,11 @@ class WeightedSamplingReader:
         # readers not yet exhausted by __next__; persists across calls so dead
         # readers are not re-drawn/re-polled on every remaining row
         self._alive: List[int] = list(range(len(self._readers)))
+        # mixture certificate: order-sensitive crc chain over the draw
+        # sequence (draw ordinal, chosen reader, exhaustion markers) - the
+        # certified record of WHICH corpus each delivered unit came from
+        self._draw_crc = 0
+        self._draw_count = 0
 
         first = readers[0]
         self.batched_output = first.batched_output
@@ -82,6 +158,51 @@ class WeightedSamplingReader:
         """True once every underlying reader finished its epochs."""
         return all(r.last_row_consumed for r in self._readers)
 
+    @property
+    def telemetry(self):
+        """The first sub-reader's recorder (downstream adapters - the jax
+        loader, the sequence packer - observe the mix through it)."""
+        return getattr(self._readers[0], "telemetry", None)
+
+    # -- mixture certificate (docs/operations.md "Reproducibility") ----------
+
+    def _record_draw(self, reader_index: int, exhausted: bool = False) -> None:
+        self._draw_crc = zlib.crc32(
+            struct.pack("<3q", self._draw_count, int(reader_index),
+                        1 if exhausted else 0), self._draw_crc)
+        self._draw_count += 1
+
+    @property
+    def mixture_digest(self) -> dict:
+        """The mixture-side stream certificate: the draw-sequence chain plus
+        a combined value folding every sub-reader's own StreamDigest - two
+        mixed runs are diffed in O(1) like single-reader ones.  ``combined``
+        is only configuration-stable when the mixer is seeded and every
+        sub-reader runs ``deterministic='seed'``."""
+        combined = self._draw_crc
+        readers = []
+        for r in self._readers:
+            sub = None
+            diag = getattr(r, "diagnostics", None)
+            if isinstance(diag, dict):
+                sub = (diag.get("stream_digest") or {}).get("combined")
+            readers.append(sub)
+            combined = zlib.crc32(
+                (sub or "-").encode("ascii", "replace"), combined)
+        return {"draws": f"{self._draw_crc:08x}",
+                "draw_count": self._draw_count,
+                "readers": readers,
+                "combined": f"{combined:08x}"}
+
+    @property
+    def diagnostics(self) -> dict:
+        """Mixer diagnostics: the mixture digest, resolved seed and
+        per-reader aliveness (sub-reader diagnostics stay on the readers)."""
+        return {"mixture_digest": self.mixture_digest,
+                "seed": self.seed,
+                "alive_readers": list(self._alive),
+                "num_readers": len(self._readers)}
+
     def __iter__(self):
         return self
 
@@ -96,22 +217,33 @@ class WeightedSamplingReader:
             weights = self._p[self._alive] / self._p[self._alive].sum()
             i = int(self._rng.choice(len(self._alive), p=weights))
             try:
-                return next(self._readers[self._alive[i]])
+                row = next(self._readers[self._alive[i]])
             except StopIteration:
+                self._record_draw(self._alive[i], exhausted=True)
                 self._alive.pop(i)
+            else:
+                self._record_draw(self._alive[i])
+                return row
         raise StopIteration
 
     def iter_batches(self):
-        """Columnar batches drawn from the mixed stream (device-feed path)."""
+        """Columnar batches drawn from the mixed stream (device-feed path).
+        Shares the aliveness ledger with ``__next__`` (one consumption mode
+        per instance), so ``diagnostics['alive_readers']`` stays truthful
+        for batch consumers too."""
         sources = [r.iter_batches() for r in self._readers]
-        alive = list(range(len(sources)))
+        alive = self._alive
         while alive:
             weights = self._p[alive] / self._p[alive].sum()
             i = int(self._rng.choice(len(alive), p=weights))
             try:
-                yield next(sources[alive[i]])
+                batch = next(sources[alive[i]])
             except StopIteration:
+                self._record_draw(alive[i], exhausted=True)
                 alive.pop(i)
+            else:
+                self._record_draw(alive[i])
+                yield batch
 
     def stop(self) -> None:
         """Stop every underlying reader."""

@@ -500,6 +500,15 @@ class RowGroupDecoderWorker:
         pred_batch = self._load(parquet_file, item, pred_fields, row_range=row_range)
         mask = np.asarray(self._predicate.do_include_vectorized(pred_batch.columns),
                           dtype=bool)
+        tele = self._telemetry
+        if tele is not None and tele.enabled:
+            # the observable proof of worker-side predicate pushdown: rows
+            # masked HERE never reach phase 2, so they cost no payload
+            # decode/transform - sequence.rows_filtered counts the drops and
+            # worker.rows_decoded counts only the survivors (docs/
+            # operations.md "Token pipelines")
+            tele.counter("sequence.rows_filtered").add(
+                int(mask.size - mask.sum()))
         if not mask.any():
             return self._empty_batch()
         # phase 2: remaining columns, arrow-filtered by the mask BEFORE decode

@@ -19,7 +19,8 @@ from petastorm_tpu.etl.writer import write_dataset
 from petastorm_tpu.reader import make_batch_reader
 from petastorm_tpu.schema import Field, Schema
 from petastorm_tpu.seeding import StreamDigest, derive_seed, seed_stream
-from petastorm_tpu.test_util.matrix import (CellResult, MatrixCell, run_cell,
+from petastorm_tpu.test_util.matrix import (CellResult, MatrixCell,
+                                            run_cell, run_sequence_cell,
                                             service_fleet)
 
 SEED = 7
@@ -151,6 +152,111 @@ def test_service_sigkill_quiesce_resume_digest(matrix_dataset, baseline):
     assert resumed["combined"] == baseline.digest["combined"], \
         (resumed, baseline.digest)
     assert resumed["rows"] == baseline.rows
+
+
+# -- token-dataset cell family (ISSUE 11: the packed stream is certified) -----
+
+@pytest.fixture(scope="module")
+def token_corpora(tmp_path_factory):
+    """Two small token corpora (lognormal doc lengths, 8 rowgroups each):
+    enough items for real out-of-order completion and the (2, 7) kill
+    ordinals, cheap enough for many cells."""
+    from petastorm_tpu.test_util.synthetic import write_token_corpus
+
+    base = tmp_path_factory.mktemp("det_tokens")
+    urls = []
+    for i in range(2):
+        url = str(base / f"c{i}")
+        write_token_corpus(url, n_docs=80, rows_per_rg=10, mean_len=24,
+                           max_len=100, seed=40 + i)
+        urls.append(url)
+    return urls
+
+
+@pytest.fixture(scope="module")
+def token_baseline(token_corpora):
+    """Reference packed stream: 2-corpus seeded mixture, 2 thread workers,
+    no chaos."""
+    return run_sequence_cell(token_corpora, SEED,
+                             MatrixCell(workers=2, pool="thread"),
+                             num_epochs=EPOCHS)
+
+
+def _assert_sequence_matches(result, base, label: str) -> None:
+    assert result.tokens == base.tokens, label
+    assert result.rows == base.rows, f"{label}: packed row counts differ"
+    assert result.packed_crc == base.packed_crc, \
+        f"{label}: packed stream differs"
+    assert result.mixture == base.mixture, \
+        f"{label}: mixture certificate differs"
+
+
+TOKEN_CELLS = [
+    MatrixCell(workers=1, pool="thread"),
+    MatrixCell(workers=4, pool="thread"),
+    MatrixCell(workers=2, pool="serial"),
+    MatrixCell(workers=3, pool="thread", chaos="kill"),
+]
+
+
+@pytest.mark.parametrize("cell", TOKEN_CELLS, ids=lambda c: c.label())
+def test_token_cells_bit_identical(token_corpora, token_baseline, cell):
+    """The PACKED 2-corpus mixture stream - tokens, segment boundaries,
+    masks AND the mixture draw certificate - is bit-identical across
+    worker counts, executor flavors and chaos kills."""
+    result = run_sequence_cell(token_corpora, SEED, cell, num_epochs=EPOCHS)
+    _assert_sequence_matches(result, token_baseline, cell.label())
+
+
+@pytest.mark.slow
+def test_token_process_cell_bit_identical(token_corpora, token_baseline):
+    """Real spawned worker processes deliver the same packed stream (the
+    variable-length token columns cross the process transport)."""
+    result = run_sequence_cell(token_corpora, SEED,
+                               MatrixCell(workers=2, pool="process"),
+                               num_epochs=EPOCHS)
+    _assert_sequence_matches(result, token_baseline, "2w-process-tokens")
+
+
+def test_token_service_cell_bit_identical(token_corpora, token_baseline):
+    """The service hop delivers the identical packed mixture stream (both
+    corpus readers consume through one dispatcher + fleet)."""
+    with service_fleet(n_workers=2) as (_disp, addr, _workers):
+        result = run_sequence_cell(token_corpora, SEED,
+                                   MatrixCell(transport="service"),
+                                   num_epochs=EPOCHS, service_address=addr)
+    _assert_sequence_matches(result, token_baseline, "service-tokens")
+
+
+def test_token_different_seed_differs(token_corpora, token_baseline):
+    """Seed sensitivity: a different mixture seed changes corpus plans AND
+    the draw sequence - both certificates must move."""
+    other = run_sequence_cell(token_corpora, SEED + 1, MatrixCell(),
+                              num_epochs=EPOCHS)
+    assert other.tokens == token_baseline.tokens  # same corpus, same mass
+    assert other.packed_crc != token_baseline.packed_crc
+    assert other.mixture["combined"] != token_baseline.mixture["combined"]
+    assert other.mixture["draws"] != token_baseline.mixture["draws"]
+
+
+def test_token_single_corpus_cells(token_corpora):
+    """The single-corpus (no mixer) packed stream is equally seed-stable."""
+    base = run_sequence_cell(token_corpora[0], SEED, MatrixCell(workers=2),
+                             num_epochs=1)
+    assert base.mixture is None
+    kill = run_sequence_cell(token_corpora[0], SEED,
+                             MatrixCell(workers=4, chaos="kill"),
+                             num_epochs=1)
+    assert kill.packed_crc == base.packed_crc
+    assert base.fill_rate > 0.8  # lognormal corpus packs densely
+
+
+def test_token_cell_refuses_quiesce_split(token_corpora):
+    from petastorm_tpu.errors import PetastormTpuError
+
+    with pytest.raises(PetastormTpuError, match="quiesce"):
+        run_sequence_cell(token_corpora, SEED,
+                          MatrixCell(split="quiesce"))
 
 
 # -- seed sensitivity ---------------------------------------------------------

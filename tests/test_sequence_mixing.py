@@ -1,0 +1,215 @@
+"""Deterministic multi-corpus mixture scheduling (ISSUE 11 tentpole c +
+satellite): one seed drives corpus plans + mixture draws, the draw sequence
+rides the mixture certificate, and an unseeded mixer over seeded
+sub-readers warns + auto-derives under deterministic='auto'."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.seeding import derive_seed
+from petastorm_tpu.sequence import (corpus_seed, iter_documents,
+                                    make_mixed_sequence_reader,
+                                    make_sequence_reader)
+from petastorm_tpu.test_util.synthetic import write_token_corpus
+from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+
+@pytest.fixture(scope="module")
+def corpora(tmp_path_factory):
+    base = tmp_path_factory.mktemp("mix_corpora")
+    urls = []
+    for i in range(2):
+        url = str(base / f"c{i}")
+        write_token_corpus(url, n_docs=60, rows_per_rg=10, mean_len=12,
+                           max_len=40, seed=30 + i)
+        urls.append(url)
+    return urls
+
+
+def _doc_stream(urls, seed, weights=None, **kwargs):
+    with make_mixed_sequence_reader(urls, weights=weights,
+                                    seed=seed, **kwargs) as mixer:
+        docs = [d.tolist() for d in iter_documents(mixer, "tokens")]
+        digest = mixer.mixture_digest
+        diag = mixer.diagnostics
+    return docs, digest, diag
+
+
+def test_mixture_pure_function_of_seed(corpora):
+    a_docs, a_dig, a_diag = _doc_stream(corpora, seed=7)
+    b_docs, b_dig, _ = _doc_stream(corpora, seed=7)
+    assert a_docs == b_docs
+    assert a_dig == b_dig
+    assert a_diag["seed"] is not None
+    c_docs, c_dig, _ = _doc_stream(corpora, seed=8)
+    assert c_docs != a_docs
+    assert c_dig["combined"] != a_dig["combined"]
+    assert c_dig["draws"] != a_dig["draws"]
+
+
+def test_mixer_exposes_adapter_surface(corpora):
+    """Downstream adapters (the jax loader's buffer seeding, the packer's
+    telemetry) read deterministic/shuffle_seed/telemetry off their source:
+    a fully-seeded mixture must expose them, or buffer RNGs silently fall
+    back to unseeded."""
+    from petastorm_tpu.seeding import reader_buffer_seed
+    from petastorm_tpu.telemetry import Telemetry
+
+    tele = Telemetry()
+    with make_mixed_sequence_reader(corpora, seed=7,
+                                    telemetry=tele) as mixer:
+        assert mixer.deterministic == "seed"
+        assert mixer.shuffle_seed == mixer.seed is not None
+        assert mixer.telemetry is tele
+        # the exact call the JaxDataLoader makes: must derive, not None
+        assert reader_buffer_seed(mixer, "loader.shuffle_buffer") is not None
+        list(mixer.iter_batches())
+    # unseeded mixture: adapters must see 'off'/None
+    with make_mixed_sequence_reader(corpora) as mixer:
+        assert mixer.deterministic == "off"
+        assert mixer.shuffle_seed is None
+        assert reader_buffer_seed(mixer, "loader.shuffle_buffer") is None
+        list(mixer.iter_batches())
+
+
+def test_mixture_digest_is_o1_certificate(corpora):
+    """The combined value folds the draw chain + every sub-reader's own
+    StreamDigest: two runs are compared by ONE hex value each."""
+    _, dig, _ = _doc_stream(corpora, seed=7)
+    assert set(dig) == {"draws", "draw_count", "readers", "combined"}
+    assert len(dig["readers"]) == 2
+    assert all(isinstance(r, str) for r in dig["readers"])
+    assert dig["draw_count"] > 0
+
+
+def test_corpus_seeds_differ_per_corpus():
+    assert corpus_seed(None, 0) is None
+    assert corpus_seed(7, 0) != corpus_seed(7, 1)
+    assert corpus_seed(7, 0) == derive_seed(7, 0, "sequence.corpus", 0)
+
+
+def test_weights_skew_mixture(corpora):
+    """A heavily skewed weight draws mostly from that corpus early on (the
+    schedule is a property of the weights, not just the seed)."""
+    docs_even, _, _ = _doc_stream(corpora, seed=3)
+    docs_skew, _, _ = _doc_stream(corpora, seed=3, weights=[0.95, 0.05])
+    assert docs_even != docs_skew
+    # exhaustion renormalizes: every document still arrives exactly once
+    with make_sequence_reader(corpora[0], shuffle_seed=1) as r0, \
+            make_sequence_reader(corpora[1], shuffle_seed=2) as r1:
+        total = (sum(1 for _ in iter_documents(r0, "tokens"))
+                 + sum(1 for _ in iter_documents(r1, "tokens")))
+    assert len(docs_skew) == total == len(docs_even)
+
+
+def test_mixture_rejects_explicit_shuffle_seed(corpora):
+    with pytest.raises(PetastormTpuError, match="not shuffle_seed"):
+        make_mixed_sequence_reader(corpora, seed=1, shuffle_seed=2)
+
+
+def test_mixture_weight_count_mismatch(corpora):
+    with pytest.raises(PetastormTpuError, match="weights"):
+        make_mixed_sequence_reader(corpora, weights=[1.0], seed=1)
+    with pytest.raises(PetastormTpuError, match="at least one corpus"):
+        make_mixed_sequence_reader([], seed=1)
+
+
+# -- satellite: WeightedSamplingReader auto-seed ------------------------------
+
+def test_unseeded_mixer_over_seeded_readers_warns_and_derives(
+        corpora, caplog):
+    """All sub-readers seed-deterministic + mixer seed=None: one warning,
+    and under deterministic='auto' the mixer seed derives from the first
+    reader's shuffle_seed - so two such constructions mix identically."""
+    def build():
+        readers = [make_sequence_reader(u, shuffle_seed=40 + i,
+                                        deterministic="seed")
+                   for i, u in enumerate(corpora)]
+        return WeightedSamplingReader(readers, [0.5, 0.5])
+
+    with caplog.at_level(logging.WARNING,
+                         logger="petastorm_tpu.weighted_sampling"):
+        with build() as a:
+            warnings = [r for r in caplog.records
+                        if "defeat stream reproducibility" in r.message]
+            assert len(warnings) == 1
+            assert a.seed == derive_seed(40, 0, "weighted_sampling.auto")
+            a_ids = [int(x) for b in a.iter_batches()
+                     for x in b.columns["doc_id"]]
+            a_dig = a.mixture_digest
+    with build() as b:
+        b_ids = [int(x) for b2 in b.iter_batches()
+                 for x in b2.columns["doc_id"]]
+        b_dig = b.mixture_digest
+    assert a_ids == b_ids
+    assert a_dig == b_dig
+
+
+def test_unseeded_mixer_deterministic_off_warns_but_stays_unseeded(
+        corpora, caplog):
+    readers = [make_sequence_reader(u, shuffle_seed=50 + i,
+                                    deterministic="seed")
+               for i, u in enumerate(corpora)]
+    with caplog.at_level(logging.WARNING,
+                         logger="petastorm_tpu.weighted_sampling"):
+        with WeightedSamplingReader(readers, [0.5, 0.5],
+                                    deterministic="off") as mixer:
+            assert mixer.seed is None
+            assert any("defeating stream reproducibility" in r.message
+                       for r in caplog.records)
+            list(mixer.iter_batches())
+
+
+def test_explicit_seed_silences_warning(corpora, caplog):
+    readers = [make_sequence_reader(u, shuffle_seed=60 + i,
+                                    deterministic="seed")
+               for i, u in enumerate(corpora)]
+    with caplog.at_level(logging.WARNING,
+                         logger="petastorm_tpu.weighted_sampling"):
+        with WeightedSamplingReader(readers, [0.5, 0.5], seed=123) as mixer:
+            assert mixer.seed == 123
+            assert not caplog.records
+            list(mixer.iter_batches())
+
+
+def test_unseeded_readers_no_warning(corpora, caplog):
+    """Unseeded sub-readers never warn: there is no reproducibility to
+    defeat (and no root to derive from)."""
+    readers = [make_sequence_reader(u) for u in corpora]
+    with caplog.at_level(logging.WARNING,
+                         logger="petastorm_tpu.weighted_sampling"):
+        with WeightedSamplingReader(readers, [0.5, 0.5]) as mixer:
+            assert mixer.seed is None
+            assert not caplog.records
+            list(mixer.iter_batches())
+
+
+def test_mixer_rejects_bad_deterministic(corpora):
+    readers = [make_sequence_reader(u) for u in corpora]
+    try:
+        with pytest.raises(PetastormTpuError, match="deterministic"):
+            WeightedSamplingReader(readers, [0.5, 0.5],
+                                   deterministic="seed")
+    finally:
+        for r in readers:
+            r.stop()
+        for r in readers:
+            r.join()
+
+
+def test_next_path_mixture_records_draws_and_exhaustion(corpora):
+    """``__next__`` mixing folds draws (and exhaustion markers) too, and
+    every document still arrives exactly once."""
+    readers = [make_sequence_reader(u, shuffle_seed=70 + i,
+                                    deterministic="seed")
+               for i, u in enumerate(corpora)]
+    with WeightedSamplingReader(readers, [0.5, 0.5], seed=5) as mixer:
+        delivered = list(mixer)  # batched readers: one namedtuple per batch
+        dig = mixer.mixture_digest
+    ids = sorted(int(x) for nt in delivered for x in np.asarray(nt.doc_id))
+    assert ids == sorted(list(range(60)) + list(range(60)))
+    # draw_count = delivered batches + the two exhaustion discoveries
+    assert dig["draw_count"] == len(delivered) + 2

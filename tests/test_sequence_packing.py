@@ -1,0 +1,217 @@
+"""Sequence packer unit behavior (ISSUE 11 tentpole b): deterministic
+first-fit-shrinking packing into (batch, seq_len) blocks with document
+segment IDs / positions / loss masks, ragged delivery, and the
+packed-stream digest."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.sequence.packing import (SequencePacker,
+                                            iter_packed_blocks,
+                                            iter_packed_rows,
+                                            iter_ragged_batches,
+                                            packed_stream_digest)
+
+
+def _docs(*lengths, base=100):
+    return [np.full(n, base + i, dtype=np.int32)
+            for i, n in enumerate(lengths)]
+
+
+def test_masks_segments_positions():
+    rows = list(iter_packed_rows(_docs(3, 4, 9), seq_len=8))
+    # docs of 3 and 4 share bin 0; the 9-token doc doesn't fit and opens
+    # bin 1 ... wait, 9 > 8 so it splits into 8 + 1; the 8-chunk fills a
+    # fresh bin (emitted), the 1-chunk joins bin 0 (3+4+1=8, emitted full)
+    assert len(rows) == 2
+    by_first = sorted(rows, key=lambda r: int(r["tokens"][0]))
+    mixed = by_first[0]
+    assert mixed["tokens"].tolist() == [100] * 3 + [101] * 4 + [102]
+    assert mixed["segment_ids"].tolist() == [1] * 3 + [2] * 4 + [3]
+    assert mixed["positions"].tolist() == [0, 1, 2, 0, 1, 2, 3, 0]
+    assert mixed["loss_mask"].tolist() == [1.0] * 8
+    full = by_first[1]
+    assert full["tokens"].tolist() == [102] * 8
+    assert full["segment_ids"].tolist() == [1] * 8
+
+
+def test_padding_and_fill_rate():
+    p = SequencePacker(10)
+    rows = list(iter_packed_rows(_docs(6, 6), 10, packer=p))
+    assert len(rows) == 2
+    for r in rows:
+        assert r["tokens"].tolist()[6:] == [0] * 4
+        assert r["segment_ids"].tolist()[6:] == [0] * 4
+        assert r["loss_mask"].tolist() == [1.0] * 6 + [0.0] * 4
+    stats = p.stats()
+    assert stats["rows"] == 2 and stats["tokens"] == 12
+    assert stats["fill_rate"] == pytest.approx(0.6)
+
+
+def test_exact_token_multiset_preserved():
+    rng = np.random.default_rng(3)
+    docs = [rng.integers(0, 1000, int(n), dtype=np.int32)
+            for n in rng.integers(1, 50, 200)]
+    rows = list(iter_packed_rows(iter(docs), seq_len=64))
+    packed = np.concatenate([r["tokens"][r["loss_mask"] > 0] for r in rows])
+    # per-row tokens stay in segment order; the multiset must be exact
+    assert sorted(packed.tolist()) == sorted(
+        np.concatenate(docs).tolist())
+    # no doc straddles rows except via the long-doc split (none here)
+    for r in rows:
+        segs = r["segment_ids"][r["loss_mask"] > 0]
+        assert (np.diff(segs) >= 0).all()
+
+
+def test_deterministic_pure_function_of_order():
+    rng = np.random.default_rng(5)
+    docs = [rng.integers(0, 99, int(n), dtype=np.int32)
+            for n in rng.integers(1, 40, 150)]
+    a = list(iter_packed_blocks(iter(docs), 32, 4))
+    b = list(iter_packed_blocks(iter(docs), 32, 4))
+    assert packed_stream_digest(a) == packed_stream_digest(b)
+    # a different ORDER is a different packed stream (order sensitivity of
+    # both the packer and the digest)
+    c = list(iter_packed_blocks(iter(docs[::-1]), 32, 4))
+    assert packed_stream_digest(a) != packed_stream_digest(c)
+
+
+def test_long_doc_policies():
+    rows = list(iter_packed_rows(_docs(20), 8, long_docs="split"))
+    assert len(rows) == 3  # 8 + 8 + 4
+    assert sum(int(r["loss_mask"].sum()) for r in rows) == 20
+    # each split chunk restarts positions (its own segment)
+    assert rows[2]["positions"][:4].tolist() == [0, 1, 2, 3]
+
+    p = SequencePacker(8, long_docs="truncate")
+    rows = list(iter_packed_rows(_docs(20), 8, packer=p))
+    assert len(rows) == 1 and int(rows[0]["loss_mask"].sum()) == 8
+    assert p.stats()["docs_truncated"] == 1
+    assert p.stats()["tokens"] == 8  # truncated tokens don't count
+
+    with pytest.raises(PetastormTpuError, match="long_docs='error'"):
+        list(iter_packed_rows(_docs(20), 8, long_docs="error"))
+
+
+def test_empty_and_none_docs_skipped():
+    p = SequencePacker(8)
+    assert p.feed(None) == [] and p.feed(np.empty(0, np.int32)) == []
+    assert p.feed(np.asarray([1, 2], np.int32)) == []
+    rows = p.finish()
+    assert len(rows) == 1
+    assert p.stats()["docs_empty"] == 2 and p.stats()["docs"] == 1
+
+
+def test_eviction_closes_most_shrunk_bin():
+    p = SequencePacker(10, open_bins=2)
+    assert p.feed(np.full(7, 1, np.int32)) == []   # bin A: used 7
+    assert p.feed(np.full(5, 2, np.int32)) == []   # bin B: used 5
+    # 6 fits neither; open set full -> bin A (least remaining) is evicted
+    out = p.feed(np.full(6, 3, np.int32))
+    assert len(out) == 1 and out[0]["tokens"][:7].tolist() == [1] * 7
+    # finish: B then the fresh bin, in creation order
+    tail = p.finish()
+    assert [int(r["tokens"][0]) for r in tail] == [2, 3]
+
+
+def test_packer_reuse_across_calls_with_finish_false():
+    """finish=False keeps one packer (and its accounting) live across
+    several iter_packed_rows calls; the last call closes the bins."""
+    p = SequencePacker(8)
+    first = list(iter_packed_rows(_docs(6), 8, packer=p, finish=False))
+    assert first == []  # the 6-token doc sits in an open bin
+    rows = list(iter_packed_rows(iter(_docs(2, 8, base=200)), 8, packer=p))
+    assert p.stats()["docs"] == 3 and p.stats()["tokens"] == 16
+    # the 2-token doc joined the first call's open bin
+    joined = [r for r in rows if r["segment_ids"].max() == 2]
+    assert len(joined) == 1 and joined[0]["tokens"][:6].tolist() == [100] * 6
+
+
+def test_truncate_telemetry_counter_is_monotonic():
+    """long_docs='truncate' must never add a negative correction to the
+    monotonic tokens counter: only the kept length is counted."""
+    from petastorm_tpu.telemetry import Telemetry
+
+    tele = Telemetry()
+    p = SequencePacker(8, long_docs="truncate", telemetry=tele)
+    list(iter_packed_rows(_docs(20), 8, packer=p))
+    assert tele.snapshot()["counters"]["sequence.tokens_packed"] == 8
+
+
+def test_feed_after_finish_refused():
+    p = SequencePacker(8)
+    p.finish()
+    with pytest.raises(PetastormTpuError, match="after finish"):
+        p.feed(np.asarray([1], np.int32))
+
+
+def test_blocks_shape_and_drop_last():
+    docs = _docs(*[8] * 10)  # 10 full rows at seq_len 8
+    blocks = list(iter_packed_blocks(iter(docs), 8, 4))
+    assert [b["tokens"].shape for b in blocks] == [(4, 8), (4, 8), (2, 8)]
+    blocks = list(iter_packed_blocks(iter(docs), 8, 4, drop_last=True))
+    assert [b["tokens"].shape for b in blocks] == [(4, 8), (4, 8)]
+    for b in blocks:
+        assert set(b) == {"tokens", "segment_ids", "positions", "loss_mask"}
+
+
+def test_ragged_batches():
+    docs = [np.asarray([1, 2, 3], np.int64), None,
+            np.asarray([4], np.int64), np.asarray([5, 6], np.int64),
+            np.asarray([7], np.int64)]
+    groups = list(iter_ragged_batches(iter(docs), 3))
+    assert len(groups) == 2
+    g = groups[0]
+    assert g["tokens"].dtype == np.int32
+    assert g["offsets"].tolist() == [0, 3, 3, 4]  # None -> zero-length span
+    assert g["lengths"].tolist() == [3, 0, 1]
+    assert g["tokens"].tolist() == [1, 2, 3, 4]
+    assert groups[1]["lengths"].tolist() == [2, 1]
+    # document i is tokens[offsets[i]:offsets[i+1]]
+    assert g["tokens"][g["offsets"][0]:g["offsets"][1]].tolist() == [1, 2, 3]
+
+
+def test_digest_chains_and_is_content_sensitive():
+    blocks = list(iter_packed_blocks(iter(_docs(5, 5, 5, 5)), 8, 2))
+    whole = packed_stream_digest(blocks)
+    # chaining one block at a time equals one call over the stream
+    crc = 0
+    for b in blocks:
+        crc = packed_stream_digest([b], crc=crc)
+    assert crc == whole
+    mutated = [dict(b) for b in blocks]
+    mutated[0] = dict(mutated[0], tokens=mutated[0]["tokens"] + 1)
+    assert packed_stream_digest(mutated) != whole
+
+
+def test_packer_telemetry_series():
+    from petastorm_tpu.telemetry import Telemetry
+
+    tele = Telemetry()
+    p = SequencePacker(8, telemetry=tele)
+    list(iter_packed_rows(_docs(6, 6, 20), 8, packer=p))
+    snap = tele.snapshot()
+    assert snap["counters"]["sequence.docs_packed"] == 3
+    assert snap["counters"]["sequence.tokens_packed"] == 32
+    assert snap["counters"]["sequence.docs_split"] == 1
+    assert snap["counters"]["sequence.rows_emitted"] == p.stats()["rows"]
+    assert snap["counters"]["sequence.pad_tokens"] == \
+        p.stats()["rows"] * 8 - 32
+    assert snap["gauges"]["sequence.fill_rate"] == pytest.approx(
+        p.fill_rate)
+
+
+def test_invalid_args():
+    with pytest.raises(PetastormTpuError):
+        SequencePacker(0)
+    with pytest.raises(PetastormTpuError):
+        SequencePacker(8, open_bins=0)
+    with pytest.raises(PetastormTpuError):
+        SequencePacker(8, long_docs="explode")
+    with pytest.raises(PetastormTpuError):
+        list(iter_packed_blocks(iter([]), 8, 0))
+    with pytest.raises(PetastormTpuError):
+        list(iter_ragged_batches(iter([]), 0))
+    with pytest.raises(PetastormTpuError, match="1-D"):
+        SequencePacker(8).feed(np.zeros((2, 2), np.int32))

@@ -52,6 +52,16 @@ stage against completion-order delivery, same-session anchored.  It also
 carries an ABSOLUTE floor (see ``ABSOLUTE_FLOORS``): any candidate below
 0.85x fails an armed gate even if the baseline file was already below it -
 the ISSUE 10 acceptance bar is absolute, not relative.
+
+Sequence metrics (BENCH_r10+, docs/operations.md "Token pipelines"):
+``sequence_packed_vs_padded_ratio`` prices packed ``(batch, seq_len)``
+delivery against the naive pad-to-max baseline under a fixed simulated
+step per block - SAME-SESSION anchored (drift-immune), absolute floor
+1.5x.  ``sequence_packing_fill_rate`` (real tokens / emitted slots) is a
+pure property of the packer + corpus shape and carries the 0.85 absolute
+floor from the ISSUE 11 acceptance bar; the two absolute-rate members
+(``sequence_packed_tokens_per_sec`` / ``..._padded_anchor_...``) drift
+with the host like any rate.
 """
 
 from __future__ import annotations
@@ -72,6 +82,10 @@ LOWER_IS_BETTER_MARKERS = ("idle_pct", "stall_pct", "latency",
 ABSOLUTE_FLOORS = {
     # ISSUE 10: deterministic-mode throughput >= 0.85x completion-order
     "determinism_vs_off_ratio": 0.85,
+    # ISSUE 11: packed delivery >= 1.5x the pad-to-max baseline, and the
+    # packer must fill >= 85% of emitted (batch, seq_len) slots
+    "sequence_packed_vs_padded_ratio": 1.5,
+    "sequence_packing_fill_rate": 0.85,
 }
 
 
