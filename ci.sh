@@ -324,7 +324,10 @@ echo "== service smoke (disaggregated ingest: dispatcher + fleet + 2 clients, on
 # while it holds in-flight work.  Both clients must deliver their exact row
 # multiset and the dispatcher's service.requeued_items must account for the
 # kill - the disaggregated-ingest contract of ISSUE 9 (docs/operations.md
-# "Disaggregated ingest service").
+# "Disaggregated ingest service").  The dispatcher's wire-mix counters
+# (scraped off the stats frame) must show the result data path ran
+# PICKLE-FREE: every delivered batch a binary frame, zero pickle fallbacks
+# - the ISSUE 12 contract.
 SVC_SMOKE="$(mktemp /tmp/petastorm_tpu_service_smoke_XXXXXX.py)"
 cat > "$SVC_SMOKE" <<'PY'
 import os
@@ -399,8 +402,15 @@ if __name__ == "__main__":
         s = stats(addr)
         requeued = s["counters"].get("service.requeued_items", 0)
         assert requeued >= 1, s["counters"]
+        # the v2 wire contract: the result data path ran pickle-free (2
+        # clients x 40 rowgroups = 80 delivered batches, all binary frames)
+        binary = s["counters"].get("service.frames_binary", 0)
+        fallback = s["counters"].get("service.frames_pickle_fallback", 0)
+        assert binary >= 80, s["counters"]
+        assert fallback == 0, s["counters"]
         print("service smoke OK (2 clients exact under a worker SIGKILL,"
-              f" {int(requeued)} item(s) requeued, fleet="
+              f" {int(requeued)} item(s) requeued, {int(binary)} binary"
+              f" frames / {int(fallback)} pickle fallbacks, fleet="
               f"{sorted(s['workers'])})")
     finally:
         for p in procs:

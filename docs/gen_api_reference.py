@@ -84,7 +84,10 @@ API = [
                                       "ServiceConnectionError"]),
     ("petastorm_tpu.service.protocol", ["FrameSocket", "connect_frames",
                                         "parse_address", "encode_result",
-                                        "PayloadDecoder"]),
+                                        "PayloadDecoder", "WireItem"]),
+    ("petastorm_tpu.service.wire", ["dumps", "loads", "encode_batch_parts",
+                                    "decode_batch_body", "negotiate_codec",
+                                    "WireFormatError"]),
     ("petastorm_tpu.errors", None),
     ("petastorm_tpu.ops.normalize", ["normalize_images"]),
     ("petastorm_tpu.ops.augment", ["random_crop", "random_flip",

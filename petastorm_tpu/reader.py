@@ -1972,9 +1972,15 @@ class Reader:
         # decode (missing .so) must be visible here, not just in one log line
         from petastorm_tpu.native import image as _native_image
         from petastorm_tpu.native import is_available as _shm_available
+        from petastorm_tpu.native import \
+            transport_availability as _shm_availability
 
         diag["native"] = {"image_decode": _native_image.available(),
                           "shm_arena": _shm_available(),
+                          # WHY the zero-copy plane is (un)available - a
+                          # dark shm fast path (py<3.12, missing .so) must
+                          # be readable here, not inferred from a slow bench
+                          "shm_transport": _shm_availability(),
                           "build_command": _native_image.BUILD_COMMAND}
         if self._decode_split_cell is not None:
             diag["decode_split"] = self.decode_split

@@ -10,11 +10,13 @@ Topology and sizing guidance: docs/operations.md "Disaggregated ingest
 service".  Trainers connect with ``make_reader(...,
 service_address='HOST:7737')``.
 
-The dispatcher binds loopback by default: the wire protocol is pickled
-frames and workers execute client-shipped code, so exposing the port IS
-exposing remote code execution.  Bind other interfaces only on trusted
-networks, with a shared handshake secret (``$PETASTORM_TPU_SERVICE_TOKEN``
-or ``--auth-token-file``) set on every party.
+The dispatcher binds loopback by default.  The v2 wire is pickle-free
+binary frames (parsing service bytes can no longer execute code), but the
+service's *job* is running client-shipped worker factories on the fleet -
+so the handshake secret (``$PETASTORM_TPU_SERVICE_TOKEN`` or
+``--auth-token-file``) decides who may ship code to workers.  Bind
+non-loopback interfaces only on trusted networks, with the token set on
+every party.
 """
 
 from __future__ import annotations
@@ -28,12 +30,13 @@ from typing import List, Optional
 
 
 _TRUST_WARNING = (
-    "SECURITY: the wire protocol is pickled python frames and workers"
-    " execute client-supplied code - anyone who can reach the dispatcher"
-    " port can run arbitrary code on every fleet member and client.  Only"
-    " expose it on trusted networks, and set a shared secret via"
-    " $PETASTORM_TPU_SERVICE_TOKEN or --auth-token-file (all parties must"
-    " agree).  See docs/operations.md 'Disaggregated ingest service'.")
+    "SECURITY: the v2 wire is pickle-free binary frames (merely reaching"
+    " the port no longer yields code execution), but workers execute the"
+    " worker factory each REGISTERED client ships - that is the service's"
+    " job.  Set a shared secret via $PETASTORM_TPU_SERVICE_TOKEN or"
+    " --auth-token-file (all parties must agree) to decide who may"
+    " register, and expose non-loopback interfaces only on trusted"
+    " networks.  See docs/operations.md 'Disaggregated ingest service'.")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -76,6 +79,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="file holding the shared handshake secret every"
                    " hello must present (overrides"
                    " $PETASTORM_TPU_SERVICE_TOKEN)")
+    d.add_argument("--compression", default=None,
+                   choices=["auto", "off", "zlib"],
+                   help="result-batch body compression, negotiated per"
+                   " (worker, client) pair: 'auto' (default) compresses"
+                   " cross-host hops only, 'off' never, 'zlib' wherever"
+                   " both ends support it (defaults to"
+                   " $PETASTORM_TPU_SERVICE_COMPRESSION)")
 
     w = sub.add_parser("worker", help="run one fleet worker",
                        epilog=_TRUST_WARNING)
@@ -131,7 +141,8 @@ def _run_dispatcher(args) -> int:
                               else DEFAULT_REQUEUE_ATTEMPTS),
         assignment_deadline_s=args.assignment_deadline,
         metrics_port=args.metrics_port,
-        auth_token=_auth_token(args))
+        auth_token=_auth_token(args),
+        wire_codec=args.compression)
     dispatcher.start()
     print(f"dispatcher listening on {args.host}:{dispatcher.port}",
           flush=True)
@@ -184,6 +195,10 @@ def _run_stats(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # dispatcher/worker processes are I/O pumps with a few cooperating
+    # threads; the default 5ms GIL switch interval adds whole milliseconds
+    # of convoy latency per relayed frame on busy hosts
+    sys.setswitchinterval(0.001)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")

@@ -37,9 +37,12 @@ from petastorm_tpu.pool import (ExecutorBase, VentilationCancelled,
 from petastorm_tpu.retry import RetryPolicy
 from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
                                             FrameClosedError, FrameSocket,
-                                            PayloadDecoder, connect_frames,
-                                            parse_address, resolve_auth_token,
+                                            PayloadDecoder, WireItem,
+                                            connect_frames, parse_address,
+                                            resolve_allow_pickle,
+                                            resolve_auth_token,
                                             shm_transport_available)
+from petastorm_tpu.service.wire import SUPPORTED_CODECS, WIRE_VERSION
 
 logger = logging.getLogger(__name__)
 
@@ -49,6 +52,10 @@ _POLL_S = 0.05
 DEFAULT_WINDOW = 16
 #: cadence of client_stats frames (the starved-seconds fleet-pressure feed)
 _STATS_INTERVAL_S = 1.0
+#: results per ack frame (batched: the ack only frees the dispatcher's
+#: redelivery buffer, so latency costs nothing but a slightly longer
+#: replay on reconnect - the per-ordinal ledger dedups it regardless)
+_ACK_BATCH = 8
 
 
 class ServiceConnectionError(WorkerError):
@@ -92,8 +99,9 @@ class ServiceExecutor(ExecutorBase):
     (the reader warns and drops them for service-backed readers).
 
     Determinism note: results arrive in fleet completion order, but every
-    outcome carries its ventilation ordinal (the VentilatedItem objects ARE
-    the wire objects) and survives requeue-on-death and
+    outcome carries its ventilation ordinal (work items travel as
+    :class:`~petastorm_tpu.service.protocol.WireItem` frames whose ordinal/
+    attempt fields are first-class wire values) and survives requeue-on-death and
     reconnect-with-replay exactly once - so the reader's
     ``deterministic='seed'`` reorder stage produces the same delivered
     stream through the service hop as through an in-process pool
@@ -105,7 +113,8 @@ class ServiceExecutor(ExecutorBase):
                  window: int = DEFAULT_WINDOW,
                  reconnect_policy: Optional[RetryPolicy] = None,
                  client_id: Optional[str] = None,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 allow_pickle_results: Optional[bool] = None):
         super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
                          max_requeue_attempts=max_requeue_attempts)
         if window < 1:
@@ -127,18 +136,29 @@ class ServiceExecutor(ExecutorBase):
         self._results: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
         self._slots = threading.BoundedSemaphore(self._window)
         self._recv_thread: Optional[threading.Thread] = None
-        self._decoder = PayloadDecoder()
+        #: ``"pickle"`` fallback payload gate (hardened deployments refuse:
+        #: allow_pickle_results=False / $PETASTORM_TPU_SERVICE_ALLOW_PICKLE=0)
+        self._decoder = PayloadDecoder(
+            allow_pickle=resolve_allow_pickle(allow_pickle_results))
         self._factory_blob: Optional[bytes] = None
         self._reconnects = 0
         self._last_connect_error: Optional[str] = None
         self._bytes_in_folded = 0
         self._starved_s = 0.0
         self._stats_sent_at = 0.0
+        #: delivered ordinals awaiting an ack flush (receiver-thread state;
+        #: acks are batched so a 2000-results/s stream does not pay a
+        #: dispatcher wakeup per result - flushed every _ACK_BATCH results
+        #: and whenever the receive loop goes idle)
+        self._ack_pending: list = []
         # service.* client-side series (docs/operations.md): the stage span
         # is registered up front so reports/--watch render "(no samples
         # yet)" for a just-started service reader instead of omitting it
         if self._telemetry.enabled:
             self._telemetry.register_stage("service")
+            # inbound wire-decoding cost, per direction (workers record
+            # service.encode on their side)
+            self._telemetry.register_stage("service.decode")
         self._m_bytes_out = self._telemetry.counter("service.frame_bytes_sent")
         self._m_bytes_in = self._telemetry.counter(
             "service.frame_bytes_received")
@@ -147,6 +167,14 @@ class ServiceExecutor(ExecutorBase):
         self._m_srv_requeued = self._telemetry.counter(
             "service.requeued_items")
         self._g_connected = self._telemetry.gauge("service.connected")
+        # wire-encoding mix of received results (mirrors the dispatcher's
+        # relay counters; rendered on the `service:` diagnose --watch line)
+        self._m_frames_bin = self._telemetry.counter("service.frames_binary")
+        self._m_frames_pkl = self._telemetry.counter(
+            "service.frames_pickle_fallback")
+        self._m_frames_shm = self._telemetry.counter("service.frames_shm")
+        self._m_frames_z = self._telemetry.counter(
+            "service.frames_compressed")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -171,17 +199,31 @@ class ServiceExecutor(ExecutorBase):
         self._recv_thread.start()
 
     def _connect(self, resume: bool) -> None:
+        from petastorm_tpu.native import transport_availability
+
+        shm = transport_availability()
         conn = connect_frames(self._address)
         conn.send({"t": "client_hello", "protocol": PROTOCOL_VERSION,
                    "client": self.client_id, "factory": self._factory_blob,
                    "hostname": socket.gethostname(),
-                   "shm_ok": shm_transport_available(),
+                   "shm_ok": shm["available"],
+                   "codecs": list(SUPPORTED_CODECS),
                    "max_requeue": self._max_requeue,
                    "resume": resume, "token": self._auth_token})
         hello = conn.recv(timeout=10.0)
         if not hello or hello.get("t") != "hello_ok":
             conn.close()
             raise OSError(f"dispatcher refused client hello: {hello!r}")
+        # which data plane this client can get, and WHY - so a silently
+        # dark shm fast path (e.g. python < 3.12) is visible in the log,
+        # not just in a bench ratio months later
+        logger.info(
+            "service wire negotiated with %s:%d: binary v%d frames, codecs"
+            " %s, pickle fallback %s, shm fast path %s", self._address[0],
+            self._address[1], WIRE_VERSION, list(SUPPORTED_CODECS),
+            "accepted" if self._decoder.allow_pickle else "refused",
+            "available (arms when a worker shares this host)"
+            if shm["available"] else f"unavailable ({shm['reason']})")
         with self._conn_lock:
             old, self._conn = self._conn, conn
             self._bytes_in_folded = 0
@@ -196,7 +238,8 @@ class ServiceExecutor(ExecutorBase):
             with self._inflight_lock:
                 items = list(self._inflight.values())
             if items:
-                self._send({"t": "resync", "items": items})
+                self._send({"t": "resync",
+                            "items": [WireItem.encode(i) for i in items]})
 
     def stop(self) -> None:
         """Stop consuming: best-effort goodbye, close the connection."""
@@ -242,7 +285,7 @@ class ServiceExecutor(ExecutorBase):
         # doubles as the resync source after a reconnect
         self._track_put(item)
         try:
-            self._send({"t": "enqueue", "item": item})
+            self._send({"t": "enqueue", "item": WireItem.encode(item)})
             self._ventilated += 1
         except OSError:
             # connection mid-drop: the item is in the ledger, so the
@@ -258,7 +301,7 @@ class ServiceExecutor(ExecutorBase):
                 # a resync (ordinal-deduped dispatcher-side, unlike enqueue)
                 # covers the race where the receiver's reconnect resync ran
                 # before this item reached the ledger
-                self._send({"t": "resync", "items": [item]})
+                self._send({"t": "resync", "items": [WireItem.encode(item)]})
             except OSError:
                 pass  # next drop repeats the recovery
             self._ventilated += 1
@@ -338,6 +381,7 @@ class ServiceExecutor(ExecutorBase):
                     return
                 continue
             if msg is None:
+                self._flush_acks()  # idle moment: free the redelivery buffer
                 continue
             self._dispatch_frame(conn, msg)
 
@@ -349,34 +393,55 @@ class ServiceExecutor(ExecutorBase):
         if kind == "result":
             t0 = time.perf_counter_ns() if self._telemetry.enabled else None
             try:
-                value = self._decoder.decode(msg["payload"])
+                value = self._decoder.decode(msg)
             except Exception as exc:  # noqa: BLE001 - surfaced to consumer
+                # malformed/refused payload: a CLASSIFIED failure for this
+                # ordinal (the frame was already fully consumed, so the
+                # stream stays synced and other ordinals keep flowing).
+                # Still ACKED: the outcome was consumed, and an unacked
+                # result would pin its multi-MB body in the dispatcher's
+                # redelivery buffer forever and replay on every reconnect
+                # just to be refused again
                 self._results.put(_Failure(exc, ordinal=msg.get("ordinal")))
+                self._ack_pending.append(msg.get("ordinal"))
+                self._flush_acks()
                 return
             if t0 is not None:
+                dur = time.perf_counter_ns() - t0
                 # the 'service' stage: client-side cost of receiving one
                 # result (payload decode; the wire wait shows up as the
                 # reader's queue.results_empty_wait_s, not busy time here)
                 self._telemetry.record_stage(
-                    "service", t0, time.perf_counter_ns() - t0,
-                    {"ordinal": msg.get("ordinal")})
+                    "service", t0, dur, {"ordinal": msg.get("ordinal")})
+                self._telemetry.record_stage(
+                    "service.decode", t0, dur,
+                    {"ordinal": msg.get("ordinal"), "pk": msg.get("pk")})
                 self._m_results.add(1)
+            pk = msg.get("pk")
+            if pk == "bin":
+                self._m_frames_bin.add(1)
+                if msg.get("codec"):
+                    self._m_frames_z.add(1)
+            elif pk == "shm":
+                self._m_frames_shm.add(1)
+            elif pk == "pickle":
+                self._m_frames_pkl.add(1)
             self._results.put(("ok", msg.get("ordinal"),
                                msg.get("attempt", 0), value))
+            self._ack_pending.append(msg.get("ordinal"))
+            if len(self._ack_pending) >= _ACK_BATCH:
+                self._flush_acks()
             try:
-                self._send({"t": "ack", "ordinals": [msg.get("ordinal")]})
                 self._maybe_send_stats()
             except OSError:
                 pass  # the read side will notice and reconnect
         elif kind == "failure":
             self._results.put(msg)
-            try:
-                # failures free the dispatcher's redelivery buffer exactly
-                # like results - an unacked failure would be buffered
-                # forever and replayed on every reconnect
-                self._send({"t": "ack", "ordinals": [msg.get("ordinal")]})
-            except OSError:
-                pass
+            # failures free the dispatcher's redelivery buffer exactly
+            # like results - an unacked failure would be buffered
+            # forever and replayed on every reconnect
+            self._ack_pending.append(msg.get("ordinal"))
+            self._flush_acks()
         elif kind == "requeued":
             # accounting notice: the dispatcher moved one of our in-flight
             # items off a dead worker (the item itself stays in flight)
@@ -414,6 +479,18 @@ class ServiceExecutor(ExecutorBase):
             logger.info("Reconnected to dispatcher (attempt %d)", attempt)
             return True
         return False
+
+    def _flush_acks(self) -> None:
+        """Send any batched delivered-ordinal acks (receiver thread only).
+        A send failure keeps them pending: the dispatcher replays unacked
+        outcomes on reconnect and the ledger dedups."""
+        if not self._ack_pending:
+            return
+        ordinals, self._ack_pending = self._ack_pending, []
+        try:
+            self._send({"t": "ack", "ordinals": ordinals})
+        except OSError:
+            self._ack_pending = ordinals + self._ack_pending
 
     def _maybe_send_stats(self) -> None:
         """Piggyback the consumer starved-seconds delta (the fleet-pressure
@@ -477,22 +554,31 @@ class ServiceExecutor(ExecutorBase):
         """Deliver one forwarded failure; True = drop (duplicate).  Data
         failures surface as classified WorkerErrors for the reader's
         ``on_error`` policy; the dispatcher already ran the requeue budget
-        for infra failures, so whatever arrives here is final."""
+        for infra failures, so whatever arrives here is final.
+
+        Failure frames carry only plain fields (formatted traceback, kind,
+        exc_type) - the failed work item itself never crosses the wire
+        back; it is recovered from this executor's own in-flight ledger
+        (the same object we ventilated) for the quarantine record."""
         ordinal = msg.get("ordinal")
-        failure = msg.get("failure")
+        with self._inflight_lock:
+            item = self._inflight.get(ordinal)
         if not self._settle(ordinal):
             return True
         self._slots.release()
+        failure = msg.get("failure")  # local decode _Failure, never wire
         if failure is not None:
             message = f"Worker failed:\n{failure.formatted}"
             kind = failure.kind
             exc_type = failure.exc_type
-            item = failure.item
+        elif msg.get("formatted") is not None:
+            message = f"Worker failed:\n{msg['formatted']}"
+            kind = msg.get("kind", "data")
+            exc_type = msg.get("exc_type")
         else:
             message = msg.get("message", "service worker failure")
             kind = msg.get("kind", "infra")
             exc_type = None
-            item = msg.get("item")
         if self._stop_on_failure:
             self.stop()
         raise WorkerError(message, kind=kind, ordinal=ordinal, item=item,

@@ -17,6 +17,18 @@ fault-tolerance semantics the in-process pools implement:
   to the client unchanged - ``on_error`` skip policies quarantine them
   client-side exactly as with a local pool.
 
+Data-plane role: the dispatcher is a **buffer relay**.  Result frames are
+parsed only to their control header (ordinal, rows, payload kind); the
+column payload - the ~MBs of pixel data - is forwarded to the owning
+client as opaque bytes, never decoded, never unpickled
+(:mod:`petastorm_tpu.service.protocol`).  Work items likewise cross the
+dispatcher as :class:`~petastorm_tpu.service.protocol.WireItem`\\ s:
+structural scheduling metadata (ordinal, attempt, rowgroup-affinity key)
+plus an opaque blob only the assigned worker opens.  The wire-encoding mix
+is metered per relayed result (``service.frames_binary`` /
+``frames_pickle_fallback`` / ``frames_shm``) so a hot pickle fallback is
+visible, not silent.
+
 Delivery is exactly-once per client: results are buffered until the client
 **acks** them, so a dropped client connection replays unacked results on
 reconnect and the client-side per-ordinal ledger dedups any overlap.
@@ -38,6 +50,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import socket
 import threading
 import time
@@ -46,29 +59,36 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 from petastorm_tpu.errors import DEFAULT_REQUEUE_ATTEMPTS, PetastormTpuError
 from petastorm_tpu.pool import VentilatedItem
-from petastorm_tpu.service.protocol import (PROTOCOL_VERSION, FrameClosedError,
-                                            FrameSocket, resolve_auth_token,
-                                            token_matches)
+from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
+                                            FrameClosedError, FrameSocket,
+                                            LegacyPickleFrameError, WireItem,
+                                            resolve_auth_token, token_matches)
+from petastorm_tpu.service.wire import SUPPORTED_CODECS, negotiate_codec
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
 
 #: telemetry counter prefixes a worker heartbeat may fold into the
 #: dispatcher's registry as ``service.fleet.<name>`` (fleet-wide decode /
-#: cache accounting - the observable proof of decode-once sharing)
-FLEET_COUNTER_PREFIXES = ("decode.", "worker.", "cache.", "io.")
+#: cache accounting - the observable proof of decode-once sharing; the
+#: ``service.`` entry folds the workers' own wire-encoding mix and stage
+#: counters so encode-side behavior is visible at the control plane)
+FLEET_COUNTER_PREFIXES = ("decode.", "worker.", "cache.", "io.", "service.",
+                          "stage.service.")
 
 
 class _WorkerState:
     __slots__ = ("name", "conn", "capacity", "hostname", "inflight",
-                 "last_heartbeat", "busy", "jobs_sent", "gone")
+                 "last_heartbeat", "busy", "jobs_sent", "gone", "codecs")
 
     def __init__(self, name: str, conn: FrameSocket, capacity: int,
-                 hostname: str):
+                 hostname: str, codecs=()):
         self.name = name
         self.conn = conn
         self.capacity = max(1, int(capacity))
         self.hostname = hostname
+        #: wire codecs this worker can compress BATCH bodies with
+        self.codecs = tuple(codecs or ())
         #: (client_id, ordinal) assignments awaiting a result
         self.inflight: Set[Tuple[str, int]] = set()
         self.last_heartbeat = time.monotonic()
@@ -89,19 +109,22 @@ class _Assignment:
 class _ClientState:
     __slots__ = ("client_id", "conn", "factory", "hostname", "shm_ok",
                  "max_requeue", "pending", "inflight", "unacked", "rows",
-                 "results", "requeued", "connected", "disconnected_at")
+                 "results", "requeued", "connected", "disconnected_at",
+                 "codecs")
 
     def __init__(self, client_id: str, conn: FrameSocket, factory: bytes,
-                 hostname: str, shm_ok: bool, max_requeue: int):
+                 hostname: str, shm_ok: bool, max_requeue: int, codecs=()):
         self.client_id = client_id
         self.conn = conn
         self.factory = factory
         self.hostname = hostname
         self.shm_ok = shm_ok
         self.max_requeue = max_requeue
+        #: wire codecs this client can decompress BATCH bodies of
+        self.codecs = tuple(codecs or ())
         #: items awaiting assignment (requeues go to the FRONT so a
         #: recovered item does not wait behind a whole epoch)
-        self.pending: Deque[VentilatedItem] = collections.deque()
+        self.pending: Deque[WireItem] = collections.deque()
         #: ordinal -> _Assignment at a worker
         self.inflight: Dict[int, _Assignment] = {}
         #: ordinal -> outcome frame delivered but not yet acked (replayed
@@ -140,9 +163,15 @@ class Dispatcher:
     the job, keeping service and in-process semantics identical).
     ``auth_token``: shared handshake secret; defaults to
     ``$PETASTORM_TPU_SERVICE_TOKEN``.  When set, every hello (worker,
-    client, stats) must present it or the connection is refused.  The wire
-    is pickled frames - see the protocol module's trust-boundary warning:
-    only ever listen on trusted networks.
+    client, stats) must present it or the connection is refused.  The v2
+    wire is pickle-free binary frames (the token gates who may ship jobs
+    to the fleet, not frame parsing) - see the protocol module's
+    trust-boundary notes.
+    ``wire_codec``: BATCH-body compression policy, negotiated per
+    (worker, client) pair at job time - ``'auto'`` (default; compress
+    cross-host hops only), ``'off'``, or a codec name to force it
+    everywhere both ends support it.  Defaults to
+    ``$PETASTORM_TPU_SERVICE_COMPRESSION`` when unset.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
@@ -152,10 +181,19 @@ class Dispatcher:
                  max_requeue_attempts: int = DEFAULT_REQUEUE_ATTEMPTS,
                  assignment_deadline_s: Optional[float] = None,
                  metrics_port: Optional[int] = None,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 wire_codec: Optional[str] = None):
         if assignment_deadline_s is not None and assignment_deadline_s <= 0:
             raise PetastormTpuError(
                 "assignment_deadline_s must be > 0 or None")
+        if wire_codec is None:
+            wire_codec = os.environ.get(
+                "PETASTORM_TPU_SERVICE_COMPRESSION", "auto")
+        if wire_codec not in ("auto", "off") + SUPPORTED_CODECS:
+            raise PetastormTpuError(
+                f"wire_codec must be 'auto', 'off' or one of"
+                f" {SUPPORTED_CODECS}; got {wire_codec!r}")
+        self._wire_codec = wire_codec
         self._host = host
         self._requested_port = port
         self._heartbeat_timeout_s = float(heartbeat_timeout_s)
@@ -197,6 +235,12 @@ class Dispatcher:
         self._m_bytes_in = tele.counter("service.frame_bytes_received")
         self._m_bytes_out = tele.counter("service.frame_bytes_sent")
         self._m_rows = tele.counter("service.client_rows")
+        # wire-encoding mix of relayed results: the pickle fallback being
+        # hot must be VISIBLE (ci.sh asserts frames_pickle_fallback == 0
+        # on the result path of its smoke topology)
+        self._m_frames_bin = tele.counter("service.frames_binary")
+        self._m_frames_pkl = tele.counter("service.frames_pickle_fallback")
+        self._m_frames_shm = tele.counter("service.frames_shm")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -226,11 +270,12 @@ class Dispatcher:
         if self._auth_token is None and self._host not in (
                 "127.0.0.1", "localhost", "::1"):
             logger.warning(
-                "Dispatcher is listening on %s with NO auth token: the wire"
-                " protocol is pickled frames, so anyone who can reach this"
-                " port can execute arbitrary code on the dispatcher, the"
-                " fleet, and every client.  Restrict to a trusted network"
-                " and set $PETASTORM_TPU_SERVICE_TOKEN (docs/operations.md"
+                "Dispatcher is listening on %s with NO auth token: anyone"
+                " who can reach this port can register as a client and ship"
+                " a worker factory the fleet will execute (the v2 binary"
+                " wire removed unpickle-on-parse, not the execute-client-"
+                "jobs feature).  Restrict to a trusted network and set"
+                " $PETASTORM_TPU_SERVICE_TOKEN (docs/operations.md"
                 " 'Disaggregated ingest service').", self._host)
         return self
 
@@ -287,6 +332,21 @@ class Dispatcher:
     def _serve_conn(self, conn: FrameSocket) -> None:
         try:
             hello = conn.recv(timeout=10.0)
+        except LegacyPickleFrameError:
+            # a v1 (pickled-wire) peer, detected WITHOUT unpickling it:
+            # answer in the one format it can read so it fails loudly with
+            # the version message instead of desyncing or hanging
+            logger.warning("Refusing legacy v1 (pickled-frame) peer: this"
+                           " dispatcher speaks the v2 binary wire")
+            try:
+                conn.send_legacy_error(
+                    "protocol version mismatch: this dispatcher speaks the"
+                    f" v2 binary wire (PROTOCOL_VERSION {PROTOCOL_VERSION});"
+                    " upgrade the client/worker")
+            except OSError:
+                pass
+            conn.close()
+            return
         except Exception:  # noqa: BLE001 - drop bad conns (EOF, garbage)
             conn.close()
             return
@@ -341,7 +401,8 @@ class Dispatcher:
             if name in self._workers:
                 name = f"{name}-{self._worker_seq}"
             state = _WorkerState(name, conn, hello.get("capacity", 1),
-                                 hello.get("hostname", ""))
+                                 hello.get("hostname", ""),
+                                 codecs=hello.get("codecs") or ())
             self._workers[name] = state
             self._g_workers.set(len(self._workers))
         conn.send({"t": "hello_ok", "worker": name})
@@ -398,14 +459,22 @@ class Dispatcher:
                 duplicate = True
                 conn = None
             else:
-                out = {"t": "result", "ordinal": ordinal,
-                       "attempt": msg.get("attempt", 0),
-                       "payload": msg["payload"], "rows": msg.get("rows", 0),
-                       "worker": state.name}
+                # buffer relay: forward the worker's result header verbatim
+                # (minus its routing field) with the column payload as
+                # opaque bytes - the dispatcher never decodes it
+                out = {k: v for k, v in msg.items() if k != "client"}
+                out["worker"] = state.name
                 client.unacked[ordinal] = out
                 client.results += 1
                 client.rows += int(msg.get("rows", 0))
                 conn = client.conn if client.connected else None
+        pk = msg.get("pk")
+        if pk == "bin":
+            self._m_frames_bin.add(1)
+        elif pk == "shm":
+            self._m_frames_shm.add(1)
+        elif pk == "pickle":
+            self._m_frames_pkl.add(1)
         if duplicate:
             # outside the lock: _pump's sends must never run while this
             # thread holds the dispatcher lock (a worker with a full TCP
@@ -428,12 +497,13 @@ class Dispatcher:
                         int(msg.get("rows", 0)))
         if conn is not None:
             self._send_to_client(cid, conn, out)
-        self._stamp_gauges()
+        # no _stamp_gauges here: the monitor loop stamps every 0.5s, and a
+        # per-result lock+scan on the relay hot path costs real throughput
+        # on a core shared with decode
         self._pump()
 
     def _on_worker_failure(self, state: _WorkerState, msg: Dict) -> None:
         cid, ordinal = msg["client"], msg["ordinal"]
-        failure = msg["failure"]  # a pool._Failure (picklable envelope)
         state.last_heartbeat = time.monotonic()
         with self._lock:
             state.inflight.discard((cid, ordinal))
@@ -444,14 +514,20 @@ class Dispatcher:
             if assign is None:
                 self._m_dup.add(1)
                 return
-        if getattr(failure, "kind", "data") == "infra":
+        # failures are plain fields on the wire (formatted traceback, kind,
+        # exc_type) - no object envelope; the client recovers the failed
+        # item from its own in-flight ledger
+        if msg.get("kind", "data") == "infra":
             # in-worker infra failure (e.g. MemoryError): the item is
             # healthy, the worker wasn't - same treatment as a death
             self._requeue_or_fail(
                 cid, ordinal, assign,
-                f"in-worker infra failure ({failure.exc_type})")
+                f"in-worker infra failure ({msg.get('exc_type')})")
         else:
-            self._forward_failure(cid, ordinal, failure=failure)
+            self._forward_failure(cid, ordinal,
+                                  formatted=msg.get("formatted"),
+                                  kind=msg.get("kind", "data"),
+                                  exc_type=msg.get("exc_type"))
         self._pump()
 
     def _worker_gone(self, name: str) -> None:
@@ -485,9 +561,8 @@ class Dispatcher:
                 return
             attempt = getattr(assign.item, "attempt", 0)
             if attempt < client.max_requeue:
-                retry = VentilatedItem(ordinal,
-                                       getattr(assign.item, "item", assign.item),
-                                       attempt + 1)
+                retry = WireItem(ordinal, attempt + 1, assign.item.blob,
+                                 assign.item.rg)
                 client.pending.appendleft(retry)
                 client.requeued += 1
                 conn = client.conn if client.connected else None
@@ -509,22 +584,23 @@ class Dispatcher:
                 f"Work item {ordinal} lost to {why}; requeue budget exhausted"
                 f" ({attempt} requeue(s) of max {client.max_requeue})"
                 " - possible crash/OOM"),
-            kind="infra", item=assign.item)
+            kind="infra")
 
-    def _forward_failure(self, cid: str, ordinal: int, failure=None,
+    def _forward_failure(self, cid: str, ordinal: int,
+                         formatted: Optional[str] = None,
                          message: Optional[str] = None, kind: str = "data",
-                         item=None) -> None:
+                         exc_type: Optional[str] = None) -> None:
         with self._lock:
             client = self._clients.get(cid)
             if client is None:
                 return
-            out = {"t": "failure", "ordinal": ordinal}
-            if failure is not None:
-                out["failure"] = failure
-            else:
+            out = {"t": "failure", "ordinal": ordinal, "kind": kind}
+            if formatted is not None:
+                out["formatted"] = formatted
+            if message is not None:
                 out["message"] = message
-                out["kind"] = kind
-                out["item"] = item
+            if exc_type is not None:
+                out["exc_type"] = exc_type
             client.unacked[ordinal] = out
             conn = client.conn if client.connected else None
         self._m_failures.add(1)
@@ -545,7 +621,8 @@ class Dispatcher:
                 client = _ClientState(
                     cid, conn, hello.get("factory"),
                     hello.get("hostname", ""), bool(hello.get("shm_ok")),
-                    int(hello.get("max_requeue", self._max_requeue)))
+                    int(hello.get("max_requeue", self._max_requeue)),
+                    codecs=hello.get("codecs") or ())
                 self._clients[cid] = client
                 self._client_order.append(cid)
                 logger.info("Client %s registered", cid)
@@ -578,7 +655,7 @@ class Dispatcher:
                 kind = msg.get("t")
                 if kind == "enqueue":
                     with self._lock:
-                        client.pending.append(msg["item"])
+                        client.pending.append(WireItem.from_wire(msg["item"]))
                     self._pump()
                 elif kind == "ack":
                     with self._lock:
@@ -617,7 +694,8 @@ class Dispatcher:
         with self._lock:
             known = client.known_ordinals()
             restored = 0
-            for item in msg.get("items", ()):
+            for entry in msg.get("items", ()):
+                item = WireItem.from_wire(entry)
                 if item.ordinal not in known:
                     client.pending.append(item)
                     restored += 1
@@ -628,7 +706,14 @@ class Dispatcher:
 
     def _send_to_client(self, cid: str, conn: FrameSocket, out: Dict) -> None:
         try:
-            self._m_bytes_out.add(conn.send(out))
+            if "_body" in out:
+                # result relay: re-frame the header, forward the payload
+                # bytes untouched (vectored write - no staging copy)
+                header = {k: v for k, v in out.items() if k != "_body"}
+                self._m_bytes_out.add(
+                    conn.send_batch(header, [out["_body"]]))
+            else:
+                self._m_bytes_out.add(conn.send(out))
         except OSError:
             # connection died mid-send: the outcome stays in unacked and
             # replays on reconnect; the client read loop marks disconnect
@@ -679,15 +764,24 @@ class Dispatcher:
         ``stable`` lets _pump hoist the sorted name list out of its
         assignment loop (membership cannot change while it holds the lock).
         """
-        work = getattr(item, "item", None)
-        rg = getattr(work, "row_group", None)
-        if rg is not None:
+        if isinstance(item, WireItem):
+            # the wire plane lifts the affinity key out structurally so the
+            # dispatcher never opens the item blob
+            rg_key = (f"{item.rg[0]}:{item.rg[1]}"
+                      if isinstance(item.rg, (list, tuple))
+                      and len(item.rg) == 2 else None)
+        else:
+            # direct VentilatedItem (tests, in-process callers)
+            work = getattr(item, "item", None)
+            rg = getattr(work, "row_group", None)
+            rg_key = (f"{getattr(rg, 'path', '')}:"
+                      f"{getattr(rg, 'row_group', 0)}"
+                      if rg is not None else None)
+        if rg_key is not None:
             if stable is None:
                 stable = sorted(w.name for w in self._workers.values()
                                 if not w.gone)
-            key = zlib.crc32(
-                f"{getattr(rg, 'path', '')}:{getattr(rg, 'row_group', 0)}"
-                .encode())
+            key = zlib.crc32(rg_key.encode())
             affine = self._workers.get(stable[key % len(stable)])
             if affine is not None and affine in free:
                 return affine
@@ -722,12 +816,18 @@ class Dispatcher:
                 worker.inflight.add((cid, item.ordinal))
                 if cid not in worker.jobs_sent:
                     worker.jobs_sent.add(cid)
+                    same_host = bool(client.hostname
+                                     and client.hostname == worker.hostname)
                     sends.append((worker, {
                         "t": "job", "client": cid, "factory": client.factory,
-                        "shm_ok": (client.shm_ok
-                                   and client.hostname == worker.hostname)}))
+                        "shm_ok": client.shm_ok and same_host,
+                        # BATCH-body compression for this pair: off for
+                        # co-located hops, negotiated for cross-host ones
+                        "codec": negotiate_codec(
+                            self._wire_codec, same_host, client.codecs,
+                            worker.codecs)}))
                 sends.append((worker, {"t": "work", "client": cid,
-                                       "item": item}))
+                                       "item": item.to_wire()}))
                 self._m_assigned.add(1)
         for worker, msg in sends:
             try:

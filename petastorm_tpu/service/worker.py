@@ -44,6 +44,7 @@ from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
                                             connect_frames, encode_result,
                                             parse_address, resolve_auth_token,
                                             shm_transport_available)
+from petastorm_tpu.service.wire import SUPPORTED_CODECS, WireFormatError
 from petastorm_tpu.telemetry import Telemetry
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
@@ -133,6 +134,7 @@ class ServiceWorker:
             conn.send({"t": "worker_hello", "protocol": PROTOCOL_VERSION,
                        "worker": self._name, "capacity": self._capacity,
                        "hostname": socket.gethostname(), "pid": os.getpid(),
+                       "codecs": list(SUPPORTED_CODECS),
                        "token": self._auth_token})
             hello = conn.recv(timeout=10.0)
         except (OSError, PetastormTpuError) as exc:
@@ -167,9 +169,19 @@ class ServiceWorker:
                     with self._fn_lock:
                         self._jobs[msg["client"]] = {
                             "factory": msg["factory"],
-                            "shm_ok": bool(msg.get("shm_ok"))}
+                            "shm_ok": bool(msg.get("shm_ok")),
+                            # negotiated BATCH-body compression for this
+                            # (worker, client) pair ('' = off)
+                            "codec": msg.get("codec") or ""}
                 elif kind == "work":
-                    self._work.put((msg["client"], msg["item"]))
+                    # the item blob is the trusted client->worker job plane:
+                    # this is the ONE place (beyond the factory bootstrap)
+                    # service bytes are unpickled, and only for items the
+                    # auth-gated dispatcher assigned to us
+                    wi = msg["item"]
+                    item = VentilatedItem(wi["o"], pickle.loads(wi["blob"]),
+                                          wi.get("a", 0))
+                    self._work.put((msg["client"], item))
                 elif kind == "job_done":
                     with self._fn_lock:
                         self._jobs.pop(msg["client"], None)
@@ -179,6 +191,10 @@ class ServiceWorker:
         except FrameClosedError:
             if not self._stop_event.is_set():
                 logger.warning("Dispatcher connection closed; worker exiting")
+        except WireFormatError:
+            if not self._stop_event.is_set():
+                logger.warning("Dispatcher sent an undecodable frame;"
+                               " worker exiting", exc_info=True)
         finally:
             self.stop()
             if self._arena is not None:
@@ -233,6 +249,12 @@ class ServiceWorker:
                 self._arena = SharedArena.create(self._shm_size_bytes)
             return self._arena
 
+    def _codec_for(self, cid: str) -> str:
+        """The negotiated BATCH-body codec for one client ('' = off)."""
+        with self._fn_lock:
+            job = self._jobs.get(cid)
+            return job["codec"] if job else ""
+
     def _processor_loop(self) -> None:
         tele = self.telemetry
         while not self._stop_event.is_set():
@@ -254,19 +276,27 @@ class ServiceWorker:
                         # no result, no goodbye; the dispatcher's death
                         # detection requeues our in-flight items
                         os._exit(137)
-                    self._send({"t": "failure", "client": cid,
-                                "ordinal": ordinal, "attempt": attempt,
-                                "failure": _Failure(exc, ordinal=ordinal,
-                                                    item=item)})
+                    self._send_failure(cid, ordinal, attempt, exc, item)
                 else:
                     try:
-                        payload = encode_result(
+                        t0 = (time.perf_counter_ns() if tele.enabled
+                              else None)
+                        header, parts = encode_result(
                             result, arena=self._arena_for(cid),
-                            stop_check=self._stop_event.is_set)
-                        self._send({"t": "result", "client": cid,
-                                    "ordinal": ordinal, "attempt": attempt,
-                                    "rows": getattr(result, "num_rows", 0),
-                                    "payload": payload})
+                            stop_check=self._stop_event.is_set,
+                            codec=self._codec_for(cid))
+                        header.update({
+                            "t": "result", "client": cid,
+                            "ordinal": ordinal, "attempt": attempt,
+                            "rows": getattr(result, "num_rows", 0)})
+                        if t0 is not None:
+                            # outbound wire-encoding cost, per direction
+                            # (the client records service.decode)
+                            tele.record_stage(
+                                "service.encode", t0,
+                                time.perf_counter_ns() - t0,
+                                {"ordinal": ordinal, "pk": header["pk"]})
+                        self._send_batch(header, parts)
                     except Exception as exc:  # noqa: BLE001 - must answer
                         # an unencodable result (unpicklable transform
                         # output, oversize frame) must become a classified
@@ -275,14 +305,17 @@ class ServiceWorker:
                         logger.warning("result for item %s not encodable;"
                                        " forwarding as failure", ordinal,
                                        exc_info=True)
-                        self._send({"t": "failure", "client": cid,
-                                    "ordinal": ordinal, "attempt": attempt,
-                                    "failure": _Failure(exc, ordinal=ordinal,
-                                                        item=item)})
+                        self._send_failure(cid, ordinal, attempt, exc, item)
                     else:
                         self.items_processed += 1
                         if tele.enabled:
                             tele.counter("service.worker_results").add(1)
+                            tele.counter(
+                                "service.frames_binary"
+                                if header["pk"] == "bin" else
+                                "service.frames_shm"
+                                if header["pk"] == "shm" else
+                                "service.frames_pickle_fallback").add(1)
             finally:
                 with self._busy_lock:
                     self._busy -= 1
@@ -297,6 +330,26 @@ class ServiceWorker:
             # dispatcher gone mid-send: the read loop notices EOF and exits;
             # the dispatcher requeues whatever we held
             logger.debug("result send failed (dispatcher gone?)")
+
+    def _send_batch(self, header: Dict, parts) -> None:
+        conn = self._conn
+        if conn is None:
+            return
+        try:
+            conn.send_batch(header, parts)
+        except OSError:
+            logger.debug("result send failed (dispatcher gone?)")
+
+    def _send_failure(self, cid: str, ordinal, attempt, exc: BaseException,
+                      item) -> None:
+        """Forward one classified failure as plain wire fields (the pool's
+        ``_Failure`` envelope supplies the formatting/classification; no
+        object crosses the socket - the client recovers the item from its
+        own ledger)."""
+        failure = _Failure(exc, ordinal=ordinal, item=item)
+        self._send({"t": "failure", "client": cid, "ordinal": ordinal,
+                    "attempt": attempt, "formatted": failure.formatted,
+                    "kind": failure.kind, "exc_type": failure.exc_type})
 
     # -- heartbeat ------------------------------------------------------------
 

@@ -375,6 +375,12 @@ def render_watch_frame(point: Dict, diagnostics: Optional[Dict] = None,
             lines.append(
                 f"service: {rates.get('service.results', 0.0):6.1f} results/s"
                 f"  {rates.get('service.frame_bytes_received', 0.0) / 2 ** 10:8.1f} KB/s in"
+                # wire-encoding mix: a hot pickle fallback (pkl > 0 and
+                # climbing) means the binary plane is NOT carrying the data
+                # path - visible here, not just in a slow bench
+                f"  wire bin={counters.get('service.frames_binary', 0):g}"
+                f"/shm={counters.get('service.frames_shm', 0):g}"
+                f"/pkl={counters.get('service.frames_pickle_fallback', 0):g}"
                 f"  requeued {counters.get('service.requeued_items', 0):g}"
                 f"  reconnects {counters.get('service.reconnects', 0):g}"
                 f"  connected {gauges.get('service.connected', 0):g}")

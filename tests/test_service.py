@@ -1,11 +1,14 @@
-"""Disaggregated ingest service (petastorm_tpu.service): wire protocol,
-dispatcher assignment/requeue, client executor, multi-client e2e with the
-shared warm tier, and chaos on the service plane (worker SIGKILL, client
-connection drop, dispatcher loss)."""
+"""Disaggregated ingest service (petastorm_tpu.service): the v2 binary
+wire (control codec, batch frames, robustness against corrupt/legacy
+frames), dispatcher assignment/requeue/buffer-relay, client executor,
+multi-client e2e with the shared warm tier, and chaos on the service plane
+(worker SIGKILL, client connection drop, dispatcher loss)."""
 
 import os
+import pickle
 import signal
 import socket
+import struct
 import subprocess
 import sys
 import threading
@@ -15,18 +18,24 @@ import uuid
 import numpy as np
 import pytest
 
+from petastorm_tpu.batch import ColumnBatch
 from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.etl.writer import write_dataset
 from petastorm_tpu.pool import VentilatedItem, WorkerError
 from petastorm_tpu.reader import make_batch_reader
 from petastorm_tpu.retry import RetryPolicy
 from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service import wire
 from petastorm_tpu.service.client import (ServiceConnectionError,
                                           ServiceExecutor)
 from petastorm_tpu.service.dispatcher import Dispatcher
 from petastorm_tpu.service.protocol import (FrameClosedError, FrameSocket,
-                                            PayloadDecoder, connect_frames,
-                                            encode_result, parse_address)
+                                            LegacyPickleFrameError,
+                                            PayloadDecoder, WireItem,
+                                            connect_frames, encode_result,
+                                            parse_address,
+                                            shm_transport_available)
+from petastorm_tpu.service.wire import WireFormatError
 from petastorm_tpu.service.worker import ServiceWorker
 from petastorm_tpu.telemetry import Telemetry
 
@@ -134,13 +143,16 @@ def test_frame_roundtrip_and_eof():
     a, b = socket.socketpair()
     fa, fb = FrameSocket(a), FrameSocket(b)
     msgs = [{"t": "x", "n": 1}, {"t": "y", "blob": os.urandom(1 << 16)},
-            {"t": "item", "item": VentilatedItem(3, "work", attempt=1)}]
+            {"t": "item", "item": WireItem.encode(
+                VentilatedItem(3, "work", attempt=1))}]
     for m in msgs:
         fa.send(m)
     got = [fb.recv(timeout=2.0) for _ in msgs]
     assert got[0] == msgs[0]
     assert got[1]["blob"] == msgs[1]["blob"]
-    assert got[2]["item"].ordinal == 3 and got[2]["item"].attempt == 1
+    item = WireItem.from_wire(got[2]["item"])
+    assert item.ordinal == 3 and item.attempt == 1
+    assert pickle.loads(item.blob) == "work"  # opaque blob: worker-side only
     assert fb.bytes_received == fa.bytes_sent
     # timeout (no data) -> None, partial state preserved
     assert fb.recv(timeout=0.05) is None
@@ -151,14 +163,17 @@ def test_frame_roundtrip_and_eof():
     fb.close()
 
 
+def _ctrl_frame(msg) -> bytes:
+    """A raw v2 CTRL frame as it appears on the socket."""
+    payload = bytes([wire.KIND_CTRL]) + wire.dumps(msg)
+    return struct.pack("!I", len(payload)) + payload
+
+
 def test_frame_partial_delivery_survives_timeouts():
     a, b = socket.socketpair()
     fb = FrameSocket(b)
     payload = FrameSocket(a)
-    import pickle
-    import struct
-    raw = pickle.dumps({"t": "big", "blob": b"z" * 100_000})
-    framed = struct.pack("!I", len(raw)) + raw
+    framed = _ctrl_frame({"t": "big", "blob": b"z" * 100_000})
     # dribble the frame in two halves with a gap: the first recv times out
     # mid-frame, the second completes it from the kept buffer
     a.sendall(framed[:50])
@@ -225,12 +240,10 @@ def test_send_timeout_rearms_on_progress():
 def test_recv_timeout_is_total_not_per_fill():
     """One recv deadline covers header AND body: a frame stuck mid-body
     must not double the caller's wait."""
-    import pickle
-    import struct
     a, b = socket.socketpair()
     fb = FrameSocket(b)
-    raw = pickle.dumps({"t": "x"})
-    a.sendall(struct.pack("!I", len(raw)) + raw[:1])  # header + 1 body byte
+    framed = _ctrl_frame({"t": "x"})
+    a.sendall(framed[:5])  # length prefix + 1 body byte
     t0 = time.monotonic()
     assert fb.recv(timeout=0.3) is None
     assert time.monotonic() - t0 < 0.55
@@ -326,6 +339,10 @@ def test_pick_worker_affinity_is_deterministic():
     for _ in range(5):  # stable across repeated picks and free-list orders
         assert disp._pick_worker(item, free) is expected
         free = free[1:] + free[:1]
+    # the wire plane's structural affinity key routes IDENTICALLY to the
+    # in-process object path (the dispatcher never opens the item blob)
+    wire_item = WireItem(0, 0, b"opaque", ["/data/part-0.parquet", 7])
+    assert disp._pick_worker(wire_item, list(workers.values())) is expected
     # saturated affine worker -> least-loaded fallback, not a re-route of
     # the whole mapping
     others = [w for w in workers.values() if w is not expected]
@@ -344,15 +361,371 @@ def test_parse_address():
         parse_address("no-port")
 
 
-def test_payload_pickle_roundtrip():
-    from petastorm_tpu.batch import ColumnBatch
+def _result_msg(header, parts):
+    """Round one encoded result through a socketpair, as the client's
+    receiver would see it (BATCH frame -> header dict + '_body')."""
+    a, b = socket.socketpair()
+    fa, fb = FrameSocket(a), FrameSocket(b)
+    try:
+        fa.send_batch(dict(header, t="result"), parts)
+        return fb.recv(timeout=2.0)
+    finally:
+        fa.close()
+        fb.close()
 
-    batch = ColumnBatch({"x": np.arange(5)}, 5, ordinal=7)
-    payload = encode_result(batch, arena=None)
-    assert payload[0] == "pickle"
-    out = PayloadDecoder().decode(payload)
+
+def test_payload_binary_roundtrip():
+    """ColumnBatch results travel as schema'd binary frames - zero pickle -
+    and decode to WRITABLE numpy views over the received buffer."""
+    batch = ColumnBatch({"x": np.arange(5), "img": np.arange(30, dtype=np.uint8)
+                         .reshape(5, 3, 2)}, 5, ordinal=7)
+    header, parts = encode_result(batch, arena=None)
+    assert header["pk"] == "bin"
+    msg = _result_msg(header, parts)
+    out = PayloadDecoder().decode(msg)
     np.testing.assert_array_equal(out.columns["x"], np.arange(5))
+    np.testing.assert_array_equal(out.columns["img"], batch.columns["img"])
     assert out.ordinal == 7
+    # consumers mutate batches in place (torch normalize etc.): the
+    # zero-copy views must be writable or every batch pays a copy downstream
+    assert out.columns["x"].flags.writeable
+    out.columns["x"][0] = 99
+
+
+def test_payload_object_columns_ride_inline_binary():
+    """Object-dtype columns (strings/bytes/ragged arrays) stay on the
+    binary plane via the control codec's inline path."""
+    strs = np.empty(3, dtype=object)
+    strs[:] = ["a", "bb", "ccc"]
+    ragged = np.empty(3, dtype=object)
+    ragged[:] = [np.arange(i + 1) for i in range(3)]
+    batch = ColumnBatch({"s": strs, "r": ragged, "x": np.arange(3)}, 3)
+    header, parts = encode_result(batch)
+    assert header["pk"] == "bin"
+    out = PayloadDecoder().decode(_result_msg(header, parts))
+    assert list(out.columns["s"]) == ["a", "bb", "ccc"]
+    np.testing.assert_array_equal(out.columns["r"][2], np.arange(3))
+
+
+def test_payload_pickle_fallback_is_counted_and_gated():
+    """Results outside the wire domain fall back to pickle (pk='pickle');
+    a client refusing pickle gets a classified WireFormatError, never an
+    unpickle."""
+    header, parts = encode_result(("echo", "payload", 3))
+    assert header["pk"] == "pickle"
+    msg = _result_msg(header, parts)
+    assert PayloadDecoder().decode(msg) == ("echo", "payload", 3)
+    with pytest.raises(WireFormatError, match="refuses"):
+        PayloadDecoder(allow_pickle=False).decode(msg)
+
+
+def test_payload_compression_roundtrip():
+    """A zlib-coded batch body decodes identically (end-to-end: the
+    dispatcher never touches it)."""
+    batch = ColumnBatch({"x": np.zeros((64, 128), dtype=np.uint8)}, 64)
+    header, parts = encode_result(batch, codec="zlib")
+    assert header["pk"] == "bin" and header["codec"] == "zlib"
+    assert sum(len(p) for p in parts) < batch.columns["x"].nbytes  # it DID
+    out = PayloadDecoder().decode(_result_msg(header, parts))
+    np.testing.assert_array_equal(out.columns["x"], batch.columns["x"])
+    # a corrupted compressed body is a classified failure, not a zlib crash
+    msg = _result_msg(header, [b"\x00garbage"])
+    with pytest.raises(WireFormatError, match="corrupt|bytes"):
+        PayloadDecoder().decode(msg)
+
+
+# -- wire robustness: corrupt/hostile frames ----------------------------------
+
+def test_wire_control_codec_roundtrip():
+    values = [None, True, False, 0, -(2 ** 62), 3.5, "héllo", b"\x00\xff",
+              [1, [2, [3]]], {"a": {"b": [None, "x"]}},
+              np.arange(6, dtype=np.float32).reshape(2, 3)]
+    for v in values:
+        out = wire.loads(wire.dumps(v))
+        if isinstance(v, np.ndarray):
+            np.testing.assert_array_equal(out, v)
+        else:
+            assert out == v, (v, out)
+    with pytest.raises(WireFormatError, match="not wire-encodable"):
+        wire.dumps(object())
+    with pytest.raises(WireFormatError, match="64-bit"):
+        wire.dumps(2 ** 70)
+
+
+def test_wire_rejects_truncated_and_trailing():
+    blob = wire.dumps({"k": [1, 2, 3]})
+    with pytest.raises(WireFormatError, match="truncated"):
+        wire.loads(blob[:-2])
+    with pytest.raises(WireFormatError, match="trailing"):
+        wire.loads(blob + b"\x00")
+    # a list claiming 2^20+ items on 4 bytes of input: bounds, not OOM
+    bomb = struct.pack("!BI", 0x07, (1 << 20) + 1)
+    with pytest.raises(WireFormatError, match="claims"):
+        wire.loads(bomb)
+    # an object array claiming 2^29 elements in a 6-byte frame must be
+    # bounded BEFORE allocation (np.empty of the pointer array alone
+    # would be 4GB - the allocation-bomb shape of the same attack)
+    obj_bomb = struct.pack("!BBI", 0x0A, 1, 1 << 29)
+    with pytest.raises(WireFormatError, match="claims"):
+        wire.loads(obj_bomb)
+    # deep nesting is cut off, not a RecursionError
+    deep = b"\x07\x00\x00\x00\x01" * 64 + wire.dumps(None)
+    with pytest.raises(WireFormatError, match="nests deeper"):
+        wire.loads(deep)
+
+
+def test_frame_socket_rejects_unknown_and_legacy_kinds():
+    """Unknown frame kinds and v1 pickled frames are refused as classified
+    errors - the pickled frame is DETECTED (first byte), never loaded."""
+    a, b = socket.socketpair()
+    fb = FrameSocket(b)
+    # unknown kind byte
+    a.sendall(struct.pack("!I", 1) + b"\x7f")
+    with pytest.raises(WireFormatError, match="unknown frame kind"):
+        fb.recv(timeout=2.0)
+    # a legacy pickled frame: the payload would RCE if anyone loaded it;
+    # detection must classify it without executing anything
+    evil = pickle.dumps({"t": "client_hello"})
+    assert evil[0] == wire.PICKLE_PROTO_BYTE
+    a.sendall(struct.pack("!I", len(evil)) + evil)
+    with pytest.raises(LegacyPickleFrameError, match="v1 pickled"):
+        fb.recv(timeout=2.0)
+    # the stream itself stays synced: a good frame after the bad ones parses
+    a.sendall(_ctrl_frame({"t": "ok"}))
+    assert fb.recv(timeout=2.0) == {"t": "ok"}
+    a.close()
+    fb.close()
+
+
+def test_batch_frame_spec_validation():
+    """Every header/buffer disagreement is a classified WireFormatError:
+    wrong lengths, out-of-bounds offsets, object dtypes, oversize column
+    tables - never a numpy crash or an unpickle."""
+    body = bytearray(np.arange(10, dtype=np.int64).tobytes())
+
+    def decode(cols, rows=10, blen=None):
+        header = {"pk": "bin", "rows": rows, "cols": cols,
+                  "blen": len(body) if blen is None else blen, "codec": ""}
+        return wire.decode_batch_body(header, memoryview(body))
+
+    ok = decode({"x": ["raw", "<i8", [10], 0, 80]})
+    np.testing.assert_array_equal(ok.columns["x"], np.arange(10))
+    with pytest.raises(WireFormatError, match="needs"):
+        decode({"x": ["raw", "<i8", [10], 0, 64]})  # nbytes vs dtype*shape
+    with pytest.raises(WireFormatError, match="outside"):
+        decode({"x": ["raw", "<i8", [10], 64, 80]})  # overruns the body
+    with pytest.raises(WireFormatError, match="object dtypes"):
+        decode({"x": ["raw", "|O", [10], 0, 80]})  # unpickle in disguise
+    with pytest.raises(WireFormatError, match="bad wire dtype"):
+        decode({"x": ["raw", "not-a-dtype", [10], 0, 80]})
+    with pytest.raises(WireFormatError, match="rows"):
+        decode({"x": ["raw", "<i8", [10], 0, 80]}, rows=7)  # len disagreement
+    with pytest.raises(WireFormatError, match="body is"):
+        decode({"x": ["raw", "<i8", [10], 0, 80]}, blen=79)
+    with pytest.raises(WireFormatError, match="implausibly large"):
+        decode({"x": ["raw", "<i8", [1 << 30, 1 << 30], 0, 80]})
+    with pytest.raises(WireFormatError, match="oversize"):
+        decode({f"c{i}": ["inline", None] for i in range(5000)})
+    with pytest.raises(WireFormatError, match="unknown spec kind"):
+        decode({"x": ["mystery", 1]})
+
+
+def test_legacy_v1_client_is_refused_loudly():
+    """A v1 (pickled-wire) client hello gets a v1-READABLE error frame and
+    a closed connection - a loud version mismatch, not a hang or desync."""
+    disp = Dispatcher(telemetry=Telemetry()).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", disp.port), timeout=5)
+        evil = pickle.dumps({"t": "client_hello", "protocol": 1})
+        sock.sendall(struct.pack("!I", len(evil)) + evil)
+        # the reply is a pickled error dict (the one format v1 peers read)
+        (length,) = struct.unpack("!I", _recv_exact(sock, 4))
+        reply = pickle.loads(_recv_exact(sock, length))
+        assert reply["t"] == "error"
+        assert "protocol version mismatch" in reply["error"]
+        assert _recv_exact(sock, 1) == b""  # then EOF: connection closed
+        sock.close()
+    finally:
+        disp.stop()
+        disp.join()
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return buf
+        buf += chunk
+    return buf
+
+
+def test_corrupt_result_is_classified_failure_not_desync(fleet):
+    """A result the client cannot decode (here: the pickle fallback with
+    pickle refused) surfaces as a classified WorkerError per ordinal while
+    the stream keeps flowing - no desync, no unpickle attempt - and is
+    still ACKED (a refused outcome must not pin the dispatcher's
+    redelivery buffer / replay forever)."""
+    disp, addr, _workers = fleet
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4,
+                         stop_on_failure=False, allow_pickle_results=False)
+    ex.start(EchoFactory())
+    for i in range(3):
+        ex.put(VentilatedItem(i, f"p{i}"))
+    failures = 0
+    for _ in range(3):
+        with pytest.raises(WorkerError, match="refuses"):
+            ex.get(timeout=15.0)
+        failures += 1
+    assert failures == 3  # every ordinal individually classified
+    _wait_for(lambda: all(c["unacked"] == 0
+                          for c in disp.stats()["clients"].values()),
+              what="refused results acked (redelivery buffer freed)")
+    ex.stop()
+    ex.join()
+
+
+# -- wire negotiation / encoding mix ------------------------------------------
+
+def test_negotiate_codec_policy():
+    codecs = ("zlib",)
+    # auto: compress only cross-host hops both ends support
+    assert wire.negotiate_codec("auto", True, codecs, codecs) == ""
+    assert wire.negotiate_codec("auto", False, codecs, codecs) == "zlib"
+    assert wire.negotiate_codec("auto", False, (), codecs) == ""
+    assert wire.negotiate_codec("auto", False, codecs, ()) == ""
+    # off: never; forced: wherever both ends support it
+    assert wire.negotiate_codec("off", False, codecs, codecs) == ""
+    assert wire.negotiate_codec("zlib", True, codecs, codecs) == "zlib"
+    assert wire.negotiate_codec("zlib", True, (), codecs) == ""
+
+
+def test_binary_wire_counters_and_shm_diagnostics(int_dataset, fleet):
+    """The e2e result path is pickle-free for real reads: every batch is a
+    binary frame (client AND dispatcher meter the mix), the per-direction
+    decode stage records, and the reader surfaces which shm transport path
+    this runtime can negotiate - and why not."""
+    disp, addr, _workers = fleet
+    rows, diag, tele = _read_all(int_dataset, addr)
+    assert rows == list(range(200))
+    c = tele.snapshot()["counters"]
+    assert c["service.frames_binary"] == 20
+    assert c.get("service.frames_pickle_fallback", 0) == 0
+    assert "stage.service.decode.busy_s" in c
+    dc = disp.stats()["counters"]
+    assert dc["service.frames_binary"] >= 20
+    assert dc.get("service.frames_pickle_fallback", 0) == 0
+    shm = diag["native"]["shm_transport"]
+    assert shm["available"] == shm_transport_available()
+    if not shm["available"]:
+        # the dark fast path must name its reason (py<3.12, missing .so)
+        assert shm["reason"]
+
+
+def test_pickle_fallback_is_metered(fleet):
+    """Non-ColumnBatch worker results (the echo factory's tuples) take the
+    counted pickle fallback - visible, never silent."""
+    _disp, addr, _workers = fleet
+    tele = Telemetry()
+    ex = ServiceExecutor(addr, telemetry=tele, window=4)
+    ex.start(EchoFactory())
+    for i in range(4):
+        ex.put(VentilatedItem(i, f"p{i}"))
+    got = sorted(ex.get(timeout=10.0) for _ in range(4))
+    assert got == [("echo", f"p{i}", i) for i in range(4)]
+    c = tele.snapshot()["counters"]
+    assert c["service.frames_pickle_fallback"] == 4
+    assert c.get("service.frames_binary", 0) == 0
+    ex.stop()
+    ex.join()
+
+
+def test_forced_compression_end_to_end(int_dataset):
+    """wire_codec='zlib' forces BATCH-body compression even on one host;
+    the stream stays exact and the client meters compressed frames."""
+    disp = Dispatcher(telemetry=Telemetry(), wire_codec="zlib").start()
+    addr = f"127.0.0.1:{disp.port}"
+    workers = [ServiceWorker(addr, capacity=2, name=f"wz{i}")
+               for i in range(2)]
+    for w in workers:
+        threading.Thread(target=w.run, daemon=True).start()
+    try:
+        _wait_for(lambda: len(disp.stats()["workers"]) == 2,
+                  what="worker registration")
+        rows, _diag, tele = _read_all(int_dataset, addr)
+        assert rows == list(range(200))
+        c = tele.snapshot()["counters"]
+        assert c["service.frames_binary"] == 20
+        assert c["service.frames_compressed"] == 20
+    finally:
+        for w in workers:
+            w.stop()
+        disp.stop()
+        disp.join()
+
+
+def test_wire_codec_knob_validation():
+    with pytest.raises(PetastormTpuError, match="wire_codec"):
+        Dispatcher(telemetry=Telemetry(), wire_codec="snappy")
+
+
+def test_client_hello_logs_negotiated_wire(fleet, caplog):
+    """Satellite: the hello log states which data plane was negotiated and
+    WHY the shm fast path is (un)available on this runtime."""
+    import logging
+
+    _disp, addr, _workers = fleet
+    with caplog.at_level(logging.INFO, logger="petastorm_tpu.service.client"):
+        ex = ServiceExecutor(addr, telemetry=Telemetry(), window=2)
+        ex.start(EchoFactory())
+    lines = [r.getMessage() for r in caplog.records
+             if "service wire negotiated" in r.getMessage()]
+    assert lines, caplog.records
+    assert "binary v2 frames" in lines[0]
+    if shm_transport_available():
+        assert "shm fast path available" in lines[0]
+    else:
+        assert "unavailable (python" in lines[0] \
+            or "unavailable (native" in lines[0]
+    ex.stop()
+    ex.join()
+
+
+_SHM_DARK = not shm_transport_available()
+
+
+@pytest.mark.skipif(
+    _SHM_DARK and not os.environ.get("PETASTORM_TPU_REQUIRE_ARENA"),
+    reason="shm transport plane unavailable (python >= 3.12 + native lib)")
+def test_shm_fast_path_end_to_end(int_dataset):
+    """Co-located client+worker negotiate the shm arena: batches cross the
+    socket as descriptors only (pk='shm'), counted on both ends.
+
+    Under PETASTORM_TPU_REQUIRE_ARENA=1 (the py3.12 CI jobs) this test
+    RUNS unconditionally, so a silently-broken arena plane fails loudly
+    instead of skipping - the fast path can never go dark unnoticed again.
+    """
+    disp = Dispatcher(telemetry=Telemetry()).start()
+    addr = f"127.0.0.1:{disp.port}"
+    workers = [ServiceWorker(addr, capacity=2, name=f"ws{i}",
+                             shm_size_bytes=64 * 2 ** 20) for i in range(2)]
+    for w in workers:
+        threading.Thread(target=w.run, daemon=True).start()
+    try:
+        _wait_for(lambda: len(disp.stats()["workers"]) == 2,
+                  what="worker registration")
+        rows, diag, tele = _read_all(int_dataset, addr)
+        assert rows == list(range(200))
+        c = tele.snapshot()["counters"]
+        assert c["service.frames_shm"] == 20, c
+        assert c.get("service.frames_pickle_fallback", 0) == 0
+        assert disp.stats()["counters"]["service.frames_shm"] >= 20
+        assert diag["native"]["shm_transport"]["available"] is True
+    finally:
+        for w in workers:
+            w.stop()
+        disp.stop()
+        disp.join()
 
 
 # -- client executor unit behavior -------------------------------------------
@@ -674,8 +1047,12 @@ def test_service_stage_prerendered_and_watch_line(int_dataset, fleet):
         reader.sampler.sample_now()
         point = reader.sampler.latest()
         frame = render_watch_frame(point, reader.diagnostics)
-        assert "service:" in frame and "(no samples yet)" not in frame.split(
-            "service:")[1].splitlines()[0]
+        service_line = frame.split("service:")[1].splitlines()[0]
+        assert "(no samples yet)" not in service_line
+        # the wire-encoding mix rides the line: all-binary here, zero
+        # pickle fallback (the satellite observable of the v2 wire)
+        assert "wire bin=20" in service_line, service_line
+        assert "/pkl=0" in service_line, service_line
         report = render_pipeline_report(tele.snapshot())
         assert "service" in report
     finally:

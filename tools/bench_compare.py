@@ -37,14 +37,17 @@ a session whose absolute rates drifted.
 
 Service metrics (BENCH_r08+, docs/operations.md "Disaggregated ingest
 service"): ``service_ingest_samples_per_sec`` is the remote fleet's
-delivery rate (dispatcher + 2 worker subprocesses, pickle frames) and
-drifts with the host like any absolute rate;
-``service_inprocess_anchor_samples_per_sec`` is the same read through the
-in-process thread pool in the same session; their quotient
-``service_vs_inprocess_ratio`` is the SAME-SESSION-anchored, drift-immune
-member - it prices the wire-transport tax (r08: 0.36x on ~5MB pixel
-batches), so a drop in the RATIO means the service plane itself regressed
-even when both absolute rates moved with the host.
+delivery rate (dispatcher + 2 worker subprocesses) and drifts with the
+host like any absolute rate; ``service_inprocess_anchor_samples_per_sec``
+is the same read through the in-process thread pool in the same session;
+their quotient ``service_vs_inprocess_ratio`` is the SAME-SESSION-anchored,
+drift-immune member - it prices the wire-transport tax, so a drop in the
+RATIO means the service plane itself regressed even when both absolute
+rates moved with the host.  History: r08 captured 0.36x on pickled frames;
+the ISSUE 12 binary wire plane carries an ABSOLUTE floor of 0.7x for the
+remote client, and the ``service_colocated_vs_inprocess_ratio`` member
+(shm-armed co-located fleet, emitted only where the arena plane is live -
+python >= 3.12) carries 0.9x.
 
 Determinism metrics (BENCH_r09+, docs/operations.md "Reproducibility"):
 ``determinism_vs_off_ratio`` prices the ``deterministic='seed'`` reorder
@@ -86,6 +89,12 @@ ABSOLUTE_FLOORS = {
     # packer must fill >= 85% of emitted (batch, seq_len) slots
     "sequence_packed_vs_padded_ratio": 1.5,
     "sequence_packing_fill_rate": 0.85,
+    # ISSUE 12: the binary wire plane must hold a remote service client at
+    # >= 0.7x in-process (vs 0.35x on the old pickled frames), and an
+    # shm-armed co-located client at >= 0.9x (metric emitted only on
+    # runtimes where the arena plane is live, python >= 3.12)
+    "service_vs_inprocess_ratio": 0.7,
+    "service_colocated_vs_inprocess_ratio": 0.9,
 }
 
 
