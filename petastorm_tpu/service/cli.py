@@ -79,6 +79,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="file holding the shared handshake secret every"
                    " hello must present (overrides"
                    " $PETASTORM_TPU_SERVICE_TOKEN)")
+    d.add_argument("--journal", default=None, metavar="PATH",
+                   help="session journal file for WARM restarts: client"
+                   " sessions + unresolved work items replay from it on"
+                   " start, and reconnecting clients skip re-sending what"
+                   " it restored.  Crash recovery works WITHOUT it (peers"
+                   " reconstruct the state); the journal just makes a"
+                   " restart cheaper (docs/operations.md 'Fault domains')")
+    d.add_argument("--replay-buffer-mb", type=int, default=256, metavar="MB",
+                   help="cap on unacked result BODIES retained for"
+                   " reconnect replay, across all clients (default 256);"
+                   " overflow degrades the oldest to header-only and the"
+                   " owning client re-fetches on reconnect"
+                   " (service.replay_bodies_dropped)")
     d.add_argument("--compression", default=None,
                    choices=["auto", "off", "zlib"],
                    help="result-batch body compression, negotiated per"
@@ -142,7 +155,9 @@ def _run_dispatcher(args) -> int:
         assignment_deadline_s=args.assignment_deadline,
         metrics_port=args.metrics_port,
         auth_token=_auth_token(args),
-        wire_codec=args.compression)
+        wire_codec=args.compression,
+        journal_path=args.journal,
+        replay_buffer_bytes=args.replay_buffer_mb * 2 ** 20)
     dispatcher.start()
     print(f"dispatcher listening on {args.host}:{dispatcher.port}",
           flush=True)

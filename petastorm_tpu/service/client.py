@@ -142,6 +142,12 @@ class ServiceExecutor(ExecutorBase):
             allow_pickle=resolve_allow_pickle(allow_pickle_results))
         self._factory_blob: Optional[bytes] = None
         self._reconnects = 0
+        #: dispatcher boot id from the last hello_ok: a CHANGED boot on
+        #: reconnect means the dispatcher restarted and this session was
+        #: reconstructed from our ledger (service.dispatcher_restarts)
+        self._dispatcher_boot: Optional[str] = None
+        self._dispatcher_restarts = 0
+        self._warned_pickle_fallback = False
         self._last_connect_error: Optional[str] = None
         self._bytes_in_folded = 0
         self._starved_s = 0.0
@@ -175,6 +181,8 @@ class ServiceExecutor(ExecutorBase):
         self._m_frames_shm = self._telemetry.counter("service.frames_shm")
         self._m_frames_z = self._telemetry.counter(
             "service.frames_compressed")
+        self._m_disp_restarts = self._telemetry.counter(
+            "service.dispatcher_restarts")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -214,6 +222,23 @@ class ServiceExecutor(ExecutorBase):
         if not hello or hello.get("t") != "hello_ok":
             conn.close()
             raise OSError(f"dispatcher refused client hello: {hello!r}")
+        boot = hello.get("boot")
+        if boot is not None:
+            if self._dispatcher_boot is not None \
+                    and boot != self._dispatcher_boot:
+                # a NEW dispatcher process answered: our session is being
+                # reconstructed from this client's ledger (the resync
+                # below re-sends whatever the new dispatcher lacks)
+                self._dispatcher_restarts += 1
+                self._m_disp_restarts.add(1)
+                logger.warning(
+                    "dispatcher restarted (boot %s -> %s); reconstructing"
+                    " the session from the client ledger",
+                    self._dispatcher_boot, boot)
+            self._dispatcher_boot = boot
+        #: ordinals the dispatcher already holds (journal warm restart /
+        #: unacked replay): the resync skips re-sending these
+        known = set(hello.get("known") or ())
         # which data plane this client can get, and WHY - so a silently
         # dark shm fast path (e.g. python < 3.12) is visible in the log,
         # not just in a bench ratio months later
@@ -233,10 +258,18 @@ class ServiceExecutor(ExecutorBase):
         self._g_connected.set(1)
         if resume:
             # re-send every ledger item the dispatcher may never have seen
-            # (an enqueue lost with the dying connection); the dispatcher
-            # dedups by ordinal against its pending/inflight/unacked state
+            # (an enqueue lost with the dying connection, or an entire
+            # session lost with a dead dispatcher); the dispatcher dedups
+            # by ordinal against its pending/inflight/unacked state.
+            # Ordinals the hello_ok reported as `known` are skipped - a
+            # journal-armed dispatcher restart costs no re-sends at all
             with self._inflight_lock:
-                items = list(self._inflight.values())
+                items = [i for i in self._inflight.values()
+                         if getattr(i, "ordinal", None) not in known]
+                skipped = len(self._inflight) - len(items)
+            if skipped:
+                logger.info("resync skipped %d item(s) the dispatcher"
+                            " already holds (warm restart)", skipped)
             if items:
                 self._send({"t": "resync",
                             "items": [WireItem.encode(i) for i in items]})
@@ -426,6 +459,20 @@ class ServiceExecutor(ExecutorBase):
                 self._m_frames_shm.add(1)
             elif pk == "pickle":
                 self._m_frames_pkl.add(1)
+                if not self._warned_pickle_fallback:
+                    # once, on the FIRST fallback: a hot pickle path should
+                    # be a deliberate choice, not a silent default
+                    self._warned_pickle_fallback = True
+                    logger.warning(
+                        "service result for ordinal %s arrived as a PICKLE"
+                        " fallback (outside the binary wire domain) and was"
+                        " unpickled; this is metered"
+                        " (service.frames_pickle_fallback) and refusable -"
+                        " set ServiceExecutor(allow_pickle_results=False)"
+                        " or $PETASTORM_TPU_SERVICE_ALLOW_PICKLE=0 (the"
+                        " knob make_reader service readers resolve) to"
+                        " refuse such results as classified failures",
+                        msg.get("ordinal"))
             self._results.put(("ok", msg.get("ordinal"),
                                msg.get("attempt", 0), value))
             self._ack_pending.append(msg.get("ordinal"))
@@ -593,5 +640,6 @@ class ServiceExecutor(ExecutorBase):
                 "client_id": self.client_id,
                 "connected": self._connected.is_set() and not self._stopped,
                 "reconnects": self._reconnects,
+                "dispatcher_restarts": self._dispatcher_restarts,
                 "window": self._window,
                 "window_in_use": len(self._inflight)}

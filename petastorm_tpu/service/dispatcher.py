@@ -44,6 +44,40 @@ counts with) and :meth:`Dispatcher.scaling_signal` turns the aggregate into
 a grow/ok/shrink recommendation plus a ``service.scale_pressure`` gauge -
 the operator's (or an orchestrator's) cue to resize the fleet
 (docs/operations.md "Disaggregated ingest service").
+
+Crash recovery (docs/operations.md "Fault domains"): the dispatcher's
+state is **reconstructible from its peers**, so its own death is a
+recoverable event, not an epoch abort.  A fresh dispatcher starts empty;
+then
+
+* clients re-hello with their job blob and resync their per-ordinal
+  in-flight ledgers (unresolved items are re-sent; the ledger plus the
+  reader's reorder stage keep delivery exactly-once and
+  ``deterministic='seed'`` streams bit-identical through the outage) -
+  counted as ``service.sessions_reconstructed``;
+* workers rejoin (``--reconnect-attempts``) *without dropping their
+  in-flight work*: the rejoin hello reports the assignments they are
+  still executing, which the dispatcher records as **claims** so a
+  client's resync re-attaches those ordinals to the executing worker
+  instead of double-assigning them (``service.worker_rejoins`` /
+  ``service.recovered_assignments``);
+* a result finishing before its client has reconnected is buffered as an
+  **orphan** (``service.orphan_results_buffered``) and replayed the moment
+  the client's hello lands.
+
+``journal_path`` arms the optional warm restart
+(:mod:`petastorm_tpu.service.journal`): sessions replay from disk before
+the listener opens, and reconnecting clients are told which ordinals are
+already held (``hello_ok``'s ``known`` list) so their resync skips
+re-sends.
+
+Redelivery-buffer bound: unacked result *bodies* are capped at
+``replay_buffer_bytes`` (gauge ``service.replay_buffer_bytes``).  On
+overflow the oldest already-sent (or disconnected-client) bodies degrade
+to header-only tombstones (``service.replay_bodies_dropped``): they are
+dropped from the replay set and from ``known`` ordinals, which forces the
+client's resync to re-enqueue exactly those items - re-fetch instead of
+replay, bounded memory instead of an unbounded body buffer.
 """
 
 from __future__ import annotations
@@ -54,6 +88,7 @@ import os
 import socket
 import threading
 import time
+import uuid
 import zlib
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
@@ -112,9 +147,11 @@ class _ClientState:
                  "results", "requeued", "connected", "disconnected_at",
                  "codecs")
 
-    def __init__(self, client_id: str, conn: FrameSocket, factory: bytes,
-                 hostname: str, shm_ok: bool, max_requeue: int, codecs=()):
+    def __init__(self, client_id: str, conn: Optional[FrameSocket],
+                 factory: bytes, hostname: str, shm_ok: bool,
+                 max_requeue: int, codecs=()):
         self.client_id = client_id
+        #: None for a journal-restored session awaiting its reconnect
         self.conn = conn
         self.factory = factory
         self.hostname = hostname
@@ -137,7 +174,13 @@ class _ClientState:
         self.disconnected_at: Optional[float] = None
 
     def known_ordinals(self) -> Set[int]:
-        known = set(self.inflight) | set(self.unacked)
+        """Ordinals a resync must NOT re-enqueue.  Body-dropped unacked
+        tombstones (``_stale``) are excluded on purpose: their outcome can
+        no longer be replayed, so the resync re-enqueueing them IS the
+        documented re-fetch path of the bounded redelivery buffer."""
+        known = set(self.inflight)
+        known.update(o for o, out in self.unacked.items()
+                     if not out.get("_stale"))
         known.update(i.ordinal for i in self.pending)
         return known
 
@@ -172,6 +215,12 @@ class Dispatcher:
     cross-host hops only), ``'off'``, or a codec name to force it
     everywhere both ends support it.  Defaults to
     ``$PETASTORM_TPU_SERVICE_COMPRESSION`` when unset.
+    ``journal_path``: arm the warm-restart session journal (CLI
+    ``--journal``; see :mod:`petastorm_tpu.service.journal`) - cold
+    recovery from peers works without it.
+    ``replay_buffer_bytes``: cap on retained unacked result *bodies*
+    across all clients; overflow degrades the oldest to header-only
+    tombstones whose clients re-fetch on reconnect (module docstring).
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
@@ -182,7 +231,9 @@ class Dispatcher:
                  assignment_deadline_s: Optional[float] = None,
                  metrics_port: Optional[int] = None,
                  auth_token: Optional[str] = None,
-                 wire_codec: Optional[str] = None):
+                 wire_codec: Optional[str] = None,
+                 journal_path: Optional[str] = None,
+                 replay_buffer_bytes: int = 256 << 20):
         if assignment_deadline_s is not None and assignment_deadline_s <= 0:
             raise PetastormTpuError(
                 "assignment_deadline_s must be > 0 or None")
@@ -220,6 +271,23 @@ class Dispatcher:
         self._client_counter_ids: Set[str] = set()
         self._metrics_port = metrics_port
         self.metrics_server = None
+        #: identifies THIS dispatcher process across restarts: rides every
+        #: client hello_ok so peers can count service.dispatcher_restarts
+        self.boot_id = uuid.uuid4().hex[:12]
+        #: (client_id, ordinal) -> (worker name, claimed-at) for rejoining
+        #: workers' still-executing assignments whose client has not
+        #: reconnected yet (honored at resync; swept after client_grace_s)
+        self._claims: Dict[Tuple[str, int], Tuple[str, float]] = {}
+        #: (client_id, ordinal) -> (outcome frame, buffered-at) for results
+        #: that finished before their client reconnected
+        self._orphan_results: Dict[Tuple[str, int], Tuple[Dict, float]] = {}
+        #: retained result-body accounting (the bounded redelivery buffer):
+        #: insertion-ordered (cid, outcome-dict) refs + live byte total
+        self._replay_order: Deque[Tuple[str, Dict]] = collections.deque()
+        self._replay_bytes = 0
+        self._replay_cap = int(replay_buffer_bytes)
+        self._journal = None
+        self._journal_path = journal_path
         # -- service.* telemetry (rides the registry -> Prometheus/--watch) --
         tele = self.telemetry
         self._g_workers = tele.gauge("service.registered_workers")
@@ -241,12 +309,27 @@ class Dispatcher:
         self._m_frames_bin = tele.counter("service.frames_binary")
         self._m_frames_pkl = tele.counter("service.frames_pickle_fallback")
         self._m_frames_shm = tele.counter("service.frames_shm")
+        # -- crash-recovery observability (module docstring) --
+        self._m_sessions_rec = tele.counter("service.sessions_reconstructed")
+        self._m_worker_rejoins = tele.counter("service.worker_rejoins")
+        self._m_recovered = tele.counter("service.recovered_assignments")
+        self._m_resync_restored = tele.counter(
+            "service.resync_items_restored")
+        self._m_orphans = tele.counter("service.orphan_results_buffered")
+        self._m_replay_dropped = tele.counter("service.replay_bodies_dropped")
+        self._m_refetches = tele.counter("service.replay_refetches_forced")
+        self._m_journal_items = tele.counter("service.journal_items_restored")
+        self._g_replay_bytes = tele.gauge("service.replay_buffer_bytes")
 
     # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> "Dispatcher":
         """Bind the listener (``self.port`` is then live) and start the
-        accept + monitor threads; returns self for chaining."""
+        accept + monitor threads; returns self for chaining.  With a
+        ``journal_path``, sessions replay from disk BEFORE the listener
+        opens - a reconnecting client never races its own restoration."""
+        if self._journal_path is not None:
+            self._restore_journal()
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind((self._host, self._requested_port))
@@ -279,6 +362,39 @@ class Dispatcher:
                 " 'Disaggregated ingest service').", self._host)
         return self
 
+    def _restore_journal(self) -> None:
+        """Warm restart: rebuild client sessions from the journal file (see
+        :mod:`petastorm_tpu.service.journal`).  Restored clients start
+        disconnected with the grace timer running - one that never
+        reconnects purges like any dropped client."""
+        from petastorm_tpu.service.journal import ServiceJournal
+
+        self._journal = ServiceJournal(self._journal_path)
+        sessions = self._journal.load()
+        now = time.monotonic()
+        restored_items = 0
+        with self._lock:
+            for cid, session in sessions.items():
+                hello = session.hello
+                client = _ClientState(
+                    cid, None, hello.get("factory"),
+                    hello.get("hostname", ""), bool(hello.get("shm_ok")),
+                    int(hello.get("max_requeue", self._max_requeue)),
+                    codecs=hello.get("codecs") or ())
+                client.connected = False
+                client.disconnected_at = now
+                for item in session.items.values():
+                    client.pending.append(WireItem.from_wire(item))
+                    restored_items += 1
+                self._clients[cid] = client
+                self._client_order.append(cid)
+        self._journal.open()
+        if sessions:
+            self._m_journal_items.add(restored_items)
+            logger.info("journal restored %d session(s) with %d unresolved"
+                        " item(s); clients have %.0fs to reconnect",
+                        len(sessions), restored_items, self._client_grace_s)
+
     def stop(self) -> None:
         """Close the listener and every live connection; workers and
         clients see EOF immediately."""
@@ -290,11 +406,14 @@ class Dispatcher:
                 pass
         with self._lock:
             conns = ([w.conn for w in self._workers.values()]
-                     + [c.conn for c in self._clients.values() if c.connected])
+                     + [c.conn for c in self._clients.values()
+                        if c.connected and c.conn is not None])
         for conn in conns:
             conn.close()
         if self.metrics_server is not None:
             self.metrics_server.stop()
+        if self._journal is not None:
+            self._journal.close()
 
     def join(self, timeout: float = 5.0) -> None:
         """Bounded wait for the service threads after :meth:`stop`."""
@@ -405,9 +524,17 @@ class Dispatcher:
                                  codecs=hello.get("codecs") or ())
             self._workers[name] = state
             self._g_workers.set(len(self._workers))
+            recovered = self._absorb_worker_rejoin_locked(state, hello)
         conn.send({"t": "hello_ok", "worker": name})
-        logger.info("Worker %s registered (capacity %d, host %s)", name,
-                    state.capacity, state.hostname or "?")
+        if hello.get("resume"):
+            self._m_worker_rejoins.add(1)
+            logger.info("Worker %s REJOINED still executing %d item(s)"
+                        " (%d re-attached, rest claimed for reconnecting"
+                        " clients)", name,
+                        len(hello.get("assignments") or ()), recovered)
+        else:
+            logger.info("Worker %s registered (capacity %d, host %s)", name,
+                        state.capacity, state.hostname or "?")
         self._pump()
         bytes_folded = 0
         try:
@@ -432,6 +559,65 @@ class Dispatcher:
         finally:
             self._worker_gone(name)
 
+    def _absorb_worker_rejoin_locked(self, state: _WorkerState,
+                                     hello: Dict) -> int:
+        """Re-attach a rejoining worker's still-executing assignments so
+        nothing is double-assigned (caller holds the lock).
+
+        Three cases per reported ``(client, ordinal, attempt)``:
+
+        * the client is known and the ordinal is in-flight at a worker
+          that no longer exists (the pre-restart assignment) - or pending
+          (journal-restored) - the assignment moves to this worker;
+        * the client is known and the ordinal is in-flight at a LIVE other
+          worker: the dispatcher already requeued it past this worker (a
+          worker-link blip, not a dispatcher restart) - the claim is
+          stale, this worker's eventual result dedups;
+        * the client is unknown (it has not reconnected yet): recorded in
+          ``_claims`` and honored when its resync arrives.
+
+        ``jobs`` marks which client factories the worker still holds, so
+        the pump does not re-ship them.
+        """
+        state.jobs_sent.update(str(c) for c in hello.get("jobs") or ())
+        now = time.monotonic()
+        recovered = 0
+        for entry in hello.get("assignments") or ():
+            if not (isinstance(entry, (list, tuple)) and len(entry) >= 2):
+                continue
+            cid, ordinal = str(entry[0]), entry[1]
+            if not isinstance(ordinal, int):
+                continue
+            client = self._clients.get(cid)
+            if client is None:
+                self._claims[(cid, ordinal)] = (state.name, now)
+                continue
+            assign = client.inflight.get(ordinal)
+            if assign is not None:
+                holder = self._workers.get(assign.worker)
+                if holder is None or holder is state:
+                    assign.worker = state.name
+                    assign.assigned_at = now
+                    state.inflight.add((cid, ordinal))
+                    recovered += 1
+                continue
+            if ordinal in client.unacked:
+                continue  # already completed: the worker's copy will dedup
+            for i, item in enumerate(client.pending):
+                if item.ordinal == ordinal:
+                    del client.pending[i]
+                    client.inflight[ordinal] = _Assignment(item, state.name)
+                    state.inflight.add((cid, ordinal))
+                    recovered += 1
+                    break
+            else:
+                # client reconnected but its resync has not landed yet:
+                # claim now, honor at resync
+                self._claims[(cid, ordinal)] = (state.name, now)
+        if recovered:
+            self._m_recovered.add(recovered)
+        return recovered
+
     def _on_heartbeat(self, state: _WorkerState, msg: Dict) -> None:
         state.last_heartbeat = time.monotonic()
         state.busy = int(msg.get("busy", 0))
@@ -441,10 +627,58 @@ class Dispatcher:
                 if delta and cname.startswith(FLEET_COUNTER_PREFIXES):
                     self.telemetry.counter(f"service.fleet.{cname}").add(delta)
 
+    # -- bounded redelivery buffer (satellite: replay_buffer_bytes) ------------
+
+    def _retain_body_locked(self, cid: str, out: Dict) -> None:
+        """Account one buffered outcome's body toward the replay cap and
+        enforce the cap (caller holds the lock).  Only ``_body``-carrying
+        outcomes (results) count; failure frames are header-sized."""
+        body = out.get("_body")
+        if body is None:
+            return
+        self._replay_bytes += len(body)
+        self._replay_order.append((cid, out))
+        if self._replay_bytes <= self._replay_cap:
+            return
+        # ONE oldest-first pass, dropping as many eligible bodies as the
+        # overflow needs (re-walking the ineligible prefix per drop would
+        # be O(n) per retained result, under the dispatcher lock, on the
+        # relay hot path)
+        deferred = []
+        while self._replay_order and self._replay_bytes > self._replay_cap:
+            ocid, old = self._replay_order.popleft()
+            if old.get("_body") is None:
+                continue  # already acked/released: drop the tombstone
+            client = self._clients.get(ocid)
+            if old is out or (client is not None and client.connected
+                              and not old.get("_sent")):
+                # never degrade the newest entry or one still awaiting its
+                # FIRST send to a live client (the client would simply
+                # never see it); re-check next overflow
+                deferred.append((ocid, old))
+                continue
+            self._replay_bytes -= len(old["_body"])
+            del old["_body"]
+            old["_stale"] = True
+            self._m_replay_dropped.add(1)
+        self._replay_order.extendleft(reversed(deferred))
+
+    def _release_body_locked(self, out: Optional[Dict]) -> None:
+        """Free one outcome's body accounting (ack, purge, replay drop).
+        The deque entry stays behind as a tombstone; the overflow sweep
+        skips released entries."""
+        if out is None:
+            return
+        body = out.get("_body")
+        if body is not None:
+            self._replay_bytes -= len(body)
+            del out["_body"]
+
     def _on_result(self, state: _WorkerState, msg: Dict) -> None:
         cid, ordinal = msg["client"], msg["ordinal"]
         state.last_heartbeat = time.monotonic()
         duplicate = False
+        orphaned = False
         # ONE critical section from duplicate check to outcome recording:
         # splitting them would let _purge_client (grace expiry, bye) pop
         # the client in between, silently losing the result into an
@@ -452,12 +686,44 @@ class Dispatcher:
         with self._lock:
             state.inflight.discard((cid, ordinal))
             client = self._clients.get(cid)
-            if client is None or client.inflight.pop(ordinal, None) is None:
-                # late duplicate (the ordinal was requeued and its sibling
-                # delivered first, or the client was purged): drop - the
-                # client-side ledger would drop it anyway
-                duplicate = True
+            if client is None:
                 conn = None
+                claim = self._claims.pop((cid, ordinal), None)
+                if claim is not None:
+                    # a rejoined worker finished an item whose client has
+                    # not reconnected yet: buffer the outcome and replay it
+                    # the moment the client's hello lands (bounded by the
+                    # replay cap + the grace sweep)
+                    out = {k: v for k, v in msg.items() if k != "client"}
+                    out["worker"] = state.name
+                    self._orphan_results[(cid, ordinal)] = (
+                        out, time.monotonic())
+                    self._retain_body_locked(cid, out)
+                    orphaned = True
+                else:
+                    duplicate = True
+            elif client.inflight.pop(ordinal, None) is None:
+                claim = self._claims.pop((cid, ordinal), None)
+                if claim is not None:
+                    # a claimed item's result landed after the client's
+                    # hello but before its resync: record + deliver it now;
+                    # popping the claim keeps the resync from re-attaching
+                    # an ordinal the worker already finished (which would
+                    # wedge the client waiting on a result that never
+                    # comes again)
+                    out = {k: v for k, v in msg.items() if k != "client"}
+                    out["worker"] = state.name
+                    client.unacked[ordinal] = out
+                    client.results += 1
+                    client.rows += int(msg.get("rows", 0))
+                    self._retain_body_locked(cid, out)
+                    conn = client.conn if client.connected else None
+                else:
+                    # late duplicate (the ordinal was requeued and its
+                    # sibling delivered first, or the client was purged):
+                    # drop - the client-side ledger would drop it anyway
+                    duplicate = True
+                    conn = None
             else:
                 # buffer relay: forward the worker's result header verbatim
                 # (minus its routing field) with the column payload as
@@ -467,6 +733,7 @@ class Dispatcher:
                 client.unacked[ordinal] = out
                 client.results += 1
                 client.rows += int(msg.get("rows", 0))
+                self._retain_body_locked(cid, out)
                 conn = client.conn if client.connected else None
         pk = msg.get("pk")
         if pk == "bin":
@@ -481,6 +748,12 @@ class Dispatcher:
             # buffer would stall every other connection's thread)
             self._m_dup.add(1)
             self._stamp_gauges()
+            self._pump()
+            return
+        if orphaned:
+            self._m_orphans.add(1)
+            self._m_completed.add(1)
+            self._m_rows.add(int(msg.get("rows", 0)))
             self._pump()
             return
         self._m_completed.add(1)
@@ -507,12 +780,19 @@ class Dispatcher:
         state.last_heartbeat = time.monotonic()
         with self._lock:
             state.inflight.discard((cid, ordinal))
+            # drop any claim for this item: a claimed item failing is
+            # resolved by the client's resync re-enqueueing it (the fresh
+            # dispatcher never saw the blob, so re-execution IS its
+            # requeue path) - a dangling claim would re-attach the ordinal
+            # to a worker that no longer holds it and wedge the client
+            claim = self._claims.pop((cid, ordinal), None)
             client = self._clients.get(cid)
             if client is None:
                 return
             assign = client.inflight.pop(ordinal, None)
             if assign is None:
-                self._m_dup.add(1)
+                if claim is None:
+                    self._m_dup.add(1)
                 return
         # failures are plain fields on the wire (formatted traceback, kind,
         # exc_type) - no object envelope; the client recovers the failed
@@ -615,6 +895,8 @@ class Dispatcher:
             conn.close()
             return
         cid = hello["client"]
+        resumed = bool(hello.get("resume"))
+        refetch = 0
         with self._lock:
             client = self._clients.get(cid)
             if client is None:
@@ -625,21 +907,61 @@ class Dispatcher:
                     codecs=hello.get("codecs") or ())
                 self._clients[cid] = client
                 self._client_order.append(cid)
-                logger.info("Client %s registered", cid)
+                if resumed:
+                    # a client that WAS mid-session re-helloing to a
+                    # dispatcher that has never seen it: the restart
+                    # recovery path (its resync reconstructs the session)
+                    self._m_sessions_rec.add(1)
+                    logger.info("Client %s session reconstructed after a"
+                                " dispatcher restart", cid)
+                else:
+                    logger.info("Client %s registered", cid)
             else:
                 # reconnect: swap the connection in, replay unacked outcomes
                 old = client.conn
                 client.conn = conn
                 client.connected = True
                 client.disconnected_at = None
-                if old is not conn:
+                if old is not None and old is not conn:
                     old.close()
                 logger.info("Client %s reconnected (%d unacked outcome(s)"
                             " to replay)", cid, len(client.unacked))
-            replay = list(client.unacked.values())
+            # adopt any orphan results a rejoined worker finished while
+            # this client was away (they replay below like unacked ones)
+            for key in [k for k in self._orphan_results if k[0] == cid]:
+                out, _ts = self._orphan_results.pop(key)
+                if not out.get("_stale"):
+                    client.unacked[key[1]] = out
+                    client.results += 1
+                    client.rows += int(out.get("rows", 0))
+            replay = []
+            for ordinal in list(client.unacked):
+                out = client.unacked[ordinal]
+                if out.get("_stale"):
+                    # body degraded under the replay cap: cannot replay;
+                    # dropping it here + excluding it from `known` forces
+                    # the client's resync to re-enqueue it (re-fetch)
+                    del client.unacked[ordinal]
+                    refetch += 1
+                else:
+                    replay.append(out)
+            known = sorted(client.known_ordinals())
             self._g_clients.set(
                 sum(1 for c in self._clients.values() if c.connected))
-        conn.send({"t": "hello_ok", "client": cid})
+        if refetch:
+            self._m_refetches.add(refetch)
+        if self._journal is not None:
+            self._journal.append_hello(cid, {
+                "factory": hello.get("factory"),
+                "hostname": hello.get("hostname", ""),
+                "shm_ok": bool(hello.get("shm_ok")),
+                "max_requeue": int(hello.get("max_requeue",
+                                             self._max_requeue)),
+                "codecs": list(hello.get("codecs") or ())})
+        # `boot` lets the client count dispatcher restarts; `known` lets a
+        # warm-restarted (journaled) session skip resync re-sends
+        conn.send({"t": "hello_ok", "client": cid, "boot": self.boot_id,
+                   "known": known})
         for out in replay:
             self._send_to_client(cid, conn, out)
         self._pump()
@@ -654,13 +976,19 @@ class Dispatcher:
                     continue
                 kind = msg.get("t")
                 if kind == "enqueue":
+                    item = WireItem.from_wire(msg["item"])
                     with self._lock:
-                        client.pending.append(WireItem.from_wire(msg["item"]))
+                        client.pending.append(item)
+                    if self._journal is not None:
+                        self._journal.append_enqueue(cid, item.to_wire())
                     self._pump()
                 elif kind == "ack":
                     with self._lock:
                         for ordinal in msg["ordinals"]:
-                            client.unacked.pop(ordinal, None)
+                            self._release_body_locked(
+                                client.unacked.pop(ordinal, None))
+                    if self._journal is not None:
+                        self._journal.append_ack(cid, msg["ordinals"])
                 elif kind == "resync":
                     self._on_resync(client, msg)
                 elif kind == "client_stats":
@@ -689,31 +1017,64 @@ class Dispatcher:
                 conn.close()
 
     def _on_resync(self, client: _ClientState, msg: Dict) -> None:
-        """Reconnect recovery: re-enqueue any ledger item the dispatcher has
-        no record of (an ``enqueue`` frame lost in the dying connection)."""
+        """Reconnect recovery: re-enqueue any ledger item the dispatcher
+        has no record of (an ``enqueue`` frame lost in the dying
+        connection, or a whole session lost with a dead dispatcher).  An
+        item a rejoined worker CLAIMED re-attaches to that worker instead
+        of pending - the executing copy is the assignment; nothing is
+        double-assigned."""
+        cid = client.client_id
+        journal_items = []
         with self._lock:
             known = client.known_ordinals()
-            restored = 0
+            restored = reattached = 0
             for entry in msg.get("items", ()):
                 item = WireItem.from_wire(entry)
-                if item.ordinal not in known:
+                if item.ordinal in known:
+                    continue
+                claim = self._claims.pop((cid, item.ordinal), None)
+                worker = (self._workers.get(claim[0])
+                          if claim is not None else None)
+                if worker is not None and not worker.gone:
+                    client.inflight[item.ordinal] = _Assignment(
+                        item, worker.name)
+                    worker.inflight.add((cid, item.ordinal))
+                    reattached += 1
+                else:
                     client.pending.append(item)
                     restored += 1
+                journal_items.append(item.to_wire())
+        if self._journal is not None:
+            for fields in journal_items:
+                self._journal.append_enqueue(cid, fields)
+        if reattached:
+            self._m_recovered.add(reattached)
         if restored:
-            logger.info("Client %s resync restored %d lost work item(s)",
-                        client.client_id, restored)
+            self._m_resync_restored.add(restored)
+        if restored or reattached:
+            logger.info("Client %s resync restored %d lost work item(s)"
+                        " (+%d re-attached to executing workers)",
+                        cid, restored, reattached)
         self._pump()
 
     def _send_to_client(self, cid: str, conn: FrameSocket, out: Dict) -> None:
         try:
-            if "_body" in out:
+            body = out.get("_body")
+            if body is not None:
                 # result relay: re-frame the header, forward the payload
-                # bytes untouched (vectored write - no staging copy)
-                header = {k: v for k, v in out.items() if k != "_body"}
-                self._m_bytes_out.add(
-                    conn.send_batch(header, [out["_body"]]))
+                # bytes untouched (vectored write - no staging copy).
+                # Underscore keys are dispatcher-local bookkeeping
+                # (_body/_sent/_stale) and never ride the wire.
+                header = {k: v for k, v in out.items()
+                          if not k.startswith("_")}
+                self._m_bytes_out.add(conn.send_batch(header, [body]))
             else:
-                self._m_bytes_out.add(conn.send(out))
+                self._m_bytes_out.add(conn.send(
+                    {k: v for k, v in out.items()
+                     if not k.startswith("_")}))
+            # a sent body is eligible for the replay-cap degrade: losing
+            # it costs a re-fetch only if the delivery ALSO got lost
+            out["_sent"] = True
         except OSError:
             # connection died mid-send: the outcome stays in unacked and
             # replays on reconnect; the client read loop marks disconnect
@@ -728,6 +1089,13 @@ class Dispatcher:
             if cid in self._client_order:
                 self._client_order.remove(cid)
             dropped = len(client.pending) + len(client.inflight)
+            for out in client.unacked.values():
+                self._release_body_locked(out)
+            for key in [k for k in self._claims if k[0] == cid]:
+                del self._claims[key]
+            for key in [k for k in self._orphan_results if k[0] == cid]:
+                out, _ts = self._orphan_results.pop(key)
+                self._release_body_locked(out)
             for worker in self._workers.values():
                 worker.inflight = {(c, o) for c, o in worker.inflight
                                    if c != cid}
@@ -735,12 +1103,15 @@ class Dispatcher:
                     notify.append(worker.conn)
             self._g_clients.set(sum(1 for c in self._clients.values()
                                     if c.connected))
+        if self._journal is not None:
+            self._journal.append_purge(cid)
         for conn in notify:  # sends stay outside the dispatcher lock
             try:
                 conn.send({"t": "job_done", "client": cid})
             except OSError:
                 pass
-        client.conn.close()
+        if client.conn is not None:
+            client.conn.close()
         logger.info("Client %s purged (%s; %d undelivered item(s) dropped)",
                     cid, reason, dropped)
         self._stamp_gauges()
@@ -843,8 +1214,15 @@ class Dispatcher:
         with self._lock:
             pending = sum(len(c.pending) for c in self._clients.values())
             inflight = sum(len(c.inflight) for c in self._clients.values())
+            replay_bytes = self._replay_bytes
+            # drop released tombstones off the front of the accounting
+            # deque so it tracks live entries, not history
+            while self._replay_order \
+                    and self._replay_order[0][1].get("_body") is None:
+                self._replay_order.popleft()
         self._g_pending.set(pending)
         self._g_inflight.set(inflight)
+        self._g_replay_bytes.set(replay_bytes)
 
     # -- monitoring / scaling -------------------------------------------------
 
@@ -871,6 +1249,15 @@ class Dispatcher:
                 expired = [cid for cid, c in self._clients.items()
                            if not c.connected and c.disconnected_at is not None
                            and now - c.disconnected_at > self._client_grace_s]
+                # recovery leftovers whose client never reconnected: claims
+                # and orphan results age out on the same grace clock
+                for key in [k for k, (_w, ts) in self._claims.items()
+                            if now - ts > self._client_grace_s]:
+                    del self._claims[key]
+                for key in [k for k, (_o, ts) in self._orphan_results.items()
+                            if now - ts > self._client_grace_s]:
+                    out, _ts = self._orphan_results.pop(key)
+                    self._release_body_locked(out)
             for name in dead:
                 logger.warning("Worker %s missed heartbeats for %.0fs;"
                                " declaring it dead", name,
@@ -955,6 +1342,13 @@ class Dispatcher:
             counters = {k: v for k, v in
                         self.telemetry.snapshot()["counters"].items()
                         if k.startswith("service.")}
+        with self._lock:
+            recovery = {"claims": len(self._claims),
+                        "orphan_results": len(self._orphan_results),
+                        "replay_buffer_bytes": self._replay_bytes,
+                        "journal": self._journal_path}
         return {"uptime_s": round(time.monotonic() - self._started_at, 1),
-                "port": self.port, "workers": workers, "clients": clients,
+                "port": self.port, "boot": self.boot_id,
+                "workers": workers, "clients": clients,
+                "recovery": recovery,
                 "counters": counters, "scaling": self.scaling_signal()}

@@ -1,0 +1,217 @@
+"""Dispatcher session journal: optional warm restarts that skip re-sends.
+
+Dispatcher crash recovery does NOT need this file: a fresh dispatcher
+reconstructs its sessions from its peers (clients re-hello with their job
+blob and re-send unresolved ledger items; workers rejoin and report what
+they are still executing - see :mod:`petastorm_tpu.service.dispatcher`).
+The journal is the *warm* variant: with ``Dispatcher(journal_path=...)``
+(CLI ``--journal``) the control-plane events that define a session -
+client hellos, enqueued work items, acks, purges - are appended to a
+length-prefixed :mod:`petastorm_tpu.service.wire` record file, and a
+restarted dispatcher replays it into ready-to-serve client sessions before
+it accepts a single connection.  A reconnecting client is then told (via
+``hello_ok``'s ``known`` ordinal list) which of its ledger items the
+dispatcher already holds, so its resync skips re-sending them - the
+restart costs one reconnect handshake instead of a window's worth of
+re-enqueues.
+
+Only control-plane state is journaled.  Result *bodies* (the multi-MB
+column payloads in the redelivery buffer) never touch the journal: a
+journal-restored item that was delivered-but-unacked at crash time simply
+re-executes, and the client's per-ordinal ledger drops the duplicate -
+exactly the cold-recovery semantics, paid only for the ack-batch-sized
+tail.  Requeue ``attempt`` counters restore from the *enqueued* value, so
+a restart is slightly generous to items that were mid-requeue (documented,
+deliberate: the budget is a safety valve, not an exactness invariant).
+
+Durability is flush-per-record, not fsync: a host power-loss can truncate
+the tail, and :meth:`ServiceJournal.load` stops cleanly at the first
+short/undecodable record (peer reconstruction covers whatever the tail
+lost).  The file auto-compacts - acked items are dropped and the journal
+rewritten - once the append log outgrows its live state 4x.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+from petastorm_tpu.service import wire
+from petastorm_tpu.service.wire import WireFormatError
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("!I")
+#: a single journal record larger than this is a corrupt length prefix
+#: (records are hellos and work-item stubs, tens of KB at most)
+_MAX_RECORD = 64 << 20
+#: compact when the file exceeds this AND 4x the live-state size
+_COMPACT_MIN_BYTES = 4 << 20
+
+
+class _Session:
+    """In-memory mirror of one client's journaled state (the compaction
+    source and the restart payload)."""
+
+    __slots__ = ("hello", "items")
+
+    def __init__(self, hello: Dict[str, Any]):
+        self.hello = hello
+        #: ordinal -> work-item wire fields, insertion-ordered (the replay
+        #: re-enqueues in the order the client ventilated)
+        self.items: "collections.OrderedDict[int, Dict]" = \
+            collections.OrderedDict()
+
+
+class ServiceJournal:
+    """Append-only session journal for one dispatcher (see module doc).
+
+    Lifecycle: ``load()`` parses any existing file into session dicts (the
+    dispatcher turns them into client states), then ``open()`` compacts and
+    starts appending.  All methods are thread-safe; appends flush so an
+    ordinary process death (the recovery scenario) loses nothing.
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        self._fh = None
+        self._bytes = 0
+        self._sessions: Dict[str, _Session] = {}
+
+    # -- restart side ----------------------------------------------------------
+
+    def load(self) -> Dict[str, _Session]:
+        """Parse the journal (tolerating a truncated tail) into sessions;
+        returns ``{client_id: _Session}``.  Call before :meth:`open`."""
+        if not os.path.exists(self._path):
+            return {}
+        records = 0
+        with open(self._path, "rb") as fh:
+            while True:
+                hdr = fh.read(_LEN.size)
+                if len(hdr) < _LEN.size:
+                    break
+                (length,) = _LEN.unpack(hdr)
+                if length > _MAX_RECORD:
+                    logger.warning("journal %s: corrupt record length %d;"
+                                   " stopping replay here", self._path, length)
+                    break
+                body = fh.read(length)
+                if len(body) < length:
+                    break  # crash-truncated tail: expected, not an error
+                try:
+                    rec = wire.loads(body)
+                except WireFormatError:
+                    logger.warning("journal %s: undecodable record after %d"
+                                   " good one(s); stopping replay here",
+                                   self._path, records)
+                    break
+                if isinstance(rec, dict):
+                    self._apply(rec)
+                    records += 1
+        logger.info("journal %s: replayed %d record(s) into %d session(s),"
+                    " %d unresolved item(s)", self._path, records,
+                    len(self._sessions),
+                    sum(len(s.items) for s in self._sessions.values()))
+        return dict(self._sessions)
+
+    def _apply(self, rec: Dict[str, Any]) -> None:
+        kind, cid = rec.get("r"), rec.get("client")
+        if not isinstance(cid, str):
+            return
+        if kind == "hello":
+            session = self._sessions.get(cid)
+            if session is None:
+                self._sessions[cid] = _Session(rec)
+            else:
+                session.hello = rec  # reconnects refresh the job blob
+        elif kind == "enq":
+            session = self._sessions.get(cid)
+            item = rec.get("item")
+            if session is not None and isinstance(item, dict) \
+                    and isinstance(item.get("o"), int):
+                self._sessions[cid].items[item["o"]] = item
+        elif kind == "ack":
+            session = self._sessions.get(cid)
+            if session is not None:
+                for ordinal in rec.get("ordinals") or ():
+                    session.items.pop(ordinal, None)
+        elif kind == "purge":
+            self._sessions.pop(cid, None)
+
+    # -- append side -----------------------------------------------------------
+
+    def open(self) -> "ServiceJournal":
+        """Compact-rewrite the loaded state and start appending."""
+        with self._lock:
+            self._rewrite_locked()
+        return self
+
+    def append_hello(self, cid: str, hello: Dict[str, Any]) -> None:
+        self._append(dict(hello, r="hello", client=cid))
+
+    def append_enqueue(self, cid: str, item: Dict[str, Any]) -> None:
+        self._append({"r": "enq", "client": cid, "item": item})
+
+    def append_ack(self, cid: str, ordinals) -> None:
+        self._append({"r": "ack", "client": cid, "ordinals": list(ordinals)})
+
+    def append_purge(self, cid: str) -> None:
+        self._append({"r": "purge", "client": cid})
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        try:
+            encoded = wire.dumps(rec)
+        except WireFormatError:
+            # a hello with out-of-domain extras must not kill the control
+            # plane; the session just won't warm-restart
+            logger.warning("journal: unencodable record dropped (%r)",
+                           rec.get("r"))
+            return
+        with self._lock:
+            self._apply(rec)
+            if self._fh is None:
+                return  # load-only phase (applied to the mirror regardless)
+            self._fh.write(_LEN.pack(len(encoded)) + encoded)
+            self._fh.flush()
+            self._bytes += _LEN.size + len(encoded)
+            if self._bytes > _COMPACT_MIN_BYTES \
+                    and self._bytes > 4 * self._live_bytes_locked():
+                self._rewrite_locked()
+
+    def _live_bytes_locked(self) -> int:
+        total = 0
+        for session in self._sessions.values():
+            total += len(session.hello.get("factory") or b"") + 256
+            for item in session.items.values():
+                total += len(item.get("blob") or b"") + 64
+        return total
+
+    def _rewrite_locked(self) -> None:
+        """Rewrite the file from the live mirror (compaction + open)."""
+        if self._fh is not None:
+            self._fh.close()
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as fh:
+            size = 0
+            for cid, session in self._sessions.items():
+                for rec in ([session.hello]
+                            + [{"r": "enq", "client": cid, "item": item}
+                               for item in session.items.values()]):
+                    encoded = wire.dumps(rec)
+                    fh.write(_LEN.pack(len(encoded)) + encoded)
+                    size += _LEN.size + len(encoded)
+        os.replace(tmp, self._path)
+        self._fh = open(self._path, "ab")
+        self._bytes = size
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

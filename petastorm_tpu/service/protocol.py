@@ -16,7 +16,13 @@ then the body:
   ======================  =====================================================
   ``client_hello``        client -> dispatcher: client_id, opaque worker
                           factory blob, hostname, shm capability, accepted
-                          codecs, requeue budget, ``resume`` flag
+                          codecs, requeue budget, ``resume`` flag.  The
+                          ``hello_ok`` reply carries the dispatcher's
+                          ``boot`` id (clients count
+                          ``service.dispatcher_restarts`` off a change)
+                          and the ``known`` ordinal list (a journal-armed
+                          warm restart tells the client which resync
+                          re-sends to skip)
   ``enqueue``             client -> dispatcher: one work item
                           (:class:`WireItem` fields - structural ordinal/
                           attempt/rowgroup metadata + an opaque item blob)
@@ -29,7 +35,13 @@ then the body:
                           (fleet-size pressure - Dispatcher.scaling_signal)
   ``bye``                 client -> dispatcher: clean goodbye (purge state)
   ``worker_hello``        worker -> dispatcher: name, capacity, hostname,
-                          codecs
+                          codecs; on a REJOIN (dispatcher restart / link
+                          blip survived with ``reconnect_attempts``) also
+                          ``resume`` plus the ``assignments`` it is still
+                          executing and the client ``jobs`` it holds - the
+                          dispatcher records claims so a reconnecting
+                          client's resync re-attaches instead of
+                          double-assigning
   ``heartbeat``           worker -> dispatcher: busy count + telemetry counter
                           deltas (folded into ``service.fleet.*``)
   ``failure``             worker -> dispatcher -> client: one item's classified

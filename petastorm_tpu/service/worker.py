@@ -24,10 +24,23 @@ Crash semantics match the process pool: an exception whose
 hard-kill injection) exits the process with ``os._exit`` - no result, no
 goodbye - and the dispatcher's death detection requeues the in-flight
 items onto surviving workers.
+
+Dispatcher-restart survival (``reconnect_attempts > 0``): losing the
+dispatcher connection does NOT drop this worker's state.  The processor
+threads keep executing their in-flight items through the outage; finished
+outcomes buffer in a bounded outbox; and the rejoin hello reports the
+still-held assignments plus the client jobs this process already holds -
+the restarted dispatcher records them as claims so a reconnecting client's
+resync re-attaches those ordinals here instead of double-assigning them,
+then the outbox flushes (docs/operations.md "Fault domains").  An outcome
+the outbox must shed (overflow) simply forgets its assignment: the
+client's resync re-enqueues that item and it re-executes - correctness by
+re-fetch, never by unbounded buffering.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import pickle
@@ -35,7 +48,7 @@ import queue
 import socket
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.pool import VentilatedItem, _Failure
@@ -49,6 +62,11 @@ from petastorm_tpu.telemetry import Telemetry
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
+
+#: outbox bounds while disconnected: results past these are shed oldest-
+#: first (their assignments are forgotten, so clients re-fetch them)
+OUTBOX_MAX_ITEMS = 512
+OUTBOX_MAX_BYTES = 256 << 20
 
 
 def _inject_telemetry(factory: Any, telemetry) -> None:
@@ -77,12 +95,18 @@ class ServiceWorker:
     co-located clients are encoded into a named shared-memory arena
     (descriptor on the wire, zero-copy decode client-side) when the native
     transport plane is available - remote clients always get plain frame
-    payloads.
+    payloads.  ``reconnect_attempts`` > 0 makes a lost dispatcher
+    connection a recoverable event instead of a worker exit: in-flight
+    work keeps executing, registration retries every
+    ``reconnect_backoff_s``, and the rejoin reports held assignments/jobs
+    (module docstring).
     """
 
     def __init__(self, address, capacity: int = 2, name: Optional[str] = None,
                  telemetry=None, heartbeat_interval_s: float = 2.0,
-                 shm_size_bytes: int = 0, auth_token: Optional[str] = None):
+                 shm_size_bytes: int = 0, auth_token: Optional[str] = None,
+                 reconnect_attempts: int = 0,
+                 reconnect_backoff_s: float = 1.0):
         if capacity < 1:
             raise PetastormTpuError("ServiceWorker capacity must be >= 1")
         self._address = parse_address(address)
@@ -99,17 +123,31 @@ class ServiceWorker:
         self._shm_size_bytes = int(shm_size_bytes)
         self._arena = None
         self._stop_event = threading.Event()
+        self._reconnect_attempts = int(reconnect_attempts)
+        self._reconnect_backoff_s = float(reconnect_backoff_s)
         self._conn: Optional[FrameSocket] = None
+        self._conn_lock = threading.Lock()
+        self._connected = threading.Event()
         self._work: "queue.Queue[tuple]" = queue.Queue()
         self._busy = 0
         self._busy_lock = threading.Lock()
         self._jobs: Dict[str, Dict] = {}   # cid -> {"factory": blob, "shm_ok"}
         self._fns: Dict[str, Any] = {}     # cid -> built fn
         self._fn_lock = threading.Lock()
+        #: (cid, ordinal) -> attempt for every item this worker holds -
+        #: queued or executing - until its outcome reaches a LIVE
+        #: connection.  Reported on rejoin so nothing is double-assigned.
+        self._held: Dict[Tuple[str, int], int] = {}
+        self._held_lock = threading.Lock()
+        #: outcomes finished while disconnected: (kind, header, parts, key)
+        self._outbox: "collections.deque" = collections.deque()
+        self._outbox_bytes = 0
         self._hb_snapshot: Dict[str, float] = {}
         self._threads = []
+        self._threads_started = False
         self.worker_name: Optional[str] = None
         self.items_processed = 0
+        self.dispatcher_reconnects = 0
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -117,39 +155,100 @@ class ServiceWorker:
         """Stop serving: close the dispatcher connection (in-flight items
         are requeued onto surviving workers by the dispatcher)."""
         self._stop_event.set()
-        if self._conn is not None:
-            self._conn.close()
+        self._connected.clear()
+        conn = self._conn
+        if conn is not None:
+            conn.close()
 
     def run(self) -> int:
-        """Connect, register, and serve until the dispatcher goes away or
-        :meth:`stop` is called.  Returns an exit code (0 = clean)."""
+        """Connect, register, and serve until the dispatcher goes away
+        (for longer than the reconnect budget) or :meth:`stop` is called.
+        Returns an exit code (0 = clean, 1 = never registered)."""
+        attempts_left = self._reconnect_attempts
+        registered_once = False
         try:
-            conn = connect_frames(self._address)
-        except OSError as exc:
-            logger.error("Cannot reach dispatcher at %s:%d: %s",
-                         self._address[0], self._address[1], exc)
-            return 1
-        self._conn = conn
-        try:
-            conn.send({"t": "worker_hello", "protocol": PROTOCOL_VERSION,
-                       "worker": self._name, "capacity": self._capacity,
-                       "hostname": socket.gethostname(), "pid": os.getpid(),
-                       "codecs": list(SUPPORTED_CODECS),
-                       "token": self._auth_token})
-            hello = conn.recv(timeout=10.0)
-        except (OSError, PetastormTpuError) as exc:
-            # a dispatcher mid-restart can accept then reset inside the
-            # hello; surface it as a failed registration (exit code 1) so
-            # run_worker's reconnect loop retries instead of crashing
-            logger.error("Registration handshake failed: %s", exc)
-            conn.close()
-            return 1
+            while not self._stop_event.is_set():
+                conn = None
+                try:
+                    conn = connect_frames(self._address)
+                    self._register(conn)
+                except (OSError, PetastormTpuError) as exc:
+                    # covers unreachable/refused dispatchers AND a
+                    # dispatcher mid-restart that accepts then resets
+                    # inside the hello
+                    if conn is not None:
+                        conn.close()
+                    if attempts_left <= 0:
+                        if registered_once:
+                            logger.warning(
+                                "Dispatcher gone and the reconnect budget"
+                                " is spent; worker exiting (%s)", exc)
+                            return 0
+                        logger.error("Cannot register with dispatcher at"
+                                     " %s:%d: %s", self._address[0],
+                                     self._address[1], exc)
+                        return 1
+                    attempts_left -= 1
+                    logger.info("Dispatcher unavailable (%s); retrying"
+                                " registration in %.1fs (%d attempt(s)"
+                                " left)", exc, self._reconnect_backoff_s,
+                                attempts_left + 1)
+                    self._stop_event.wait(self._reconnect_backoff_s)
+                    continue
+                if registered_once:
+                    self.dispatcher_reconnects += 1
+                registered_once = True
+                attempts_left = self._reconnect_attempts  # reset on success
+                self._start_threads()
+                self._attach(conn)
+                self._serve(conn)
+                with self._conn_lock:
+                    self._connected.clear()
+                conn.close()
+                if self._stop_event.is_set() or attempts_left <= 0:
+                    break
+        finally:
+            self.stop()
+            if self._arena is not None:
+                self._arena.close()
+        return 0 if registered_once else 1
+
+    def _register(self, conn: FrameSocket) -> None:
+        """One registration handshake; raises OSError/PetastormTpuError on
+        refusal.  A re-registration (rejoin) reports held assignments and
+        jobs so the dispatcher can re-attach instead of double-assigning."""
+        with self._held_lock:
+            assignments = [[cid, ordinal, attempt]
+                           for (cid, ordinal), attempt in self._held.items()]
+        with self._fn_lock:
+            jobs = list(self._jobs)
+        resume = self.worker_name is not None
+        conn.send({"t": "worker_hello", "protocol": PROTOCOL_VERSION,
+                   "worker": self._name or self.worker_name,
+                   "capacity": self._capacity,
+                   "hostname": socket.gethostname(), "pid": os.getpid(),
+                   "codecs": list(SUPPORTED_CODECS),
+                   "token": self._auth_token,
+                   "resume": resume,
+                   "assignments": assignments, "jobs": jobs})
+        hello = conn.recv(timeout=10.0)
         if not hello or hello.get("t") != "hello_ok":
-            logger.error("Dispatcher refused registration: %r", hello)
-            return 1
+            raise PetastormTpuError(
+                f"dispatcher refused registration: {hello!r}")
         self.worker_name = hello.get("worker")
-        logger.info("Registered with dispatcher as %s (capacity %d)",
-                    self.worker_name, self._capacity)
+        if resume:
+            logger.info("Rejoined dispatcher as %s (still holding %d"
+                        " item(s), %d buffered outcome(s))",
+                        self.worker_name, len(assignments),
+                        len(self._outbox))
+        else:
+            logger.info("Registered with dispatcher as %s (capacity %d)",
+                        self.worker_name, self._capacity)
+
+    def _start_threads(self) -> None:
+        if self._threads_started:
+            return
+        self._threads_started = True
         for i in range(self._capacity):
             t = threading.Thread(target=self._processor_loop, daemon=True,
                                  name=f"petastorm-tpu-service-proc-{i}")
@@ -159,6 +258,17 @@ class ServiceWorker:
                               name="petastorm-tpu-service-heartbeat")
         hb.start()
         self._threads.append(hb)
+
+    def _attach(self, conn: FrameSocket) -> None:
+        """Swap the live connection in and flush buffered outcomes."""
+        with self._conn_lock:
+            self._conn = conn
+            self._connected.set()
+        self._flush_outbox()
+
+    def _serve(self, conn: FrameSocket) -> None:
+        """The dispatcher read loop for one connection; returns when it is
+        lost (the run loop decides between reconnect and exit)."""
         try:
             while not self._stop_event.is_set():
                 msg = conn.recv(timeout=1.0)
@@ -181,25 +291,24 @@ class ServiceWorker:
                     wi = msg["item"]
                     item = VentilatedItem(wi["o"], pickle.loads(wi["blob"]),
                                           wi.get("a", 0))
-                    self._work.put((msg["client"], item))
+                    cid = msg["client"]
+                    with self._held_lock:
+                        self._held[(cid, item.ordinal)] = item.attempt
+                    self._work.put((cid, item))
                 elif kind == "job_done":
                     with self._fn_lock:
                         self._jobs.pop(msg["client"], None)
                         self._fns.pop(msg["client"], None)
                 elif kind == "stop":
+                    self._stop_event.set()
                     break
         except FrameClosedError:
             if not self._stop_event.is_set():
-                logger.warning("Dispatcher connection closed; worker exiting")
+                logger.warning("Dispatcher connection closed")
         except WireFormatError:
             if not self._stop_event.is_set():
                 logger.warning("Dispatcher sent an undecodable frame;"
-                               " worker exiting", exc_info=True)
-        finally:
-            self.stop()
-            if self._arena is not None:
-                self._arena.close()
-        return 0
+                               " dropping the connection", exc_info=True)
 
     # -- processing -----------------------------------------------------------
 
@@ -296,7 +405,8 @@ class ServiceWorker:
                                 "service.encode", t0,
                                 time.perf_counter_ns() - t0,
                                 {"ordinal": ordinal, "pk": header["pk"]})
-                        self._send_batch(header, parts)
+                        self._deliver("batch", header, parts,
+                                      key=(cid, ordinal))
                     except Exception as exc:  # noqa: BLE001 - must answer
                         # an unencodable result (unpicklable transform
                         # output, oversize frame) must become a classified
@@ -320,25 +430,101 @@ class ServiceWorker:
                 with self._busy_lock:
                     self._busy -= 1
 
+    # -- outcome delivery (live or buffered across a dispatcher outage) -------
+
+    def _deliver(self, kind: str, header: Dict, parts,
+                 key: Optional[Tuple[str, int]] = None) -> None:
+        """Send one outcome on the live connection, or buffer it in the
+        bounded outbox while disconnected.  ``key`` is the held-assignment
+        entry the outcome resolves; it is released only once the outcome
+        reaches a live connection (or is shed with its outcome)."""
+        with self._conn_lock:
+            conn = self._conn if self._connected.is_set() else None
+        if conn is not None:
+            try:
+                if kind == "batch":
+                    conn.send_batch(header, parts)
+                else:
+                    conn.send(header)
+                self._release_held(key)
+                return
+            except OSError:
+                with self._conn_lock:
+                    if self._conn is conn:
+                        self._connected.clear()
+                conn.close()
+        if self._reconnect_attempts <= 0:
+            # no rejoin coming: the dispatcher's death detection requeues
+            # our items; buffering would just hold memory until exit
+            self._release_held(key)
+            return
+        self._outbox_push(kind, header, parts, key)
+
+    def _release_held(self, key) -> None:
+        if key is None:
+            return
+        with self._held_lock:
+            self._held.pop(key, None)
+
+    def _outbox_push(self, kind: str, header: Dict, parts, key) -> None:
+        size = sum(len(p) for p in parts or ())
+        with self._held_lock:
+            self._outbox.append((kind, header, parts, key, size))
+            self._outbox_bytes += size
+            while self._outbox and (len(self._outbox) > OUTBOX_MAX_ITEMS
+                                    or self._outbox_bytes > OUTBOX_MAX_BYTES):
+                _k, _h, _p, old_key, old_size = self._outbox.popleft()
+                self._outbox_bytes -= old_size
+                if old_key is not None:
+                    # shedding the outcome forgets the assignment too: the
+                    # client's resync re-enqueues it (re-fetch, not a hang)
+                    self._held.pop(old_key, None)
+                logger.warning("outbox overflow while disconnected: shed one"
+                               " buffered outcome (client will re-fetch)")
+
+    def _flush_outbox(self) -> None:
+        """Drain buffered outcomes onto the fresh connection (rejoin)."""
+        while True:
+            with self._held_lock:
+                if not self._outbox:
+                    return
+                kind, header, parts, key, size = self._outbox.popleft()
+                self._outbox_bytes -= size
+            with self._conn_lock:
+                conn = self._conn if self._connected.is_set() else None
+            if conn is None:
+                with self._held_lock:
+                    self._outbox.appendleft((kind, header, parts, key, size))
+                    self._outbox_bytes += size
+                return
+            try:
+                if kind == "batch":
+                    conn.send_batch(header, parts)
+                else:
+                    conn.send(header)
+                self._release_held(key)
+            except OSError:
+                with self._held_lock:
+                    self._outbox.appendleft((kind, header, parts, key, size))
+                    self._outbox_bytes += size
+                with self._conn_lock:
+                    if self._conn is conn:
+                        self._connected.clear()
+                return
+
     def _send(self, msg: Dict) -> None:
-        conn = self._conn
+        """Best-effort control send on the live connection (heartbeats):
+        never buffered, dropped while disconnected."""
+        with self._conn_lock:
+            conn = self._conn if self._connected.is_set() else None
         if conn is None:
             return
         try:
             conn.send(msg)
         except OSError:
-            # dispatcher gone mid-send: the read loop notices EOF and exits;
-            # the dispatcher requeues whatever we held
-            logger.debug("result send failed (dispatcher gone?)")
-
-    def _send_batch(self, header: Dict, parts) -> None:
-        conn = self._conn
-        if conn is None:
-            return
-        try:
-            conn.send_batch(header, parts)
-        except OSError:
-            logger.debug("result send failed (dispatcher gone?)")
+            # dispatcher gone mid-send: the read loop notices EOF and the
+            # run loop reconnects (or exits); it requeues whatever we held
+            logger.debug("send failed (dispatcher gone?)")
 
     def _send_failure(self, cid: str, ordinal, attempt, exc: BaseException,
                       item) -> None:
@@ -347,9 +533,12 @@ class ServiceWorker:
         object crosses the socket - the client recovers the item from its
         own ledger)."""
         failure = _Failure(exc, ordinal=ordinal, item=item)
-        self._send({"t": "failure", "client": cid, "ordinal": ordinal,
-                    "attempt": attempt, "formatted": failure.formatted,
-                    "kind": failure.kind, "exc_type": failure.exc_type})
+        self._deliver("ctrl", {"t": "failure", "client": cid,
+                               "ordinal": ordinal, "attempt": attempt,
+                               "formatted": failure.formatted,
+                               "kind": failure.kind,
+                               "exc_type": failure.exc_type},
+                      None, key=(cid, ordinal))
 
     # -- heartbeat ------------------------------------------------------------
 
@@ -369,6 +558,8 @@ class ServiceWorker:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop_event.wait(self._hb_interval):
+            if not self._connected.is_set():
+                continue
             with self._busy_lock:
                 busy = self._busy + self._work.qsize()
             self._send({"t": "heartbeat", "busy": busy,
@@ -383,18 +574,14 @@ def run_worker(address, capacity: int = 2, name: Optional[str] = None,
     """Blocking worker entry (the CLI's ``worker`` subcommand).
 
     ``reconnect_attempts`` > 0 makes the worker survive dispatcher
-    restarts: after losing the connection it retries registration that
-    many times with a fixed backoff (elastic fleets keep workers running
-    while the control plane reschedules)."""
-    attempts_left = reconnect_attempts
-    while True:
-        worker = ServiceWorker(address, capacity=capacity, name=name,
-                               shm_size_bytes=shm_size_bytes,
-                               auth_token=auth_token)
-        rc = worker.run()
-        if attempts_left <= 0:
-            return rc
-        attempts_left -= 1
-        logger.info("Reconnecting to dispatcher in %.1fs (%d attempt(s)"
-                    " left)", reconnect_backoff_s, attempts_left + 1)
-        time.sleep(reconnect_backoff_s)
+    restarts WITHOUT dropping its in-flight work: registration retries
+    that many times with a fixed backoff, and every successful rejoin
+    resets the budget (elastic fleets keep workers running while the
+    control plane reschedules - see the module docstring for what a
+    rejoin reports)."""
+    worker = ServiceWorker(address, capacity=capacity, name=name,
+                           shm_size_bytes=shm_size_bytes,
+                           auth_token=auth_token,
+                           reconnect_attempts=reconnect_attempts,
+                           reconnect_backoff_s=reconnect_backoff_s)
+    return worker.run()

@@ -39,6 +39,9 @@ from petastorm_tpu.errors import PetastormTpuError
 
 #: chaos kinds a cell may name (see cell_kwargs for the exact injections)
 CHAOS_KINDS = ("none", "kill", "hang", "hedge")
+#: service-plane disruptions a cell may name (fired mid-read by run_cell's
+#: ``disruptor`` callable, normally one of the FleetHandle methods)
+DISRUPTION_KINDS = ("none", "dispatcher-restart", "netsplit", "netchaos")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,6 +54,8 @@ class MatrixCell:
     resize: bool = False          # mid-epoch executor resize (autotune shape)
     transport: str = "local"      # local | service
     split: str = "none"           # none | quiesce (mid-epoch quiesce+resume)
+    disruption: str = "none"      # none | dispatcher-restart | netsplit
+    #                             # | netchaos (service transport only)
 
     def __post_init__(self):
         if self.chaos not in CHAOS_KINDS:
@@ -59,6 +64,13 @@ class MatrixCell:
             raise PetastormTpuError(f"unknown transport {self.transport!r}")
         if self.split not in ("none", "quiesce"):
             raise PetastormTpuError(f"unknown split {self.split!r}")
+        if self.disruption not in DISRUPTION_KINDS:
+            raise PetastormTpuError(
+                f"unknown disruption {self.disruption!r}")
+        if self.disruption != "none" and self.transport != "service":
+            raise PetastormTpuError(
+                "disruption cells target the service control plane; use"
+                " transport='service'")
 
     def label(self) -> str:
         """Compact cell name for test ids and triage output, e.g.
@@ -70,6 +82,8 @@ class MatrixCell:
             parts.append(self.transport)
         if self.split != "none":
             parts.append(self.split)
+        if self.disruption != "none":
+            parts.append(self.disruption)
         return "-".join(parts)
 
 
@@ -157,7 +171,8 @@ def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
              num_epochs: int = 2,
              service_address: Optional[str] = None,
              action_at_batch: int = 5,
-             reader_kwargs: Optional[dict] = None) -> CellResult:
+             reader_kwargs: Optional[dict] = None,
+             disruptor=None) -> CellResult:
     """Run one cell's full read and return its certificates.
 
     ``action_at_batch``: delivered-batch index at which the cell's mid-epoch
@@ -165,8 +180,15 @@ def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
     ``2 * action_at_batch`` - or quiesce for ``split='quiesce'`` cells).
     ``service_address`` must point at a running dispatcher for
     ``transport='service'`` cells (see :func:`service_fleet`).
+    ``disruptor``: zero-arg callable fired ONCE at ``action_at_batch`` for
+    ``disruption`` cells - normally :meth:`FleetHandle.restart_dispatcher`
+    or :meth:`FleetHandle.netsplit` from :func:`recoverable_fleet`.
     """
     from petastorm_tpu.reader import make_batch_reader
+
+    if cell.disruption != "none" and disruptor is None:
+        raise PetastormTpuError(
+            f"cell {cell.label()} needs a disruptor callable")
 
     kwargs = dict(shuffle_row_groups=True, shuffle_seed=seed,
                   deterministic="seed", num_epochs=num_epochs)
@@ -178,6 +200,7 @@ def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
     rows = 0
     resumed_digest: Optional[dict] = None
     state: Optional[dict] = None
+    disrupted = False
 
     with make_batch_reader(dataset_url, **kwargs) as reader:
         it = reader.iter_batches()
@@ -195,6 +218,13 @@ def run_cell(dataset_url: str, seed: int, cell: MatrixCell,
                     reader._executor.resize_workers(cell.workers * 2)
                 elif delivered == 2 * action_at_batch:
                     reader._executor.resize_workers(max(1, cell.workers - 1))
+            if (cell.disruption != "none" and disruptor is not None
+                    and not disrupted and delivered == action_at_batch):
+                # the cell's service-plane disruption (dispatcher restart /
+                # partition / ...) fires exactly once, mid-epoch, while
+                # this client holds in-flight work
+                disruptor()
+                disrupted = True
             if (cell.split == "quiesce" and not quiesced
                     and delivered == action_at_batch):
                 # stop issuing work; the already-ventilated tail drains
@@ -352,3 +382,125 @@ def service_fleet(n_workers: int = 2, subprocess_workers: bool = False,
                 w.stop()
         disp.stop()
         disp.join()
+
+
+# -- recoverable fleets (dispatcher-restart / network-chaos cells) -------------
+
+class FleetHandle:
+    """A restartable service topology for disruption cells: a dispatcher
+    the harness can kill and restart ON THE SAME PORT, in-process workers
+    that rejoin (``reconnect_attempts``), and - when armed - a
+    :class:`~petastorm_tpu.test_util.netchaos.ChaosProxy` on the
+    client<->dispatcher link.  ``address`` is what clients should dial
+    (the proxy when present, else the dispatcher)."""
+
+    def __init__(self, dispatcher, workers, proxy=None,
+                 dispatcher_kwargs=None):
+        self.dispatcher = dispatcher
+        self.workers = workers
+        self.proxy = proxy
+        self.port = dispatcher.port
+        self._dispatcher_kwargs = dispatcher_kwargs or {}
+        self.restarts = 0
+
+    @property
+    def address(self) -> str:
+        if self.proxy is not None:
+            return self.proxy.address
+        return f"127.0.0.1:{self.port}"
+
+    def kill_dispatcher(self) -> None:
+        """Abrupt dispatcher death: every session, ledger and redelivery
+        buffer in its memory is gone; peers must reconstruct."""
+        self.dispatcher.stop()
+        self.dispatcher.join()
+
+    def start_dispatcher(self) -> None:
+        """A FRESH dispatcher process-equivalent on the same port (empty
+        state; recovery comes from the peers - or its journal)."""
+        from petastorm_tpu.service.dispatcher import Dispatcher
+        from petastorm_tpu.telemetry import Telemetry
+
+        kwargs = dict(self._dispatcher_kwargs)
+        kwargs.setdefault("telemetry", Telemetry())
+        kwargs.setdefault("heartbeat_timeout_s", 5.0)
+        self.dispatcher = Dispatcher(port=self.port, **kwargs).start()
+        self.restarts += 1
+
+    def restart_dispatcher(self, downtime_s: float = 0.2) -> None:
+        """The dispatcher-SIGKILL+restart disruption: kill, stay dark for
+        ``downtime_s`` (clients and workers must ride their reconnect
+        windows), then start the replacement."""
+        self.kill_dispatcher()
+        if downtime_s:
+            time.sleep(downtime_s)
+        self.start_dispatcher()
+
+    def netsplit(self, duration_s: float = 0.5) -> None:
+        """Partition the client link for ``duration_s``, then heal (needs
+        the fleet's proxy)."""
+        if self.proxy is None:
+            raise PetastormTpuError("netsplit needs net_spec/proxy armed")
+        self.proxy.partition()
+        time.sleep(duration_s)
+        self.proxy.heal()
+
+
+@contextlib.contextmanager
+def recoverable_fleet(n_workers: int = 2, capacity: int = 2,
+                      net_spec=None, dispatcher_kwargs: Optional[dict] = None,
+                      worker_reconnect_attempts: int = 60,
+                      worker_reconnect_backoff_s: float = 0.25):
+    """A dispatcher + rejoining in-process workers (+ an optional chaos
+    proxy on the client link) for disruption cells; yields a
+    :class:`FleetHandle`.
+
+    Workers connect DIRECTLY to the dispatcher with a generous rejoin
+    budget, so a dispatcher restart finds them claiming their in-flight
+    work; ``net_spec`` (a :class:`~petastorm_tpu.test_util.netchaos.
+    NetChaosSpec`) interposes the proxy on the CLIENT link only - worker-
+    link faults are the dispatcher's worker-death machinery, already a
+    matrix axis.
+    """
+    import threading
+
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    from petastorm_tpu.service.worker import ServiceWorker
+    from petastorm_tpu.telemetry import Telemetry
+
+    kwargs = dict(dispatcher_kwargs or {})
+    kwargs.setdefault("telemetry", Telemetry())
+    kwargs.setdefault("heartbeat_timeout_s", 5.0)
+    disp = Dispatcher(**kwargs).start()
+    direct = f"127.0.0.1:{disp.port}"
+    proxy = None
+    if net_spec is not None:
+        from petastorm_tpu.test_util.netchaos import ChaosProxy
+
+        proxy = ChaosProxy(direct, net_spec).start()
+    workers = [ServiceWorker(
+        direct, capacity=capacity, name=f"rw{i}",
+        reconnect_attempts=worker_reconnect_attempts,
+        reconnect_backoff_s=worker_reconnect_backoff_s)
+        for i in range(n_workers)]
+    threads = [threading.Thread(target=w.run, daemon=True) for w in workers]
+    for t in threads:
+        t.start()
+    handle = FleetHandle(disp, workers, proxy=proxy,
+                         dispatcher_kwargs=kwargs)
+    try:
+        deadline = time.monotonic() + 20.0
+        while len(handle.dispatcher.stats()["workers"]) < n_workers:
+            if time.monotonic() >= deadline:
+                raise PetastormTpuError(
+                    f"recoverable fleet: {n_workers} workers did not"
+                    " register")
+            time.sleep(0.05)
+        yield handle
+    finally:
+        for w in workers:
+            w.stop()
+        if proxy is not None:
+            proxy.stop()
+        handle.dispatcher.stop()
+        handle.dispatcher.join()

@@ -1,0 +1,598 @@
+"""Dispatcher crash recovery + network-fault injection (ISSUE 13): the
+fresh-dispatcher peer-reconstruction handshake (client re-hello/resync,
+worker rejoin claims, orphan results), the optional session journal, the
+bounded redelivery buffer, and the FrameSocket-boundary chaos transport
+(mid-frame cuts, drops-with-cut, duplicates, delays, partitions)."""
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.pool import VentilatedItem
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.retry import RetryPolicy
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service import wire
+from petastorm_tpu.service.client import ServiceExecutor
+from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.service.journal import ServiceJournal
+from petastorm_tpu.service.protocol import FrameClosedError, FrameSocket
+from petastorm_tpu.service.worker import ServiceWorker
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.matrix import (MatrixCell, recoverable_fleet,
+                                            run_cell)
+from petastorm_tpu.test_util.netchaos import ChaosProxy, NetChaosSpec
+
+FAST_RECONNECT = RetryPolicy(max_attempts=6, initial_backoff_s=0.05,
+                             backoff_multiplier=1.5, max_backoff_s=0.4)
+
+_EXECUTIONS: dict = {}
+_EXECUTIONS_LOCK = threading.Lock()
+
+
+class CountingSlowFactory:
+    """Counts executions per ordinal (module-global: in-process fleet
+    workers share this interpreter) - the double-assignment detector."""
+
+    def __init__(self, sleep_s: float = 0.0, tag: str = "t"):
+        self.sleep_s = sleep_s
+        self.tag = tag
+
+    def __call__(self):
+        sleep_s, tag = self.sleep_s, self.tag
+
+        def fn(item):
+            with _EXECUTIONS_LOCK:
+                _EXECUTIONS.setdefault(tag, []).append(item.ordinal)
+            if sleep_s:
+                time.sleep(sleep_s)
+            return ("done", item.ordinal)
+
+        return fn
+
+
+class EchoFactory:
+    def __call__(self):
+        return lambda item: item.item
+
+
+def _wait_for(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def int_dataset(tmp_path):
+    url = str(tmp_path / "ds")
+    schema = Schema("RecInts", [Field("x", np.int64)])
+    write_dataset(url, schema, [{"x": i} for i in range(200)],
+                  row_group_size_rows=10)
+    return url
+
+
+def _ctrl_frame(msg) -> bytes:
+    payload = bytes([wire.KIND_CTRL]) + wire.dumps(msg)
+    return struct.pack("!I", len(payload)) + payload
+
+
+# -- NetChaosSpec / ChaosProxy units ------------------------------------------
+
+def test_netchaos_spec_validation_and_determinism():
+    with pytest.raises(PetastormTpuError, match="direction"):
+        NetChaosSpec(direction="up")
+    with pytest.raises(PetastormTpuError, match="dup_rate"):
+        NetChaosSpec(dup_rate=1.5)
+    spec = NetChaosSpec(seed=3, dup_rate=0.3, delay_rate=0.3, cut_frames=(7,))
+    # pure function of (seed, kind, index): two evaluations agree
+    decisions = [spec.decide("s2c", i) for i in range(64)]
+    assert decisions == [spec.decide("s2c", i) for i in range(64)]
+    assert decisions[7] == "cut"
+    assert "dup" in decisions and "delay" in decisions
+    # a different seed moves the faults
+    other = NetChaosSpec(seed=4, dup_rate=0.3, delay_rate=0.3)
+    assert [other.decide("s2c", i) for i in range(64)] \
+        != [NetChaosSpec(seed=3, dup_rate=0.3, delay_rate=0.3).decide(
+            "s2c", i) for i in range(64)]
+    # direction gating
+    one_way = NetChaosSpec(cut_frames=(0,), direction="c2s")
+    assert one_way.decide("c2s", 0) == "cut"
+    assert one_way.decide("s2c", 0) == "none"
+    # int -> tuple coercion, chaos-spec style
+    assert NetChaosSpec(cut_frames=5).cut_frames == (5,)
+
+
+def _echo_server():
+    """A tiny frame echo server; returns (thread, port, stop)."""
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(8)
+    listener.settimeout(0.2)
+    stop = threading.Event()
+
+    def serve():
+        conns = []
+        while not stop.is_set():
+            try:
+                sock, _ = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            fs = FrameSocket(sock)
+            conns.append(fs)
+
+            def pump(fs=fs):
+                try:
+                    while not stop.is_set():
+                        msg = fs.recv(timeout=0.2)
+                        if msg is not None:
+                            fs.send(msg)
+                except Exception:  # noqa: BLE001 - cut connections expected
+                    pass
+
+            threading.Thread(target=pump, daemon=True).start()
+        for fs in conns:
+            fs.close()
+        listener.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return t, listener.getsockname()[1], stop
+
+
+def test_chaos_proxy_transparent_and_duplicating():
+    t, port, stop = _echo_server()
+    try:
+        # transparent passthrough
+        with ChaosProxy(("127.0.0.1", port)).start() as proxy:
+            conn = FrameSocket(socket.create_connection(
+                ("127.0.0.1", proxy.port)))
+            for i in range(5):
+                conn.send({"t": "ping", "n": i})
+                assert conn.recv(timeout=5.0) == {"t": "ping", "n": i}
+            conn.close()
+            assert proxy.stats["frames"] >= 10  # both directions counted
+            assert proxy.stats["cuts"] == proxy.stats["drops"] == 0
+        # duplication: the echo comes back twice for the dup'd frame
+        spec = NetChaosSpec(dup_frames=(0,), direction="c2s")
+        with ChaosProxy(("127.0.0.1", port), spec).start() as proxy:
+            conn = FrameSocket(socket.create_connection(
+                ("127.0.0.1", proxy.port)))
+            conn.send({"t": "once"})
+            assert conn.recv(timeout=5.0) == {"t": "once"}
+            assert conn.recv(timeout=5.0) == {"t": "once"}  # the duplicate
+            assert proxy.stats["dups"] == 1
+            conn.close()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_chaos_proxy_mid_frame_cut_and_partition_heal():
+    t, port, stop = _echo_server()
+    try:
+        spec = NetChaosSpec(cut_frames=(1,), direction="c2s")
+        with ChaosProxy(("127.0.0.1", port), spec).start() as proxy:
+            conn = FrameSocket(socket.create_connection(
+                ("127.0.0.1", proxy.port)))
+            conn.send({"t": "ok", "blob": b"x" * 4096})
+            assert conn.recv(timeout=5.0)["t"] == "ok"
+            # frame 1 is cut mid-body: the server side dies mid-recv_into,
+            # and this side's connection is killed -> FrameClosedError,
+            # never garbage
+            with pytest.raises((FrameClosedError, OSError)):
+                conn.send({"t": "doomed", "blob": b"y" * 4096})
+                conn.recv(timeout=5.0)
+            assert proxy.stats["cuts"] == 1
+            conn.close()
+            # a FRESH connection through the same proxy resyncs cleanly
+            conn2 = FrameSocket(socket.create_connection(
+                ("127.0.0.1", proxy.port)))
+            conn2.send({"t": "alive"})
+            assert conn2.recv(timeout=5.0) == {"t": "alive"}
+            # partition: live pipe cut, new connections refused...
+            proxy.partition()
+            with pytest.raises((FrameClosedError, OSError)):
+                conn2.send({"t": "partitioned"})
+                conn2.recv(timeout=5.0)
+            conn2.close()
+            # ...until heal
+            proxy.heal()
+            conn3 = FrameSocket(socket.create_connection(
+                ("127.0.0.1", proxy.port)))
+            conn3.send({"t": "healed"})
+            assert conn3.recv(timeout=5.0) == {"t": "healed"}
+            conn3.close()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# -- FrameSocket mid-frame cut fuzz (satellite 3) ------------------------------
+
+def test_frame_socket_sender_dies_after_partial_body_write():
+    """A peer dying after a PARTIAL body write must surface as the
+    classified FrameClosedError - and a replacement connection must stream
+    cleanly (resync), never inherit desync."""
+    a, b = socket.socketpair()
+    fb = FrameSocket(b)
+    framed = _ctrl_frame({"t": "big", "blob": b"z" * 50_000})
+    a.sendall(framed[: len(framed) // 2])
+    assert fb.recv(timeout=0.05) is None  # partial frame held, no garbage
+    a.close()  # sender dies mid-frame
+    with pytest.raises(FrameClosedError):
+        fb.recv(timeout=2.0)
+    fb.close()
+    # the "reconnect": a fresh socket pair streams fine
+    a2, b2 = socket.socketpair()
+    fa2, fb2 = FrameSocket(a2), FrameSocket(b2)
+    fa2.send({"t": "resynced"})
+    assert fb2.recv(timeout=2.0) == {"t": "resynced"}
+    fa2.close()
+    fb2.close()
+
+
+@pytest.mark.parametrize("cut_at", [1, 3, 4, 5, 37, 4095])
+def test_frame_socket_fuzz_cut_at_every_layer(cut_at):
+    """Fuzz the cut point across the frame layout (mid-length-prefix,
+    mid-kind-byte, mid-body, last byte): every cut classifies as
+    FrameClosedError after the partial bytes, with NO message ever
+    fabricated from the torn frame."""
+    framed = _ctrl_frame({"t": "fuzz", "blob": b"q" * 4096})
+    cut_at = min(cut_at, len(framed) - 1)
+    a, b = socket.socketpair()
+    fb = FrameSocket(b)
+    a.sendall(framed[:cut_at])
+    assert fb.recv(timeout=0.05) is None
+    a.close()
+    with pytest.raises(FrameClosedError):
+        fb.recv(timeout=2.0)
+    fb.close()
+
+
+def test_receiver_cut_mid_recv_into_classifies():
+    """The receiving side losing its socket DURING a body fill (another
+    thread closes it, as the send-timeout death path does) maps to
+    FrameClosedError, not a crash of the read loop."""
+    a, b = socket.socketpair()
+    fb = FrameSocket(b)
+    framed = _ctrl_frame({"t": "big", "blob": b"z" * (1 << 20)})
+    a.sendall(framed[:5000])
+
+    def cut_later():
+        time.sleep(0.2)
+        fb.close()
+
+    threading.Thread(target=cut_later, daemon=True).start()
+    with pytest.raises(FrameClosedError):
+        # blocks mid-body until the concurrent close lands
+        fb.recv(timeout=10.0)
+    a.close()
+
+
+# -- dispatcher crash recovery -------------------------------------------------
+
+def test_dispatcher_restart_mid_epoch_recovers(int_dataset):
+    """TENTPOLE e2e: kill the dispatcher while the client holds in-flight
+    work, start a fresh one on the same port, and the epoch completes with
+    the exact row multiset - session reconstructed from peers, counted on
+    both sides."""
+    with recoverable_fleet(n_workers=2) as fleet:
+        tele = Telemetry()
+        reader = make_batch_reader(int_dataset, service_address=fleet.address,
+                                   shuffle_row_groups=False, telemetry=tele)
+        rows = []
+        restarted = False
+        for b in reader.iter_batches():
+            rows.extend(int(x) for x in b.columns["x"])
+            if not restarted and len(rows) >= 40:
+                restarted = True
+                fleet.restart_dispatcher(downtime_s=0.2)
+        diag = reader.diagnostics
+        reader.stop()
+        reader.join()
+        assert sorted(rows) == list(range(200))
+        assert len(rows) == 200  # exactly once, no duplicates
+        assert diag["dispatcher_restarts"] == 1
+        assert diag["reconnects"] >= 1
+        c = tele.snapshot()["counters"]
+        assert c["service.dispatcher_restarts"] == 1
+        # the NEW dispatcher saw the session reconstructed + workers rejoin
+        dc = fleet.dispatcher.stats()["counters"]
+        assert dc.get("service.sessions_reconstructed", 0) >= 1, dc
+        assert dc.get("service.worker_rejoins", 0) >= 1, dc
+
+
+def test_no_double_execution_through_restart():
+    """Workers keep executing through the outage and the rejoin claims
+    re-attach their in-flight items: nothing is executed twice despite the
+    client re-sending its whole ledger."""
+    tag = "restart-exactly-once"
+    _EXECUTIONS.pop(tag, None)
+    # sleep_s must comfortably cover downtime + the worker's rejoin backoff
+    # so the first wave is STILL EXECUTING when the rejoin hello lands -
+    # otherwise the items legitimately come back as orphans, not claims
+    with recoverable_fleet(n_workers=1, capacity=2,
+                           worker_reconnect_backoff_s=0.1) as fleet:
+        ex = ServiceExecutor(fleet.address, telemetry=Telemetry(), window=8,
+                             reconnect_policy=FAST_RECONNECT)
+        ex.start(CountingSlowFactory(sleep_s=1.2, tag=tag))
+        try:
+            for i in range(6):
+                ex.put(VentilatedItem(i, f"p{i}"))
+            time.sleep(0.2)  # let the worker start executing
+            fleet.restart_dispatcher(downtime_s=0.2)
+            got = sorted(ex.get(timeout=30.0) for _ in range(6))
+            assert got == [("done", i) for i in range(6)]
+        finally:
+            ex.stop()
+            ex.join()
+        executed = _EXECUTIONS.get(tag, [])
+        assert sorted(executed) == list(range(6)), \
+            f"double execution: {sorted(executed)}"
+        dc = fleet.dispatcher.stats()["counters"]
+        assert dc.get("service.recovered_assignments", 0) >= 1, dc
+
+
+def test_orphan_result_buffered_until_client_reconnects():
+    """A rejoined worker finishing an item BEFORE its client reconnects:
+    the outcome is buffered as an orphan and replayed on the client's
+    hello - not dropped as a duplicate."""
+    tag = "orphan"
+    _EXECUTIONS.pop(tag, None)
+    slow_client = RetryPolicy(max_attempts=4, initial_backoff_s=2.0,
+                              backoff_multiplier=1.0, max_backoff_s=2.0)
+    with recoverable_fleet(n_workers=1, capacity=1,
+                           worker_reconnect_backoff_s=0.1) as fleet:
+        ex = ServiceExecutor(fleet.address, telemetry=Telemetry(), window=2,
+                             reconnect_policy=slow_client)
+        ex.start(CountingSlowFactory(sleep_s=1.2, tag=tag))
+        try:
+            ex.put(VentilatedItem(0, "slow"))
+            time.sleep(0.3)  # executing now
+            # dispatcher dies; worker rejoins in ~0.1s and finishes the item
+            # LONG before the client's 2s reconnect backoff expires
+            fleet.restart_dispatcher(downtime_s=0.05)
+            assert ex.get(timeout=30.0) == ("done", 0)
+            assert _EXECUTIONS.get(tag) == [0]  # executed exactly once
+            dc = fleet.dispatcher.stats()["counters"]
+            assert dc.get("service.orphan_results_buffered", 0) >= 1, dc
+        finally:
+            ex.stop()
+            ex.join()
+
+
+def test_journal_warm_restart_skips_resends(tmp_path, caplog):
+    """--journal: a restarted dispatcher replays sessions from disk, tells
+    the reconnecting client which ordinals it already holds, and the
+    client's resync skips re-sending them."""
+    journal = str(tmp_path / "svc.journal")
+    tag = "journal"
+    _EXECUTIONS.pop(tag, None)
+    tele = Telemetry()
+    disp = Dispatcher(telemetry=tele, heartbeat_timeout_s=5.0,
+                      journal_path=journal).start()
+    port = disp.port
+    addr = f"127.0.0.1:{port}"
+    worker = ServiceWorker(addr, capacity=1, name="jw",
+                           reconnect_attempts=60, reconnect_backoff_s=0.1)
+    threading.Thread(target=worker.run, daemon=True).start()
+    _wait_for(lambda: len(disp.stats()["workers"]) == 1,
+              what="worker registration")
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=8,
+                         reconnect_policy=FAST_RECONNECT)
+    ex.start(CountingSlowFactory(sleep_s=0.3, tag=tag))
+    try:
+        for i in range(6):
+            ex.put(VentilatedItem(i, f"p{i}"))
+        time.sleep(0.15)  # journaled + some assigned
+        disp.stop()
+        disp.join()
+        # the warm restart: same port, same journal, EMPTY memory
+        disp = Dispatcher(telemetry=tele, heartbeat_timeout_s=5.0, port=port,
+                          journal_path=journal).start()
+        with caplog.at_level(logging.INFO,
+                             logger="petastorm_tpu.service.client"):
+            got = sorted(ex.get(timeout=30.0) for _ in range(6))
+        assert got == [("done", i) for i in range(6)]
+        c = tele.snapshot()["counters"]
+        assert c.get("service.journal_items_restored", 0) >= 1, c
+        assert any("resync skipped" in r.getMessage()
+                   for r in caplog.records), \
+            "client did not skip any journal-known re-sends"
+        # exactly-once execution held through the warm restart too (the
+        # worker's rejoin claims cover journal-restored pending items)
+        assert sorted(_EXECUTIONS.get(tag, [])) == list(range(6))
+    finally:
+        ex.stop()
+        ex.join()
+        worker.stop()
+        disp.stop()
+        disp.join()
+
+
+def test_journal_tolerates_truncated_tail(tmp_path):
+    """A crash mid-append leaves a torn record; load() replays the good
+    prefix and stops cleanly."""
+    path = str(tmp_path / "torn.journal")
+    j = ServiceJournal(path)
+    j.open()
+    j.append_hello("c1", {"factory": b"fac", "hostname": "h",
+                          "shm_ok": False, "max_requeue": 2, "codecs": []})
+    j.append_enqueue("c1", {"o": 0, "a": 0, "blob": b"item0"})
+    j.append_enqueue("c1", {"o": 1, "a": 0, "blob": b"item1"})
+    j.append_ack("c1", [0])
+    j.close()
+    with open(path, "ab") as fh:
+        fh.write(struct.pack("!I", 500) + b"torn")  # crash mid-record
+    sessions = ServiceJournal(path).load()
+    assert list(sessions) == ["c1"]
+    assert list(sessions["c1"].items) == [1]  # 0 acked, tail tolerated
+    assert sessions["c1"].hello["factory"] == b"fac"
+    # purge removes the whole session
+    j2 = ServiceJournal(path)
+    j2.load()
+    j2.open()
+    j2.append_purge("c1")
+    j2.close()
+    assert ServiceJournal(path).load() == {}
+
+
+# -- bounded redelivery buffer (satellite 1) -----------------------------------
+
+def test_replay_buffer_cap_degrades_oldest_and_forces_refetch(int_dataset):
+    """Unacked result bodies past replay_buffer_bytes degrade to
+    header-only; on reconnect the client re-fetches exactly those items -
+    every row still delivered exactly once, memory bounded."""
+    tele = Telemetry()
+    disp = Dispatcher(telemetry=tele, heartbeat_timeout_s=5.0,
+                      replay_buffer_bytes=16_384).start()
+    addr = f"127.0.0.1:{disp.port}"
+    worker = ServiceWorker(addr, capacity=2, name="bw")
+    threading.Thread(target=worker.run, daemon=True).start()
+    _wait_for(lambda: len(disp.stats()["workers"]) == 1,
+              what="worker registration")
+    slow_reconnect = RetryPolicy(max_attempts=10, initial_backoff_s=0.8,
+                                 backoff_multiplier=1.0, max_backoff_s=0.8)
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=16,
+                         reconnect_policy=slow_reconnect)
+    ex.start(EchoFactory())
+    try:
+        for i in range(12):
+            ex.put(VentilatedItem(i, b"B" * 4096))  # ~4KB bodies, 16KB cap
+        # cut the client link so every result lands in the redelivery
+        # buffer instead of the wire; ~48KB of bodies vs a 16KB cap
+        ex._conn._sock.shutdown(socket.SHUT_RDWR)
+        _wait_for(lambda: tele.snapshot()["counters"].get(
+            "service.replay_bodies_dropped", 0) >= 1,
+            what="replay-cap degrade")
+        gauge = tele.snapshot()["gauges"]["service.replay_buffer_bytes"]
+        assert gauge <= 16_384 + 8_192, gauge  # newest entry may overhang
+        # the receiver reconnects after its backoff; stale outcomes force
+        # re-fetch, fresh ones replay - all 12 arrive exactly once
+        got = sorted([ex.get(timeout=30.0) for _ in range(12)],
+                     key=lambda v: 0)
+        assert got == [b"B" * 4096] * 12
+        c = tele.snapshot()["counters"]
+        assert c.get("service.replay_bodies_dropped", 0) >= 1, c
+        assert c.get("service.replay_refetches_forced", 0) >= 1, c
+    finally:
+        ex.stop()
+        ex.join()
+        worker.stop()
+        disp.stop()
+        disp.join()
+
+
+# -- pickle-fallback warn-once (satellite 2) -----------------------------------
+
+def test_pickle_fallback_warns_once_naming_refusal_knobs():
+    disp = Dispatcher(telemetry=Telemetry(), heartbeat_timeout_s=5.0).start()
+    addr = f"127.0.0.1:{disp.port}"
+    worker = ServiceWorker(addr, capacity=2, name="pw")
+    threading.Thread(target=worker.run, daemon=True).start()
+    _wait_for(lambda: len(disp.stats()["workers"]) == 1,
+              what="worker registration")
+    logger = logging.getLogger("petastorm_tpu.service.client")
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger.addHandler(handler)
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+    try:
+        ex.start(EchoFactory())
+        for i in range(4):
+            ex.put(VentilatedItem(i, f"p{i}"))
+        got = sorted(ex.get(timeout=15.0) for _ in range(4))
+        assert got == [f"p{i}" for i in range(4)]
+        warnings = [r.getMessage() for r in records
+                    if r.levelno == logging.WARNING
+                    and "PICKLE" in r.getMessage()]
+        assert len(warnings) == 1, warnings  # once, not per frame
+        assert "allow_pickle_results=False" in warnings[0]
+        assert "PETASTORM_TPU_SERVICE_ALLOW_PICKLE" in warnings[0]
+    finally:
+        logger.removeHandler(handler)
+        ex.stop()
+        ex.join()
+        worker.stop()
+        disp.stop()
+        disp.join()
+
+
+# -- reads through a hostile network ------------------------------------------
+
+def test_service_read_survives_netchaos_on_client_link(int_dataset):
+    """A full read through a duplicating/delaying/cutting proxy delivers
+    the exact row multiset - and the chaos provably fired."""
+    # the cut frame index must be comfortably inside what the read pushes
+    # per direction (~20 enqueues / ~20 results); high indexes are reached
+    # only on runs whose ack batching stays fine-grained
+    spec = NetChaosSpec(seed=11, dup_rate=0.1, delay_rate=0.15,
+                        delay_s=0.01, cut_frames=(12,))
+    with recoverable_fleet(n_workers=2, net_spec=spec) as fleet:
+        tele = Telemetry()
+        reader = make_batch_reader(int_dataset, service_address=fleet.address,
+                                   shuffle_row_groups=False, telemetry=tele)
+        rows = sorted(int(x) for b in reader.iter_batches()
+                      for x in b.columns["x"])
+        reader.stop()
+        reader.join()
+        assert rows == list(range(200))
+        stats = fleet.proxy.stats
+        assert stats["dups"] >= 1, stats
+        assert stats["cuts"] >= 1, stats
+        assert tele.snapshot()["counters"]["service.reconnects"] >= 1
+
+
+def test_matrix_cell_rejects_local_disruption():
+    with pytest.raises(PetastormTpuError, match="service"):
+        MatrixCell(disruption="dispatcher-restart")
+    with pytest.raises(PetastormTpuError, match="disruption"):
+        MatrixCell(transport="service", disruption="meteor")
+    with pytest.raises(PetastormTpuError, match="disruptor"):
+        run_cell("unused", 7, MatrixCell(transport="service",
+                                         disruption="netsplit"),
+                 service_address="127.0.0.1:1")
+
+
+def test_worker_rejoin_hello_reports_held_state():
+    """Unit: a rejoining worker's hello carries its executing assignments
+    and held jobs (what the dispatcher turns into claims)."""
+    worker = ServiceWorker("127.0.0.1:1", capacity=1,
+                           reconnect_attempts=1)
+    worker.worker_name = "w0"  # registered once already
+    worker._jobs["cid"] = {"factory": b"f", "shm_ok": False, "codec": ""}
+    worker._held[("cid", 5)] = 1
+
+    sent = {}
+
+    class _FakeConn:
+        def send(self, msg):
+            sent.update(msg)
+
+        def recv(self, timeout=None):
+            return {"t": "hello_ok", "worker": "w0"}
+
+    worker._register(_FakeConn())
+    assert sent["resume"] is True
+    assert sent["assignments"] == [["cid", 5, 1]]
+    assert sent["jobs"] == ["cid"]
+    # pre-registration hello is a plain one
+    fresh = ServiceWorker("127.0.0.1:1", capacity=1)
+    sent.clear()
+    fresh._register(_FakeConn())
+    assert sent["resume"] is False
+    assert sent["assignments"] == []
