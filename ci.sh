@@ -653,6 +653,148 @@ else
          "REQUIRE_ARENA job captures and gates the colocated ratio)"
 fi
 
+echo "== autoscale smoke (closed loop: starved client forces a scale-up, idle drain a graceful retire) =="
+# the full ISSUE 14 loop as real CLI processes under timeout: a dispatcher,
+# a `petastorm-tpu-service autoscale` supervisor (floor 1 / ceiling 2), and
+# one starved trainer.  The supervisor must spawn the second worker off
+# sustained pressure DURING the read, the idle fleet afterwards must shrink
+# via a GRACEFUL retire (drain, flush, bye - no force-kill), the client's
+# row multiset must be exact through the scale events, and the
+# service.autoscale.workers_spawned/retired counters must prove both moves.
+AUTOSCALE_SMOKE="$(mktemp /tmp/petastorm_tpu_autoscale_smoke_XXXXXX.py)"
+cat > "$AUTOSCALE_SMOKE" <<'PY'
+import collections
+import json
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service.protocol import connect_frames, parse_address
+from petastorm_tpu.test_util.synthetic import synthetic_rgb_image
+
+tmp = tempfile.mkdtemp(prefix="petastorm_tpu_autoscale_smoke_")
+url = f"{tmp}/img"
+# starvation is piggybacked on ~1s client_stats frames and the loop wants
+# 2 consecutive pressured polls: the read must span several seconds on the
+# 1-worker fleet for the scale-up to fire mid-read
+n_rows, epochs = 96, 30
+schema = Schema("Img", [
+    Field("label", np.int64, (), ScalarCodec()),
+    Field("image", np.uint8, (224, 224, 3),
+          CompressedImageCodec("jpeg", quality=90)),
+])
+write_dataset(url, schema,
+              [{"label": i, "image": synthetic_rgb_image(i, 224, 224)}
+               for i in range(n_rows)], row_group_size_rows=16)
+
+def stats(addr):
+    conn = connect_frames(parse_address(addr), timeout=5.0)
+    try:
+        conn.send({"t": "stats?"})
+        return conn.recv(timeout=5.0)["stats"]
+    finally:
+        conn.close()
+
+events = []
+procs = []
+try:
+    disp = subprocess.Popen(
+        [sys.executable, "-m", "petastorm_tpu.service.cli", "dispatcher",
+         "--host", "127.0.0.1", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    procs.append(disp)
+    addr = re.search(r"listening on (\S+)",
+                     disp.stdout.readline()).group(1)
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "petastorm_tpu.service.cli", "autoscale",
+         "--address", addr, "--min-workers", "1", "--max-workers", "2",
+         "--capacity", "1", "--poll-interval", "0.25",
+         "--grow-windows", "2", "--shrink-windows", "6",
+         "--settle", "0.5", "--starved-threshold", "0.02",
+         "--drain-timeout", "20"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    procs.append(sup)
+
+    def pump():
+        for line in sup.stdout:
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                pass
+
+    pumper = threading.Thread(target=pump, daemon=True)
+    pumper.start()
+
+    def wait_for(cond, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {what}: {events}")
+
+    # the min_workers floor brings worker #1 up without any client
+    wait_for(lambda: len(stats(addr)["workers"]) >= 1, 30, "floor worker")
+
+    # one greedy trainer: the 1-worker fleet starves it -> the loop must
+    # spawn worker #2 DURING the read (sustained pressure, 2 polls)
+    got = []
+    with make_batch_reader(url, shuffle_row_groups=False,
+                           num_epochs=epochs, service_address=addr) as r:
+        for b in r.iter_batches():
+            got.extend(int(v) for v in b.columns["label"])
+    assert collections.Counter(got) == collections.Counter(
+        list(range(n_rows)) * epochs), "row multiset not exact"
+    grow_events = [e for e in events if e.get("event") == "scale-up"
+                   and "pressure" in e.get("reason", "")]
+    assert grow_events, f"no pressure-driven scale-up fired: {events}"
+    assert len(stats(addr)["workers"]) == 2, stats(addr)["workers"]
+
+    # the read is done, the client gone: the idle fleet must shrink back
+    # to the floor via a GRACEFUL retire (scale_pressure decays out of its
+    # 10s window first, then 6 shrink verdicts accumulate)
+    wait_for(lambda: any(e.get("event") == "scale-down" for e in events),
+             45, "graceful scale-down")
+    down = [e for e in events if e.get("event") == "scale-down"]
+    assert all(e.get("graceful") for e in down), down
+    wait_for(lambda: len(stats(addr)["workers"]) == 1, 30, "fleet at floor")
+    dc = stats(addr)["counters"]
+    assert dc.get("service.qos.workers_draining", 0) >= 1, dc
+    assert dc.get("service.requeued_items", 0) == 0, dc  # drained, not moved
+
+    # SIGTERM = drain the spawned fleet and exit with a counters summary
+    sup.send_signal(signal.SIGTERM)
+    sup.wait(timeout=60)
+    pumper.join(timeout=5)
+    summary = [e for e in events if e.get("event") == "stopped"][-1]["summary"]
+    c = summary["counters"]
+    assert c["workers_spawned"] >= 2, c   # floor + pressure-driven grow
+    assert c["workers_retired"] >= 2, c   # idle shrink + shutdown drain
+    assert c["workers_force_killed"] == 0, c
+    assert c["scale_ups"] >= 2, c         # floor bring-up counts as one
+    assert c["scale_downs"] >= 1, c
+    print("autoscale smoke OK (floor up, pressure scale-up mid-read, exact"
+          f" rows, graceful idle shrink + shutdown drain;"
+          f" spawned={int(c['workers_spawned'])}"
+          f" retired={int(c['workers_retired'])} force_killed=0)")
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+PY
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 300 python "$AUTOSCALE_SMOKE"
+rm -f "$AUTOSCALE_SMOKE"
+
 echo "== determinism smoke (seed-stable delivery: identical stream digests across configs) =="
 # two SUBPROCESS runs of petastorm-tpu-diagnose over ONE dataset - different
 # worker counts, the second with a chaos worker kill - must print identical

@@ -82,6 +82,11 @@ API = [
     ("petastorm_tpu.service.worker", ["ServiceWorker", "run_worker"]),
     ("petastorm_tpu.service.client", ["ServiceExecutor",
                                       "ServiceConnectionError"]),
+    ("petastorm_tpu.service.autoscale", ["AutoscaleSupervisor",
+                                         "AutoscalePolicy",
+                                         "SubprocessSpawner",
+                                         "InProcessSpawner",
+                                         "ExecHookSpawner"]),
     ("petastorm_tpu.service.protocol", ["FrameSocket", "connect_frames",
                                         "parse_address", "encode_result",
                                         "PayloadDecoder", "WireItem"]),

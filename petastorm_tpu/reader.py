@@ -109,6 +109,8 @@ def make_reader(dataset_url: str,
                 sample_interval_s: Optional[float] = None,
                 autotune=None,
                 service_address=None,
+                service_weight: Optional[float] = None,
+                service_priority: Optional[int] = None,
                 chaos=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -281,6 +283,12 @@ def make_reader(dataset_url: str,
     :class:`~petastorm_tpu.service.client.ServiceConnectionError` instead
     of hanging the epoch.
 
+    ``service_weight`` / ``service_priority``: this trainer's multi-tenant
+    QoS identity at the dispatcher (weighted deficit-round-robin share
+    within a strict priority tier; docs/operations.md "Fleet autoscaling &
+    QoS").  Defaults 1.0 / 0 (or ``$PETASTORM_TPU_SERVICE_WEIGHT`` /
+    ``$PETASTORM_TPU_SERVICE_PRIORITY``); require ``service_address``.
+
     ``chaos``: deterministic fault injection for tests/benchmarks
     (``petastorm_tpu.test_util.chaos.ChaosSpec``); never set in production.
     """
@@ -307,7 +315,9 @@ def make_reader(dataset_url: str,
                              flight_record_path=flight_record_path,
                              sample_interval_s=sample_interval_s,
                              autotune=autotune,
-                             service_address=service_address)
+                             service_address=service_address,
+                             service_weight=service_weight,
+                             service_priority=service_priority)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -374,6 +384,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       sample_interval_s: Optional[float] = None,
                       autotune=None,
                       service_address=None,
+                      service_weight: Optional[float] = None,
+                      service_priority: Optional[int] = None,
                       chaos=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
@@ -382,8 +394,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
     column arrays per decoded rowgroup.  ``deterministic``/``io_retries``/``telemetry``/
     ``on_error``/``item_deadline_s``/``hedge_after_s``/``stall_warn_s``/
     ``stall_abort_s``/``metrics_port``/``flight_record_path``/
-    ``sample_interval_s``/``autotune``/``service_address``/``chaos``: see
-    ``make_reader``.
+    ``sample_interval_s``/``autotune``/``service_address``/
+    ``service_weight``/``service_priority``/``chaos``: see ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -408,7 +420,9 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              flight_record_path=flight_record_path,
                              sample_interval_s=sample_interval_s,
                              autotune=autotune,
-                             service_address=service_address)
+                             service_address=service_address,
+                             service_weight=service_weight,
+                             service_priority=service_priority)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -434,7 +448,9 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       flight_record_path: Optional[str] = None,
                       sample_interval_s: Optional[float] = None,
                       autotune=None,
-                      service_address=None) -> "Reader":
+                      service_address=None,
+                      service_weight: Optional[float] = None,
+                      service_priority: Optional[int] = None) -> "Reader":
     from petastorm_tpu.autotune import resolve_autotune
     from petastorm_tpu.seeding import resolve_deterministic
 
@@ -476,6 +492,11 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                 " would hold its own empty cache. Use cache_type='shared'"
                 " (the host-wide tier remote workers share) or"
                 " 'local-disk' with service_address readers.")
+    elif service_weight is not None or service_priority is not None:
+        raise PetastormTpuError(
+            "service_weight/service_priority are multi-tenant QoS knobs of"
+            " the ingest service and need service_address (a local pool"
+            " serves exactly one consumer - there is nothing to share)")
     if not flight_record_path:
         flight_record_path = (
             os.environ.get("PETASTORM_TPU_FLIGHT_RECORD", "").strip() or None)
@@ -720,7 +741,10 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                   else DEFAULT_REQUEUE_ATTEMPTS),
             # the in-flight window is the service analog of the results
             # queue bound: batches outstanding at the dispatcher per client
-            window=max(4, int(results_queue_size)))
+            window=max(4, int(results_queue_size)),
+            # multi-tenant QoS identity (weighted fair assignment + strict
+            # priority tiers dispatcher-side); None = env/default
+            weight=service_weight, priority=service_priority)
     else:
         executor = make_executor(
             reader_pool_type, workers_count, results_queue_size,

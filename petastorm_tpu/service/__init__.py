@@ -28,16 +28,23 @@ Topology::
                                                                      +-- worker N
 
 Entry points: ``petastorm-tpu-service dispatcher`` / ``petastorm-tpu-service
-worker`` (service.cli), :class:`~petastorm_tpu.service.dispatcher.Dispatcher`,
-:class:`~petastorm_tpu.service.worker.ServiceWorker`, and
-:class:`~petastorm_tpu.service.client.ServiceExecutor`.  Operations guide:
-docs/operations.md "Disaggregated ingest service".
+worker`` / ``petastorm-tpu-service autoscale`` (service.cli),
+:class:`~petastorm_tpu.service.dispatcher.Dispatcher`,
+:class:`~petastorm_tpu.service.worker.ServiceWorker`,
+:class:`~petastorm_tpu.service.client.ServiceExecutor`, and
+:class:`~petastorm_tpu.service.autoscale.AutoscaleSupervisor` (the
+closed-loop fleet actuator + multi-tenant QoS - weights, priorities,
+admission control - of ISSUE 14).  Operations guides: docs/operations.md
+"Disaggregated ingest service" and "Fleet autoscaling & QoS".
 """
 
+from petastorm_tpu.service.autoscale import (AutoscalePolicy,
+                                             AutoscaleSupervisor)
 from petastorm_tpu.service.client import (ServiceConnectionError,
                                           ServiceExecutor)
 from petastorm_tpu.service.dispatcher import Dispatcher
 from petastorm_tpu.service.worker import ServiceWorker
 
 __all__ = ["Dispatcher", "ServiceWorker", "ServiceExecutor",
-           "ServiceConnectionError"]
+           "ServiceConnectionError", "AutoscalePolicy",
+           "AutoscaleSupervisor"]

@@ -4,7 +4,16 @@ Usage::
 
     petastorm-tpu-service dispatcher --port 7737 [--metrics-port 9100]
     petastorm-tpu-service worker --address HOST:7737 [--capacity 4]
+    petastorm-tpu-service autoscale --address HOST:7737 --max-workers 8
     petastorm-tpu-service stats --address HOST:7737
+
+``autoscale`` runs the closed-loop fleet supervisor
+(:mod:`petastorm_tpu.service.autoscale`): it polls the dispatcher's
+scaling signal and spawns/retires local worker subprocesses (or invokes
+``--exec-hook`` for k8s-style orchestrators), printing one JSON line per
+scale event and a final counters summary.  SIGTERM/Ctrl-C drains the
+spawned fleet gracefully before exiting.  A ``worker`` process retires
+gracefully on SIGTERM too (drain in-flight, flush, goodbye).
 
 Topology and sizing guidance: docs/operations.md "Disaggregated ingest
 service".  Trainers connect with ``make_reader(...,
@@ -99,6 +108,21 @@ def build_parser() -> argparse.ArgumentParser:
                    " cross-host hops only, 'off' never, 'zlib' wherever"
                    " both ends support it (defaults to"
                    " $PETASTORM_TPU_SERVICE_COMPRESSION)")
+    d.add_argument("--starved-threshold", type=float, default=None,
+                   metavar="X", help="scaling-signal pressure (starved-"
+                   "seconds per second) above which the signal recommends"
+                   " grow (default: the in-process autotune policy's"
+                   " starved_threshold)")
+    d.add_argument("--max-clients", type=int, default=None, metavar="N",
+                   help="admission control: refuse NEW client sessions past"
+                   " N live ones (reconnects always pass; default"
+                   " unbounded)")
+    d.add_argument("--max-client-inflight", type=int, default=None,
+                   metavar="N", help="per-client cap on items in flight at"
+                   " workers: a client at the cap waits for its own results"
+                   " before being assigned more, so one greedy trainer"
+                   " degrades itself, not the fleet (default: bounded only"
+                   " by each client's window)")
 
     w = sub.add_parser("worker", help="run one fleet worker",
                        epilog=_TRUST_WARNING)
@@ -117,6 +141,54 @@ def build_parser() -> argparse.ArgumentParser:
                    help="survive dispatcher restarts: retry registration"
                    " this many times (default 0 = exit with the dispatcher)")
     w.add_argument("--auth-token-file", default=None, metavar="PATH",
+                   help="file holding the dispatcher's shared handshake"
+                   " secret (overrides $PETASTORM_TPU_SERVICE_TOKEN)")
+
+    a = sub.add_parser(
+        "autoscale", help="run the closed-loop fleet supervisor",
+        epilog="The supervisor spawns `worker` subprocesses against"
+               " --address (or invokes --exec-hook) off the dispatcher's"
+               " grow/ok/shrink scaling signal.  Scale-down is graceful:"
+               " the worker drains its in-flight items before exiting, so"
+               " deterministic streams ride scale events untouched.  See"
+               " docs/operations.md 'Fleet autoscaling & QoS'.")
+    a.add_argument("--address", required=True, metavar="HOST:PORT",
+                   help="dispatcher address to supervise")
+    a.add_argument("--min-workers", type=int, default=1,
+                   help="fleet floor, held self-healingly (default 1)")
+    a.add_argument("--max-workers", type=int, default=8,
+                   help="fleet ceiling (default 8)")
+    a.add_argument("--poll-interval", type=float, default=1.0, metavar="S",
+                   help="scaling-signal poll cadence (default 1s)")
+    a.add_argument("--grow-windows", type=int, default=3, metavar="N",
+                   help="consecutive grow verdicts before a scale-up"
+                   " (default 3)")
+    a.add_argument("--shrink-windows", type=int, default=6, metavar="N",
+                   help="consecutive shrink verdicts before a scale-down"
+                   " (default 6)")
+    a.add_argument("--settle", type=float, default=5.0, metavar="S",
+                   help="post-scale-event settle window before verdicts"
+                   " accumulate again (default 5s)")
+    a.add_argument("--capacity", type=int, default=2,
+                   help="capacity of spawned workers (default 2)")
+    a.add_argument("--starved-threshold", type=float, default=None,
+                   metavar="X", help="override the grow pressure threshold"
+                   " for this supervisor (default: whatever the dispatcher"
+                   " reports)")
+    a.add_argument("--drain-timeout", type=float, default=30.0, metavar="S",
+                   help="graceful-drain budget per retirement before a"
+                   " force-kill (default 30s)")
+    a.add_argument("--shm-size-mb", type=int, default=0, metavar="MB",
+                   help="arm spawned workers' co-located shm fast path"
+                   " (default 0 = off)")
+    a.add_argument("--exec-hook", default=None, metavar="CMD",
+                   help="replace local spawning: run CMD through the shell"
+                   " with one JSON scale event on stdin ({action:"
+                   " scale_up|scale_down, address, workers, target,"
+                   " pressure, recommendation, reason, policy}) - the"
+                   " orchestrator owns the fleet; bounds then apply to the"
+                   " OBSERVED worker count")
+    a.add_argument("--auth-token-file", default=None, metavar="PATH",
                    help="file holding the dispatcher's shared handshake"
                    " secret (overrides $PETASTORM_TPU_SERVICE_TOKEN)")
 
@@ -157,7 +229,10 @@ def _run_dispatcher(args) -> int:
         auth_token=_auth_token(args),
         wire_codec=args.compression,
         journal_path=args.journal,
-        replay_buffer_bytes=args.replay_buffer_mb * 2 ** 20)
+        replay_buffer_bytes=args.replay_buffer_mb * 2 ** 20,
+        starved_threshold=args.starved_threshold,
+        max_clients=args.max_clients,
+        max_client_inflight=args.max_client_inflight)
     dispatcher.start()
     print(f"dispatcher listening on {args.host}:{dispatcher.port}",
           flush=True)
@@ -185,9 +260,61 @@ def _run_worker(args) -> int:
                           name=args.name,
                           shm_size_bytes=args.shm_size_mb * 2 ** 20,
                           reconnect_attempts=args.reconnect_attempts,
-                          auth_token=_auth_token(args))
+                          auth_token=_auth_token(args),
+                          # SIGTERM = graceful drain (the autoscale
+                          # supervisor's scale-down path); 2nd = hard stop
+                          install_signal_handlers=True)
     except KeyboardInterrupt:
         return 0
+
+
+def _run_autoscale(args) -> int:
+    from petastorm_tpu.service.autoscale import (AutoscalePolicy,
+                                                 AutoscaleSupervisor,
+                                                 ExecHookSpawner,
+                                                 SubprocessSpawner)
+
+    policy = AutoscalePolicy(
+        min_workers=args.min_workers, max_workers=args.max_workers,
+        poll_interval_s=args.poll_interval, grow_windows=args.grow_windows,
+        shrink_windows=args.shrink_windows, settle_s=args.settle,
+        worker_capacity=args.capacity,
+        starved_threshold=args.starved_threshold,
+        drain_timeout_s=args.drain_timeout)
+    if args.exec_hook:
+        spawner = ExecHookSpawner(args.exec_hook)
+    else:
+        spawner = SubprocessSpawner(
+            args.address, capacity=args.capacity,
+            shm_size_mb=args.shm_size_mb,
+            auth_token_file=args.auth_token_file)
+    supervisor = AutoscaleSupervisor(
+        args.address, policy=policy, spawner=spawner,
+        auth_token=_auth_token(args),
+        on_event=lambda e: print(json.dumps(e), flush=True))
+    print(json.dumps({"event": "supervising", "address": args.address,
+                      "min_workers": policy.min_workers,
+                      "max_workers": policy.max_workers,
+                      "exec_hook": bool(args.exec_hook)}), flush=True)
+
+    import signal as _signal
+
+    def _on_term(_signum, _frame):
+        raise KeyboardInterrupt  # unify SIGTERM with Ctrl-C: drain + exit
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except ValueError:
+        pass
+    try:
+        supervisor.run()
+    except KeyboardInterrupt:
+        print(json.dumps({"event": "stopping"}), flush=True)
+    finally:
+        supervisor.stop()  # graceful fleet drain (bounded per worker)
+        print(json.dumps({"event": "stopped",
+                          "summary": supervisor.summary()}), flush=True)
+    return 0
 
 
 def _run_stats(args) -> int:
@@ -222,6 +349,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _run_dispatcher(args)
     if args.command == "worker":
         return _run_worker(args)
+    if args.command == "autoscale":
+        return _run_autoscale(args)
     return _run_stats(args)
 
 

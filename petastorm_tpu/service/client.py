@@ -98,6 +98,15 @@ class ServiceExecutor(ExecutorBase):
     worker-side concerns on the service plane and are not accepted here
     (the reader warns and drops them for service-backed readers).
 
+    QoS: ``weight`` (default 1.0, or ``$PETASTORM_TPU_SERVICE_WEIGHT``) is
+    this client's long-run assignment share within its priority tier -
+    weighted deficit-round-robin dispatcher-side, so two concurrent
+    trainers with weights 3 and 1 are served ~3:1 while both keep making
+    progress; ``priority`` (default 0, or
+    ``$PETASTORM_TPU_SERVICE_PRIORITY``) is a **strict** tier - a lower
+    tier is served only while no higher tier has pending work
+    (docs/operations.md "Fleet autoscaling & QoS").
+
     Determinism note: results arrive in fleet completion order, but every
     outcome carries its ventilation ordinal (work items travel as
     :class:`~petastorm_tpu.service.protocol.WireItem` frames whose ordinal/
@@ -114,11 +123,29 @@ class ServiceExecutor(ExecutorBase):
                  reconnect_policy: Optional[RetryPolicy] = None,
                  client_id: Optional[str] = None,
                  auth_token: Optional[str] = None,
-                 allow_pickle_results: Optional[bool] = None):
+                 allow_pickle_results: Optional[bool] = None,
+                 weight: Optional[float] = None,
+                 priority: Optional[int] = None):
         super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
                          max_requeue_attempts=max_requeue_attempts)
         if window < 1:
             raise PetastormTpuError("ServiceExecutor window must be >= 1")
+        # multi-tenant QoS identity, carried by the hello: `weight` is this
+        # client's long-run share within its strict-priority tier (weighted
+        # deficit-round-robin dispatcher-side), `priority` its tier (higher
+        # is served first).  Env fallbacks let a deployment tier trainers
+        # without touching reader call sites.
+        if weight is None:
+            weight = float(os.environ.get(
+                "PETASTORM_TPU_SERVICE_WEIGHT", "1.0") or 1.0)
+        if priority is None:
+            priority = int(os.environ.get(
+                "PETASTORM_TPU_SERVICE_PRIORITY", "0") or 0)
+        if weight <= 0:
+            raise PetastormTpuError(
+                f"service client weight must be > 0; got {weight}")
+        self.weight = float(weight)
+        self.priority = int(priority)
         self._address = parse_address(address)
         #: handshake secret (default $PETASTORM_TPU_SERVICE_TOKEN); must
         #: match the dispatcher's when it enforces one
@@ -217,6 +244,7 @@ class ServiceExecutor(ExecutorBase):
                    "shm_ok": shm["available"],
                    "codecs": list(SUPPORTED_CODECS),
                    "max_requeue": self._max_requeue,
+                   "weight": self.weight, "priority": self.priority,
                    "resume": resume, "token": self._auth_token})
         hello = conn.recv(timeout=10.0)
         if not hello or hello.get("t") != "hello_ok":

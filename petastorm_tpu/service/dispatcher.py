@@ -41,9 +41,25 @@ decode each rowgroup once fleet-wide regardless.
 Fleet sizing: clients piggyback their consumer starved-seconds (the
 ``queue.results_empty_wait_s`` signal petastorm_tpu.autotune drives worker
 counts with) and :meth:`Dispatcher.scaling_signal` turns the aggregate into
-a grow/ok/shrink recommendation plus a ``service.scale_pressure`` gauge -
-the operator's (or an orchestrator's) cue to resize the fleet
-(docs/operations.md "Disaggregated ingest service").
+a grow/ok/shrink recommendation plus a ``service.scale_pressure`` gauge.
+:class:`~petastorm_tpu.service.autoscale.AutoscaleSupervisor` (CLI
+``petastorm-tpu-service autoscale``) closes the loop: it polls the signal
+and spawns/retires local worker processes (or invokes an ``--exec-hook``
+for k8s-style orchestrators).  Retirement is **graceful**: a worker sends a
+``retiring`` frame, the dispatcher marks it draining (no new assignments),
+the worker finishes its in-flight items, flushes its outbox, and says
+``bye`` - so ``deterministic='seed'`` streams stay bit-identical through
+scale events (docs/operations.md "Fleet autoscaling & QoS").
+
+Multi-tenant QoS: client hellos carry a ``weight`` (long-run share within a
+priority tier) and a ``priority`` (strict tiers: a lower tier is served
+only while no higher tier has pending work).  Assignment is weighted
+deficit-round-robin, so a greedy trainer cannot starve its peers - and
+admission control (``max_clients``, ``max_client_inflight``) bounds what
+any one client (or an unbounded client herd) can occupy.  Per-client
+weights/priorities/assigned shares are exact and unbounded in
+``stats()['qos']``; refusals and cap deferrals ride ``service.qos.*``
+counters.
 
 Crash recovery (docs/operations.md "Fault domains"): the dispatcher's
 state is **reconstructible from its peers**, so its own death is a
@@ -112,9 +128,36 @@ FLEET_COUNTER_PREFIXES = ("decode.", "worker.", "cache.", "io.", "service.",
                           "stage.service.")
 
 
+def compute_recommendation(pressure: float, threshold: float, pending: int,
+                           capacity: int, busy_fraction: float,
+                           clients: int) -> str:
+    """The grow/ok/shrink rule, shared by :meth:`Dispatcher.scaling_signal`
+    and the autoscale supervisor's remote ``stats`` probes (so a supervisor
+    overriding ``starved_threshold`` re-judges the same raw fields the
+    dispatcher published, with no second copy of the rule).
+
+    * ``grow``: connected clients are starved past ``threshold`` (or there
+      is no capacity at all) **and work is actually queued** - growing a
+      fleet with an empty queue adds idle workers no matter how starved
+      the consumers are (their bottleneck is elsewhere, e.g. their own
+      in-flight window or the wire).
+    * ``shrink``: capacity exists but is essentially idle (busy < 10%,
+      nothing pending, pressure well under threshold) - including a fleet
+      whose clients all left.
+    * else ``ok``.
+    """
+    if clients and pending > 0 and (pressure > threshold or not capacity):
+        return "grow"
+    if capacity and busy_fraction < 0.1 and pending == 0 \
+            and pressure < threshold / 4:
+        return "shrink"
+    return "ok"
+
+
 class _WorkerState:
     __slots__ = ("name", "conn", "capacity", "hostname", "inflight",
-                 "last_heartbeat", "busy", "jobs_sent", "gone", "codecs")
+                 "last_heartbeat", "busy", "jobs_sent", "gone", "codecs",
+                 "draining")
 
     def __init__(self, name: str, conn: FrameSocket, capacity: int,
                  hostname: str, codecs=()):
@@ -130,6 +173,9 @@ class _WorkerState:
         self.busy = 0
         self.jobs_sent: Set[str] = set()
         self.gone = False
+        #: graceful retirement: a draining worker finishes its in-flight
+        #: items but is never assigned new ones (the ``retiring`` frame)
+        self.draining = False
 
 
 class _Assignment:
@@ -145,11 +191,12 @@ class _ClientState:
     __slots__ = ("client_id", "conn", "factory", "hostname", "shm_ok",
                  "max_requeue", "pending", "inflight", "unacked", "rows",
                  "results", "requeued", "connected", "disconnected_at",
-                 "codecs")
+                 "codecs", "weight", "priority", "deficit", "assigned")
 
     def __init__(self, client_id: str, conn: Optional[FrameSocket],
                  factory: bytes, hostname: str, shm_ok: bool,
-                 max_requeue: int, codecs=()):
+                 max_requeue: int, codecs=(), weight: float = 1.0,
+                 priority: int = 0):
         self.client_id = client_id
         #: None for a journal-restored session awaiting its reconnect
         self.conn = conn
@@ -172,6 +219,17 @@ class _ClientState:
         self.requeued = 0
         self.connected = True
         self.disconnected_at: Optional[float] = None
+        #: QoS: long-run share within this client's priority tier (weighted
+        #: deficit-round-robin) and its strict-priority tier (higher first)
+        self.weight = max(1e-6, float(weight))
+        self.priority = int(priority)
+        #: the WDRR deficit counter: refilled by ``weight`` per scheduler
+        #: round, spent one unit per assigned item, reset when the client's
+        #: pending queue empties (classic DRR - no idle-time credit burst)
+        self.deficit = 0.0
+        #: total items ever assigned (exact + unbounded - the per-client
+        #: telemetry counter names are capped, this is not)
+        self.assigned = 0
 
     def known_ordinals(self) -> Set[int]:
         """Ordinals a resync must NOT re-enqueue.  Body-dropped unacked
@@ -221,6 +279,23 @@ class Dispatcher:
     ``replay_buffer_bytes``: cap on retained unacked result *bodies*
     across all clients; overflow degrades the oldest to header-only
     tombstones whose clients re-fetch on reconnect (module docstring).
+    ``starved_threshold``: the pressure level (starved-seconds per second)
+    above which :meth:`scaling_signal` recommends ``grow`` (CLI
+    ``--starved-threshold``); defaults to the in-process autotune loop's
+    ``AutotunePolicy.starved_threshold`` so the fleet and a local pool
+    judge "the worker plane is the bottleneck" identically.
+    ``max_clients``: admission control - a NEW client hello past this many
+    CONNECTED sessions is refused (``service.qos.admission_refused``;
+    reconnects of admitted sessions always pass, and a crashed trainer
+    riding out its reconnect grace does not hold a seat against its
+    replacement).  Note a dispatcher restart re-admits sessions
+    first-come-first-served, so a herd larger than the cap can lose
+    members across a restart.  Default None = unbounded.
+    ``max_client_inflight``: per-client cap on items in flight at workers;
+    a client at the cap is skipped by the assignment loop until results
+    return (``service.qos.capped_deferrals``), so one greedy trainer with
+    a huge window degrades itself, not the fleet.  Default None = bounded
+    only by the client's own window.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
@@ -233,10 +308,20 @@ class Dispatcher:
                  auth_token: Optional[str] = None,
                  wire_codec: Optional[str] = None,
                  journal_path: Optional[str] = None,
-                 replay_buffer_bytes: int = 256 << 20):
+                 replay_buffer_bytes: int = 256 << 20,
+                 starved_threshold: Optional[float] = None,
+                 max_clients: Optional[int] = None,
+                 max_client_inflight: Optional[int] = None):
         if assignment_deadline_s is not None and assignment_deadline_s <= 0:
             raise PetastormTpuError(
                 "assignment_deadline_s must be > 0 or None")
+        if starved_threshold is not None and starved_threshold < 0:
+            raise PetastormTpuError("starved_threshold must be >= 0 or None")
+        if max_clients is not None and max_clients < 1:
+            raise PetastormTpuError("max_clients must be >= 1 or None")
+        if max_client_inflight is not None and max_client_inflight < 1:
+            raise PetastormTpuError(
+                "max_client_inflight must be >= 1 or None")
         if wire_codec is None:
             wire_codec = os.environ.get(
                 "PETASTORM_TPU_SERVICE_COMPRESSION", "auto")
@@ -250,6 +335,9 @@ class Dispatcher:
         self._heartbeat_timeout_s = float(heartbeat_timeout_s)
         self._client_grace_s = float(client_grace_s)
         self._assignment_deadline_s = assignment_deadline_s
+        self._starved_threshold = starved_threshold
+        self._max_clients = max_clients
+        self._max_client_inflight = max_client_inflight
         self._max_requeue = int(max_requeue_attempts)
         self._auth_token = resolve_auth_token(auth_token)
         self.telemetry = _resolve_telemetry(telemetry)
@@ -269,6 +357,7 @@ class Dispatcher:
             maxlen=512)
         self._worker_seq = 0
         self._client_counter_ids: Set[str] = set()
+        self._counter_cap_warned = False
         self._metrics_port = metrics_port
         self.metrics_server = None
         #: identifies THIS dispatcher process across restarts: rides every
@@ -320,6 +409,12 @@ class Dispatcher:
         self._m_refetches = tele.counter("service.replay_refetches_forced")
         self._m_journal_items = tele.counter("service.journal_items_restored")
         self._g_replay_bytes = tele.gauge("service.replay_buffer_bytes")
+        # -- multi-tenant QoS observability (module docstring) --
+        self._m_admission_refused = tele.counter(
+            "service.qos.admission_refused")
+        self._m_capped_deferrals = tele.counter("service.qos.capped_deferrals")
+        self._m_drains = tele.counter("service.qos.workers_draining")
+        self._g_priority_tiers = tele.gauge("service.qos.priority_tiers")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -380,7 +475,9 @@ class Dispatcher:
                     cid, None, hello.get("factory"),
                     hello.get("hostname", ""), bool(hello.get("shm_ok")),
                     int(hello.get("max_requeue", self._max_requeue)),
-                    codecs=hello.get("codecs") or ())
+                    codecs=hello.get("codecs") or (),
+                    weight=hello.get("weight", 1.0),
+                    priority=hello.get("priority", 0))
                 client.connected = False
                 client.disconnected_at = now
                 for item in session.items.values():
@@ -552,6 +649,8 @@ class Dispatcher:
                     self._on_result(state, msg)
                 elif kind == "failure":
                     self._on_worker_failure(state, msg)
+                elif kind == "retiring":
+                    self._on_retiring(state)
                 elif kind == "bye":
                     break
         except FrameClosedError:
@@ -617,6 +716,26 @@ class Dispatcher:
         if recovered:
             self._m_recovered.add(recovered)
         return recovered
+
+    def _on_retiring(self, state: _WorkerState) -> None:
+        """Graceful retirement, phase 1: the worker asked to drain.  Mark
+        it draining (the assignment loop never picks it again), then ack -
+        the worker finishes its in-flight items, flushes, and says ``bye``.
+        Because nothing is dropped or requeued on this path, a
+        ``deterministic='seed'`` stream rides a graceful shrink untouched.
+        """
+        with self._lock:
+            already = state.draining
+            state.draining = True
+            inflight = len(state.inflight)
+        if not already:
+            self._m_drains.add(1)
+            logger.info("Worker %s is retiring (draining %d in-flight"
+                        " item(s); no new assignments)", state.name, inflight)
+        try:
+            state.conn.send({"t": "retire_ok"})
+        except OSError:
+            pass  # dying connection: _worker_gone's requeue path covers it
 
     def _on_heartbeat(self, state: _WorkerState, msg: Dict) -> None:
         state.last_heartbeat = time.monotonic()
@@ -758,22 +877,37 @@ class Dispatcher:
             return
         self._m_completed.add(1)
         self._m_rows.add(int(msg.get("rows", 0)))
-        if self.telemetry.enabled:
-            # per-client rows ride the registry under a bounded name set: a
-            # dispatcher serving an unbounded client churn must not grow the
-            # registry forever (stats() always has per-client exact counts)
-            if cid in self._client_counter_ids \
-                    or len(self._client_counter_ids) < 100:
-                self._client_counter_ids.add(cid)
-                self.telemetry.counter(
-                    f"service.client.{cid[:12]}.rows").add(
-                        int(msg.get("rows", 0)))
+        self._count_client_rows(cid, int(msg.get("rows", 0)))
         if conn is not None:
             self._send_to_client(cid, conn, out)
         # no _stamp_gauges here: the monitor loop stamps every 0.5s, and a
         # per-result lock+scan on the relay hot path costs real throughput
         # on a core shared with decode
         self._pump()
+
+    def _count_client_rows(self, cid: str, rows: int) -> None:
+        """Per-client delivered-row telemetry under a bounded name set: a
+        dispatcher serving an unbounded client churn must not grow the
+        registry forever.  The cap applies ONLY to registry counter names -
+        ``stats()`` per-client counts and the ``qos`` share report come
+        from ``_ClientState`` and stay exact and unbounded past it; the
+        first capped client logs a warning so the silent gap in the
+        ``service.client.*`` series is explained."""
+        if not self.telemetry.enabled:
+            return
+        if cid in self._client_counter_ids \
+                or len(self._client_counter_ids) < 100:
+            self._client_counter_ids.add(cid)
+            self.telemetry.counter(
+                f"service.client.{cid[:12]}.rows").add(rows)
+        elif not self._counter_cap_warned:
+            self._counter_cap_warned = True
+            logger.warning(
+                "per-client counter cap reached (100 clients): client %s"
+                " (and later arrivals) will NOT get a service.client.<id>"
+                ".rows registry counter; per-client counts in stats() and"
+                " the stats()['qos'] share report remain exact and"
+                " unbounded", cid)
 
     def _on_worker_failure(self, state: _WorkerState, msg: Dict) -> None:
         cid, ordinal = msg["client"], msg["ordinal"]
@@ -899,12 +1033,27 @@ class Dispatcher:
         refetch = 0
         with self._lock:
             client = self._clients.get(cid)
-            if client is None:
+            # admission control: a NEW session past the cap is refused
+            # inside the registration critical section (two racing hellos
+            # cannot both squeeze under the cap) and before any state
+            # exists for it; reconnects of admitted sessions never hit
+            # this - their state is live above.  Only CONNECTED sessions
+            # count toward the cap: a crashed trainer riding out its
+            # reconnect grace (or a journal-restored session that never
+            # came back) must not block its replacement's seat.
+            admitted = (client is not None or self._max_clients is None
+                        or sum(1 for c in self._clients.values()
+                               if c.connected) < self._max_clients)
+            if not admitted:
+                pass  # refusal send/close happens outside the lock, below
+            elif client is None:
                 client = _ClientState(
                     cid, conn, hello.get("factory"),
                     hello.get("hostname", ""), bool(hello.get("shm_ok")),
                     int(hello.get("max_requeue", self._max_requeue)),
-                    codecs=hello.get("codecs") or ())
+                    codecs=hello.get("codecs") or (),
+                    weight=hello.get("weight", 1.0),
+                    priority=hello.get("priority", 0))
                 self._clients[cid] = client
                 self._client_order.append(cid)
                 if resumed:
@@ -926,28 +1075,44 @@ class Dispatcher:
                     old.close()
                 logger.info("Client %s reconnected (%d unacked outcome(s)"
                             " to replay)", cid, len(client.unacked))
-            # adopt any orphan results a rejoined worker finished while
-            # this client was away (they replay below like unacked ones)
-            for key in [k for k in self._orphan_results if k[0] == cid]:
-                out, _ts = self._orphan_results.pop(key)
-                if not out.get("_stale"):
-                    client.unacked[key[1]] = out
-                    client.results += 1
-                    client.rows += int(out.get("rows", 0))
-            replay = []
-            for ordinal in list(client.unacked):
-                out = client.unacked[ordinal]
-                if out.get("_stale"):
-                    # body degraded under the replay cap: cannot replay;
-                    # dropping it here + excluding it from `known` forces
-                    # the client's resync to re-enqueue it (re-fetch)
-                    del client.unacked[ordinal]
-                    refetch += 1
-                else:
-                    replay.append(out)
-            known = sorted(client.known_ordinals())
-            self._g_clients.set(
-                sum(1 for c in self._clients.values() if c.connected))
+            replay: List[Dict] = []
+            known: List[int] = []
+            if admitted:
+                # adopt any orphan results a rejoined worker finished while
+                # this client was away (they replay below like unacked ones)
+                for key in [k for k in self._orphan_results if k[0] == cid]:
+                    out, _ts = self._orphan_results.pop(key)
+                    if not out.get("_stale"):
+                        client.unacked[key[1]] = out
+                        client.results += 1
+                        client.rows += int(out.get("rows", 0))
+                for ordinal in list(client.unacked):
+                    out = client.unacked[ordinal]
+                    if out.get("_stale"):
+                        # body degraded under the replay cap: cannot replay;
+                        # dropping it here + excluding it from `known`
+                        # forces the client's resync to re-enqueue it
+                        # (re-fetch)
+                        del client.unacked[ordinal]
+                        refetch += 1
+                    else:
+                        replay.append(out)
+                known = sorted(client.known_ordinals())
+                self._g_clients.set(
+                    sum(1 for c in self._clients.values() if c.connected))
+        if not admitted:
+            self._m_admission_refused.add(1)
+            logger.warning("Refusing client %s: admission control"
+                           " (max_clients=%d sessions live)", cid,
+                           self._max_clients)
+            try:
+                conn.send({"t": "error", "error":
+                           "admission refused: this dispatcher caps"
+                           f" sessions at max_clients={self._max_clients}"})
+            except OSError:
+                pass
+            conn.close()
+            return
         if refetch:
             self._m_refetches.add(refetch)
         if self._journal is not None:
@@ -957,7 +1122,8 @@ class Dispatcher:
                 "shm_ok": bool(hello.get("shm_ok")),
                 "max_requeue": int(hello.get("max_requeue",
                                              self._max_requeue)),
-                "codecs": list(hello.get("codecs") or ())})
+                "codecs": list(hello.get("codecs") or ()),
+                "weight": client.weight, "priority": client.priority})
         # `boot` lets the client count dispatcher restarts; `known` lets a
         # warm-restarted (journaled) session skip resync re-sends
         conn.send({"t": "hello_ok", "client": cid, "boot": self.boot_id,
@@ -1158,30 +1324,86 @@ class Dispatcher:
                 return affine
         return min(free, key=lambda w: len(w.inflight))
 
+    #: WDRR burst bound: a client's deficit never exceeds this many times
+    #: its (floored-at-1) weight, so credit earned while briefly unscheduled
+    #: cannot pile into an unbounded burst later
+    _DEFICIT_BURST = 2.0
+
+    def _next_client_locked(self) -> Optional[str]:
+        """Pick the next client to assign for: **strict-priority tiers**
+        (the highest tier with eligible pending work is served exclusively)
+        and **weighted deficit-round-robin** within the tier (each refill
+        adds credit proportional to ``weight``; one assignment spends one
+        unit; an emptied queue resets its deficit - classic DRR, so
+        long-run shares converge to the weight ratio and every positive
+        weight keeps making progress).  A client at
+        ``max_client_inflight`` is skipped (``service.qos.capped_deferrals``
+        counts pumps where ONLY capped clients had pending work).  Caller
+        holds the lock; returns None when nothing is assignable."""
+        eligible = []
+        capped_only = False
+        for cid in self._client_order:
+            c = self._clients[cid]
+            if not c.pending:
+                continue
+            if self._max_client_inflight is not None \
+                    and len(c.inflight) >= self._max_client_inflight:
+                capped_only = True
+                continue
+            eligible.append(cid)
+        if not eligible:
+            if capped_only:
+                self._m_capped_deferrals.add(1)
+            return None
+        top = max(self._clients[cid].priority for cid in eligible)
+        tier = [cid for cid in eligible if self._clients[cid].priority == top]
+        if len(tier) == 1:
+            return tier[0]
+        if all(self._clients[cid].deficit < 1.0 for cid in tier):
+            # proportional refill sized so the first client to afford one
+            # item lands exactly at 1.0 (virtual-time DRR: credit per
+            # refill is weight-proportional; no fixed quantum to tune, no
+            # refill loop that crawls for tiny weights)
+            quantum = min((1.0 - self._clients[cid].deficit)
+                          / self._clients[cid].weight for cid in tier)
+            for cid in tier:
+                c = self._clients[cid]
+                c.deficit = min(c.deficit + c.weight * quantum,
+                                self._DEFICIT_BURST * max(1.0, c.weight))
+        affordable = [cid for cid in tier
+                      if self._clients[cid].deficit >= 1.0] or tier
+        # rotate the tie-break start so equal-deficit clients alternate
+        self._rr = (self._rr + 1) % len(affordable)
+        rotated = affordable[self._rr:] + affordable[:self._rr]
+        return max(rotated, key=lambda cid: self._clients[cid].deficit)
+
     def _pump(self) -> None:
-        """Assign pending items to free workers (round-robin across clients
-        for fairness).  Sends happen outside the lock; assignment state is
-        recorded first, so a failed send surfaces as a worker death whose
-        requeue path recovers the item."""
+        """Assign pending items to free workers (strict-priority weighted
+        deficit-round-robin across clients - :meth:`_next_client_locked`).
+        Sends happen outside the lock; assignment state is recorded first,
+        so a failed send surfaces as a worker death whose requeue path
+        recovers the item."""
         sends: List[Tuple[_WorkerState, Dict]] = []
         with self._lock:
             stable = sorted(w.name for w in self._workers.values()
                             if not w.gone)
             while True:
                 free = [w for w in self._workers.values()
-                        if not w.gone and len(w.inflight) < w.capacity]
+                        if not w.gone and not w.draining
+                        and len(w.inflight) < w.capacity]
                 if not free:
                     break
-                # round-robin over clients with pending work
-                order = self._client_order
-                candidates = [cid for cid in order
-                              if self._clients[cid].pending]
-                if not candidates:
+                cid = self._next_client_locked()
+                if cid is None:
                     break
-                self._rr = (self._rr + 1) % len(candidates)
-                cid = candidates[self._rr % len(candidates)]
                 client = self._clients[cid]
                 item = client.pending.popleft()
+                client.deficit = max(0.0, client.deficit - 1.0)
+                client.assigned += 1
+                if not client.pending:
+                    # DRR: an emptied queue forfeits its residual credit
+                    # (idle time must not bank into a later burst)
+                    client.deficit = 0.0
                 worker = self._pick_worker(item, free, stable)
                 client.inflight[item.ordinal] = _Assignment(item, worker.name)
                 worker.inflight.add((cid, item.ordinal))
@@ -1214,6 +1436,8 @@ class Dispatcher:
         with self._lock:
             pending = sum(len(c.pending) for c in self._clients.values())
             inflight = sum(len(c.inflight) for c in self._clients.values())
+            tiers = len({c.priority for c in self._clients.values()
+                         if c.connected})
             replay_bytes = self._replay_bytes
             # drop released tombstones off the front of the accounting
             # deque so it tracks live entries, not history
@@ -1222,6 +1446,7 @@ class Dispatcher:
                 self._replay_order.popleft()
         self._g_pending.set(pending)
         self._g_inflight.set(inflight)
+        self._g_priority_tiers.set(tiers)
         self._g_replay_bytes.set(replay_bytes)
 
     # -- monitoring / scaling -------------------------------------------------
@@ -1281,42 +1506,55 @@ class Dispatcher:
             self._g_pressure.set(self.scaling_signal()["pressure"])
             self._stamp_gauges()
 
-    def scaling_signal(self, window_s: float = 10.0) -> Dict[str, Any]:
+    def scaling_signal(self, window_s: float = 10.0,
+                       threshold: Optional[float] = None) -> Dict[str, Any]:
         """Fleet-size pressure from the clients' queue-wait signals.
 
         ``pressure`` is the aggregate consumer starved-seconds per second
         over the last ``window_s`` (clients report their
         ``queue.results_empty_wait_s`` deltas - the exact signal
-        petastorm_tpu.autotune grows local worker pools on).  Crossing the
-        autotune policy's ``starved_threshold`` with work queued means the
-        fleet is the bottleneck -> ``'grow'``; an idle fleet with nothing
-        pending -> ``'shrink'``; else ``'ok'``.
-        """
-        from petastorm_tpu.autotune import AutotunePolicy
+        petastorm_tpu.autotune grows local worker pools on).  Crossing
+        ``threshold`` with work queued means the fleet is the bottleneck
+        -> ``'grow'``; an idle fleet with nothing pending -> ``'shrink'``;
+        else ``'ok'`` (:func:`compute_recommendation` is the exact rule -
+        the autoscale supervisor applies the same one to remote
+        ``stats`` probes).
 
-        threshold = AutotunePolicy.starved_threshold
+        ``threshold`` defaults to the dispatcher's configured
+        ``starved_threshold`` (ctor / ``--starved-threshold``), which
+        itself defaults to the in-process ``AutotunePolicy``'s value - so
+        service fleets and local pools judge starvation identically unless
+        an operator tunes them apart.
+        """
+        if threshold is None:
+            threshold = self._starved_threshold
+        if threshold is None:
+            from petastorm_tpu.autotune import AutotunePolicy
+
+            threshold = AutotunePolicy.starved_threshold
         now = time.monotonic()
         with self._lock:
             starved = sum(delta for t, delta in self._starved_reports
                           if now - t <= window_s)
             pending = sum(len(c.pending) for c in self._clients.values())
             inflight = sum(len(c.inflight) for c in self._clients.values())
-            capacity = sum(w.capacity for w in self._workers.values())
+            # draining workers are leaving: they finish their in-flight
+            # items but take no new ones, so they are not capacity
+            capacity = sum(w.capacity for w in self._workers.values()
+                           if not w.draining)
+            workers = sum(1 for w in self._workers.values()
+                          if not w.draining)
             clients = sum(1 for c in self._clients.values() if c.connected)
         pressure = starved / window_s
         busy_frac = (inflight / capacity) if capacity else 0.0
-        if clients and (pressure > threshold or not capacity) \
-                and (pending > 0 or not capacity):
-            recommendation = "grow"
-        elif capacity and clients and busy_frac < 0.1 and pending == 0 \
-                and pressure < threshold / 4:
-            recommendation = "shrink"
-        else:
-            recommendation = "ok"
+        recommendation = compute_recommendation(
+            pressure=pressure, threshold=threshold, pending=pending,
+            capacity=capacity, busy_fraction=busy_frac, clients=clients)
         return {"pressure": round(pressure, 4),
                 "starved_threshold": threshold,
                 "busy_fraction": round(busy_frac, 4),
                 "pending_items": pending, "worker_capacity": capacity,
+                "workers": workers, "connected_clients": clients,
                 "recommendation": recommendation}
 
     def stats(self) -> Dict[str, Any]:
@@ -1327,6 +1565,7 @@ class Dispatcher:
             workers = {name: {"capacity": w.capacity, "busy": w.busy,
                               "inflight": len(w.inflight),
                               "hostname": w.hostname,
+                              "draining": w.draining,
                               "heartbeat_age_s": round(
                                   time.monotonic() - w.last_heartbeat, 2)}
                        for name, w in self._workers.items()}
@@ -1337,6 +1576,15 @@ class Dispatcher:
                              "rows": c.rows, "results": c.results,
                              "requeued": c.requeued}
                        for cid, c in self._clients.items()}
+            # per-client QoS share report: exact + unbounded (satellite of
+            # the per-client counter-name cap - THIS is the canonical
+            # per-client accounting, whatever the registry capped)
+            total_assigned = sum(c.assigned for c in self._clients.values())
+            qos = {cid: {"weight": c.weight, "priority": c.priority,
+                         "assigned": c.assigned,
+                         "share": round(c.assigned / total_assigned, 4)
+                         if total_assigned else 0.0}
+                   for cid, c in self._clients.items()}
         counters = {}
         if self.telemetry.enabled:
             counters = {k: v for k, v in
@@ -1349,6 +1597,6 @@ class Dispatcher:
                         "journal": self._journal_path}
         return {"uptime_s": round(time.monotonic() - self._started_at, 1),
                 "port": self.port, "boot": self.boot_id,
-                "workers": workers, "clients": clients,
+                "workers": workers, "clients": clients, "qos": qos,
                 "recovery": recovery,
                 "counters": counters, "scaling": self.scaling_signal()}

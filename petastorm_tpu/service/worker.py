@@ -148,6 +148,13 @@ class ServiceWorker:
         self.worker_name: Optional[str] = None
         self.items_processed = 0
         self.dispatcher_reconnects = 0
+        #: graceful retirement state (begin_retire/retire): the heartbeat
+        #: thread drives the drain so arming it is signal-safe
+        self._retiring = threading.Event()
+        self._retire_acked = threading.Event()
+        self._retire_sent = False
+        self._drain_ok_since: Optional[float] = None
+        self.retired_gracefully = False
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -159,6 +166,27 @@ class ServiceWorker:
         conn = self._conn
         if conn is not None:
             conn.close()
+
+    def begin_retire(self) -> None:
+        """Arm **graceful retirement** (idempotent, signal-safe: only sets
+        a flag).  The heartbeat thread then runs the drain protocol: send
+        ``retiring`` (the dispatcher stops assigning to us and acks with
+        ``retire_ok``), finish every held item, flush the outbox, say
+        ``bye``, and exit - nothing is dropped or requeued, so
+        ``deterministic='seed'`` streams ride a scale-down untouched.
+        A retirement that cannot finish (dispatcher gone for good) is
+        force-resolved by the caller's timeout (:meth:`retire`) - the
+        dispatcher's death detection requeues whatever was left."""
+        self._retiring.set()
+
+    def retire(self, timeout: Optional[float] = None) -> bool:
+        """Blocking graceful retirement: arm the drain and wait up to
+        ``timeout`` (None = forever) for it to complete.  Returns True when
+        the worker drained and said goodbye; False on timeout (the caller
+        decides whether to :meth:`stop` it hard)."""
+        self.begin_retire()
+        self._stop_event.wait(timeout)
+        return self.retired_gracefully
 
     def run(self) -> int:
         """Connect, register, and serve until the dispatcher goes away
@@ -264,6 +292,10 @@ class ServiceWorker:
         with self._conn_lock:
             self._conn = conn
             self._connected.set()
+        # a retirement armed across a reconnect must re-announce itself to
+        # the (possibly restarted) dispatcher before the drain can finish
+        self._retire_sent = False
+        self._retire_acked.clear()
         self._flush_outbox()
 
     def _serve(self, conn: FrameSocket) -> None:
@@ -299,6 +331,11 @@ class ServiceWorker:
                     with self._fn_lock:
                         self._jobs.pop(msg["client"], None)
                         self._fns.pop(msg["client"], None)
+                elif kind == "retire_ok":
+                    # the dispatcher marked us draining (no new work will
+                    # be assigned); the heartbeat thread completes the
+                    # drain once everything held has been delivered
+                    self._retire_acked.set()
                 elif kind == "stop":
                     self._stop_event.set()
                     break
@@ -557,7 +594,20 @@ class ServiceWorker:
         return deltas
 
     def _heartbeat_loop(self) -> None:
-        while not self._stop_event.wait(self._hb_interval):
+        # wakes every 0.25s so a drain completes promptly, but heartbeats
+        # still go out only every _hb_interval
+        next_hb = 0.0
+        while not self._stop_event.wait(0.25):
+            now = time.monotonic()
+            if self._retiring.is_set():
+                if not self._retire_sent and self._connected.is_set():
+                    self._retire_sent = True
+                    self._send({"t": "retiring"})
+                if self._check_drained(now):
+                    return
+            if now < next_hb:
+                continue
+            next_hb = now + self._hb_interval
             if not self._connected.is_set():
                 continue
             with self._busy_lock:
@@ -565,12 +615,38 @@ class ServiceWorker:
             self._send({"t": "heartbeat", "busy": busy,
                         "counters": self._counter_deltas()})
 
+    def _check_drained(self, now: float) -> bool:
+        """Drain-completion check (heartbeat thread): everything this
+        worker held has reached the dispatcher, stably across two checks
+        >= 0.3s apart (the stability window absorbs work frames that were
+        already in flight toward us when the dispatcher marked us
+        draining).  On completion: ``bye``, stop, done."""
+        if not self._retire_acked.is_set():
+            return False
+        with self._held_lock:
+            empty = not self._held and not self._outbox
+        if not empty:
+            self._drain_ok_since = None
+            return False
+        if self._drain_ok_since is None:
+            self._drain_ok_since = now
+            return False
+        if now - self._drain_ok_since < 0.3:
+            return False
+        logger.info("Worker %s drained; retiring gracefully",
+                    self.worker_name or "?")
+        self._send({"t": "bye"})
+        self.retired_gracefully = True
+        self.stop()
+        return True
+
 
 def run_worker(address, capacity: int = 2, name: Optional[str] = None,
                shm_size_bytes: int = 0,
                reconnect_attempts: int = 0,
                reconnect_backoff_s: float = 1.0,
-               auth_token: Optional[str] = None) -> int:
+               auth_token: Optional[str] = None,
+               install_signal_handlers: bool = False) -> int:
     """Blocking worker entry (the CLI's ``worker`` subcommand).
 
     ``reconnect_attempts`` > 0 makes the worker survive dispatcher
@@ -578,10 +654,29 @@ def run_worker(address, capacity: int = 2, name: Optional[str] = None,
     that many times with a fixed backoff, and every successful rejoin
     resets the budget (elastic fleets keep workers running while the
     control plane reschedules - see the module docstring for what a
-    rejoin reports)."""
+    rejoin reports).
+
+    ``install_signal_handlers``: SIGTERM triggers **graceful retirement**
+    (drain in-flight items, flush, goodbye - the autoscale supervisor's
+    scale-down path); a second SIGTERM stops hard.  Main-thread only (the
+    CLI sets it)."""
     worker = ServiceWorker(address, capacity=capacity, name=name,
                            shm_size_bytes=shm_size_bytes,
                            auth_token=auth_token,
                            reconnect_attempts=reconnect_attempts,
                            reconnect_backoff_s=reconnect_backoff_s)
+    if install_signal_handlers:
+        import signal as _signal
+
+        def _on_term(_signum, _frame):
+            if worker._retiring.is_set():  # noqa: SLF001 - own module
+                worker.stop()  # second SIGTERM: stop hard
+            else:
+                worker.begin_retire()
+
+        try:
+            _signal.signal(_signal.SIGTERM, _on_term)
+        except ValueError:
+            logger.warning("not the main thread; SIGTERM graceful-drain"
+                           " handler not installed")
     return worker.run()

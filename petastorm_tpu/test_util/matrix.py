@@ -40,8 +40,12 @@ from petastorm_tpu.errors import PetastormTpuError
 #: chaos kinds a cell may name (see cell_kwargs for the exact injections)
 CHAOS_KINDS = ("none", "kill", "hang", "hedge")
 #: service-plane disruptions a cell may name (fired mid-read by run_cell's
-#: ``disruptor`` callable, normally one of the FleetHandle methods)
-DISRUPTION_KINDS = ("none", "dispatcher-restart", "netsplit", "netchaos")
+#: ``disruptor`` callable, normally one of the FleetHandle methods);
+#: ``elastic-fleet`` is the ISSUE 14 cell: a new worker joins AND an
+#: original gracefully drains mid-epoch (the autoscale supervisor's
+#: grow + retire moves)
+DISRUPTION_KINDS = ("none", "dispatcher-restart", "netsplit", "netchaos",
+                    "elastic-fleet")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -402,6 +406,7 @@ class FleetHandle:
         self.port = dispatcher.port
         self._dispatcher_kwargs = dispatcher_kwargs or {}
         self.restarts = 0
+        self._extra_seq = 0
 
     @property
     def address(self) -> str:
@@ -444,6 +449,50 @@ class FleetHandle:
         self.proxy.partition()
         time.sleep(duration_s)
         self.proxy.heal()
+
+    # -- elastic-fleet moves (ISSUE 14: autoscale grow / graceful shrink) -----
+
+    def scale_up(self, n: int = 1, capacity: int = 2,
+                 timeout_s: float = 20.0) -> None:
+        """Grow the fleet by ``n`` in-process workers (the supervisor's
+        scale-up move) and wait until they are registered."""
+        import threading
+
+        from petastorm_tpu.service.worker import ServiceWorker
+
+        target = len(self.dispatcher.stats()["workers"]) + n
+        for _ in range(n):
+            self._extra_seq += 1
+            w = ServiceWorker(f"127.0.0.1:{self.port}", capacity=capacity,
+                              name=f"ew{self._extra_seq}",
+                              heartbeat_interval_s=0.5,
+                              reconnect_attempts=60,
+                              reconnect_backoff_s=0.25)
+            self.workers.append(w)
+            threading.Thread(target=w.run, daemon=True).start()
+        deadline = time.monotonic() + timeout_s
+        while len(self.dispatcher.stats()["workers"]) < target:
+            if time.monotonic() >= deadline:
+                raise PetastormTpuError("scale_up: new worker(s) did not"
+                                        " register")
+            time.sleep(0.05)
+
+    def retire_worker(self, index: int = 0, timeout_s: float = 30.0) -> None:
+        """Gracefully retire one worker (the supervisor's scale-down move):
+        it drains its in-flight assignments, flushes, and exits - nothing
+        requeues, so a deterministic stream must not notice."""
+        worker = self.workers.pop(index)
+        if not worker.retire(timeout=timeout_s):
+            raise PetastormTpuError(
+                "retire_worker: graceful drain missed its timeout")
+
+    def elastic_event(self) -> None:
+        """The elastic-fleet disruption: a new worker joins mid-epoch, then
+        an ORIGINAL worker (holding live assignments) gracefully drains
+        out - the exact grow+retire sequence an autoscale supervisor
+        drives, compressed into one mid-read event."""
+        self.scale_up(1)
+        self.retire_worker(0)
 
 
 @contextlib.contextmanager

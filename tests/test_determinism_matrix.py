@@ -222,6 +222,70 @@ def test_netsplit_heal_cell_bit_identical(matrix_dataset, baseline):
     assert stats["connections"] >= 2, stats
 
 
+# -- elastic-fleet cells (ISSUE 14: autoscaling must not move a byte) ---------
+
+def test_elastic_fleet_cell_bit_identical(matrix_dataset, baseline):
+    """Elastic-fleet as a matrix cell: mid-epoch a NEW worker joins and an
+    ORIGINAL worker (holding live assignments) gracefully drains out - the
+    autoscale supervisor's grow + retire moves.  The delivered stream is
+    bit-identical to the uninterrupted baseline, and the drain requeues
+    NOTHING (graceful means finished, not rescheduled)."""
+    from petastorm_tpu.test_util.matrix import recoverable_fleet
+
+    cell = MatrixCell(transport="service", disruption="elastic-fleet")
+    with recoverable_fleet(n_workers=2) as fleet:
+        result = run_cell(matrix_dataset, SEED, cell, num_epochs=EPOCHS,
+                          service_address=fleet.address,
+                          disruptor=fleet.elastic_event)
+        _assert_matches(result, baseline, cell.label())
+        dc = fleet.dispatcher.stats()["counters"]
+        assert dc.get("service.qos.workers_draining", 0) >= 1, dc
+        # graceful = the drained worker FINISHED its items; nothing moved
+        # through the requeue path
+        assert dc.get("service.requeued_items", 0) == 0, dc
+        assert len(fleet.dispatcher.stats()["workers"]) == 2  # 2+1-1
+
+
+def test_autoscale_supervisor_cell_bit_identical(matrix_dataset, baseline):
+    """The CLOSED LOOP as a matrix cell: an undersized fleet (1 worker) +
+    a live AutoscaleSupervisor reacting to real client pressure.  The
+    supervisor must scale up at least once mid-read, and the delivered
+    stream must still be bit-identical to the baseline."""
+    from petastorm_tpu.service.autoscale import (AutoscalePolicy,
+                                                 AutoscaleSupervisor,
+                                                 InProcessSpawner)
+    from petastorm_tpu.test_util.chaos import ChaosSpec
+    from petastorm_tpu.test_util.matrix import recoverable_fleet
+
+    cell = MatrixCell(transport="service")
+    with recoverable_fleet(n_workers=1, capacity=1) as fleet:
+        policy = AutoscalePolicy(min_workers=0, max_workers=3,
+                                 poll_interval_s=0.2, grow_windows=2,
+                                 shrink_windows=50, settle_s=0.5,
+                                 worker_capacity=1,
+                                 starved_threshold=0.01,
+                                 drain_timeout_s=20.0)
+        supervisor = AutoscaleSupervisor(
+            dispatcher=fleet.dispatcher, policy=policy,
+            spawner=InProcessSpawner(fleet.address, capacity=1,
+                                     heartbeat_interval_s=0.3)).start()
+        try:
+            # every item decodes 50ms slower: the 1-worker fleet starves
+            # the client long enough for the loop to react (timing-only
+            # chaos - content identical to the baseline by construction)
+            result = run_cell(
+                matrix_dataset, SEED, cell, num_epochs=EPOCHS,
+                service_address=fleet.address,
+                reader_kwargs={"chaos": ChaosSpec(slow_rate=1.0,
+                                                  slow_s=0.05)})
+        finally:
+            supervisor.stop()
+        _assert_matches(result, baseline, "autoscale-closed-loop")
+        counters = supervisor.summary()["counters"]
+        assert counters["workers_spawned"] >= 1, counters
+        assert counters["workers_force_killed"] == 0, counters
+
+
 # -- token-dataset cell family (ISSUE 11: the packed stream is certified) -----
 
 @pytest.fixture(scope="module")

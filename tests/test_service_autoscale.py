@@ -1,0 +1,761 @@
+"""Closed-loop fleet autoscaling + multi-tenant QoS (ISSUE 14):
+the AutoscaleSupervisor's verdict loop / settle / hysteresis / graceful
+retirement / self-healing floor / exec-hook contract, the dispatcher's
+weighted deficit-round-robin with strict priority tiers, admission
+control, per-client in-flight caps, the configurable starved threshold,
+scaling_signal edge cases, and the per-client counter-cap warning."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.pool import VentilatedItem
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service.autoscale import (AutoscalePolicy,
+                                             AutoscaleSupervisor,
+                                             ExecHookSpawner,
+                                             InProcessSpawner)
+from petastorm_tpu.service.client import ServiceExecutor
+from petastorm_tpu.service.dispatcher import (Dispatcher,
+                                              compute_recommendation)
+from petastorm_tpu.service.worker import ServiceWorker
+from petastorm_tpu.telemetry import Telemetry
+
+
+class PlainEchoFactory:
+    def __call__(self):
+        return lambda item: item.item
+
+
+#: serve order observed AT the worker (module-global so the factory keeps
+#: pointing at it through the pickle hop to in-process worker threads)
+SERVED_ORDER = []
+
+
+class OrderRecordingEchoFactory:
+    """Echo that appends each item to SERVED_ORDER as the worker decodes
+    it: the single source of truth for assignment order (client-side
+    delivery timestamps race across drain threads)."""
+
+    def __call__(self):
+        def fn(item):
+            SERVED_ORDER.append(item.item)
+            return item.item
+
+        return fn
+
+
+class SlowEchoFactory:
+    """Per-item decode delay: makes a 1-worker fleet a real bottleneck."""
+
+    def __init__(self, delay_s=0.01):
+        self.delay_s = delay_s
+
+    def __call__(self):
+        delay = self.delay_s
+
+        def fn(item):
+            time.sleep(delay)
+            return item.item
+
+        return fn
+
+
+def _wait_for(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _start_worker(addr, capacity=1, name=None):
+    worker = ServiceWorker(addr, capacity=capacity, name=name,
+                           heartbeat_interval_s=0.3)
+    thread = threading.Thread(target=worker.run, daemon=True)
+    thread.start()
+    return worker
+
+
+@pytest.fixture
+def dispatcher():
+    disp = Dispatcher(telemetry=Telemetry(), heartbeat_timeout_s=5.0).start()
+    try:
+        yield disp, f"127.0.0.1:{disp.port}"
+    finally:
+        disp.stop()
+        disp.join()
+
+
+# -- multi-tenant QoS: weighted shares ----------------------------------------
+
+def test_weighted_shares_proportional_and_starvation_free(dispatcher):
+    """Acceptance (ISSUE 14): two concurrent greedy clients with weights
+    3:1 on a capacity-1 fleet - while both are active, delivered-row
+    shares land within 15% of the configured 75/25 split, and the
+    low-weight client keeps making progress throughout (no starvation)."""
+    disp, addr = dispatcher
+    _start_worker(addr, capacity=1)
+    _wait_for(lambda: len(disp.stats()["workers"]) == 1)
+    n = 80
+    results = {}
+    done_at = {}
+
+    def run_client(tag, weight):
+        ex = ServiceExecutor(addr, telemetry=Telemetry(), window=8,
+                             weight=weight)
+        ex.start(SlowEchoFactory(0.01))
+        deliveries = []
+
+        def feed():
+            for i in range(n):
+                ex.put(VentilatedItem(i, f"{tag}-{i}"))
+
+        feeder = threading.Thread(target=feed)
+        feeder.start()
+        for _ in range(n):
+            deliveries.append((time.monotonic(), ex.get(timeout=60.0)))
+        done_at[tag] = time.monotonic()
+        results[tag] = deliveries
+        feeder.join()
+        ex.stop()
+        ex.join()
+
+    threads = [threading.Thread(target=run_client, args=("A", 3.0)),
+               threading.Thread(target=run_client, args=("B", 1.0))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactness first: QoS must never lose or duplicate a row
+    for tag in ("A", "B"):
+        assert sorted(int(v.split("-")[1])
+                      for _, v in results[tag]) == list(range(n)), tag
+    # shares measured while BOTH were active (at the first finisher's
+    # completion moment)
+    first_done = min(done_at.values())
+    got_a = sum(1 for t, _ in results["A"] if t <= first_done)
+    got_b = sum(1 for t, _ in results["B"] if t <= first_done)
+    share_a = got_a / (got_a + got_b)
+    assert abs(share_a - 0.75) <= 0.15, \
+        f"A={got_a} B={got_b} share={share_a:.2f} (want 0.75 +- 0.15)"
+    # starvation freedom: the low-weight client made real progress while
+    # the heavy one was still running
+    assert got_b >= n * 0.1, f"B starved: {got_b}/{n} while A ran"
+
+
+def test_strict_priority_tiers(dispatcher):
+    """Priority is STRICT: with both clients' full backlogs pending before
+    any worker exists, every high-tier item is SERVED (decoded at the
+    capacity-1 worker) before any low-tier one.  Order is measured at the
+    worker - client-side delivery timestamps race across drain threads."""
+    disp, addr = dispatcher
+    n = 25
+    del SERVED_ORDER[:]
+    hi = ServiceExecutor(addr, telemetry=Telemetry(), window=2 * n,
+                         priority=1)
+    lo = ServiceExecutor(addr, telemetry=Telemetry(), window=2 * n,
+                         priority=0)
+    hi.start(OrderRecordingEchoFactory())
+    lo.start(OrderRecordingEchoFactory())
+    try:
+        for i in range(n):
+            hi.put(VentilatedItem(i, f"hi-{i}"))
+            lo.put(VentilatedItem(i, f"lo-{i}"))
+        _wait_for(lambda: sum(c["pending"] for c in
+                              disp.stats()["clients"].values()) == 2 * n,
+                  what="full backlog pending")
+        _start_worker(addr, capacity=1)
+        hi_done = []
+        lo_done = []
+
+        def drain(ex, out):
+            for _ in range(n):
+                out.append(ex.get(timeout=30.0))
+
+        threads = [threading.Thread(target=drain, args=(hi, hi_done)),
+                   threading.Thread(target=drain, args=(lo, lo_done))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(hi_done) == sorted(f"hi-{i}" for i in range(n))
+        assert sorted(lo_done) == sorted(f"lo-{i}" for i in range(n))
+        served = list(SERVED_ORDER)
+        assert len(served) == 2 * n
+        last_hi = max(i for i, v in enumerate(served)
+                      if v.startswith("hi-"))
+        first_lo = min(i for i, v in enumerate(served)
+                       if v.startswith("lo-"))
+        assert last_hi < first_lo, \
+            (f"a low-priority item was served while high-tier work was"
+             f" pending: {served}")
+        qos = disp.stats()["qos"]
+        prios = {q["priority"] for q in qos.values()}
+        assert prios == {0, 1}, qos
+    finally:
+        for ex in (hi, lo):
+            ex.stop()
+            ex.join()
+
+
+def test_admission_control_max_clients():
+    """A NEW session past max_clients is refused with a clear error (and
+    counted) while admitted sessions keep working."""
+    disp = Dispatcher(telemetry=Telemetry(), max_clients=1).start()
+    addr = f"127.0.0.1:{disp.port}"
+    try:
+        _start_worker(addr, capacity=1)
+        ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+        ex.start(PlainEchoFactory())
+        ex.put(VentilatedItem(0, "first"))
+        assert ex.get(timeout=15.0) == "first"
+        refused = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+        with pytest.raises(OSError, match="admission refused"):
+            refused.start(PlainEchoFactory())
+        counters = disp.stats()["counters"]
+        assert counters.get("service.qos.admission_refused", 0) == 1
+        # the admitted session is unaffected
+        ex.put(VentilatedItem(1, "second"))
+        assert ex.get(timeout=15.0) == "second"
+        ex.stop()
+        ex.join()
+    finally:
+        disp.stop()
+        disp.join()
+
+
+def test_per_client_inflight_cap():
+    """max_client_inflight caps what one client occupies at the workers:
+    its in-flight count never exceeds the cap even with spare fleet
+    capacity, and the deferral is counted."""
+    disp = Dispatcher(telemetry=Telemetry(), max_client_inflight=2).start()
+    addr = f"127.0.0.1:{disp.port}"
+    try:
+        _start_worker(addr, capacity=4)
+        _wait_for(lambda: len(disp.stats()["workers"]) == 1)
+        ex = ServiceExecutor(addr, telemetry=Telemetry(), window=16)
+        ex.start(SlowEchoFactory(0.03))
+        n = 24
+        max_seen = 0
+
+        def feed():
+            for i in range(n):
+                ex.put(VentilatedItem(i, f"i-{i}"))
+
+        feeder = threading.Thread(target=feed)
+        feeder.start()
+        got = []
+        while len(got) < n:
+            try:
+                got.append(ex.get(timeout=10.0))
+            except Exception:  # noqa: BLE001 - assert below names the gap
+                break
+            stats = disp.stats()
+            for c in stats["clients"].values():
+                max_seen = max(max_seen, c["inflight"])
+        feeder.join()
+        assert sorted(int(v.split("-")[1]) for v in got) == list(range(n))
+        assert max_seen <= 2, f"inflight cap breached: {max_seen}"
+        counters = disp.stats()["counters"]
+        assert counters.get("service.qos.capped_deferrals", 0) >= 1
+        ex.stop()
+        ex.join()
+    finally:
+        disp.stop()
+        disp.join()
+
+
+def test_reader_qos_kwargs_need_service_address(tmp_path):
+    url = str(tmp_path / "ds")
+    schema = Schema("QoSInts", [Field("x", np.int64)])
+    write_dataset(url, schema, [{"x": i} for i in range(20)],
+                  row_group_size_rows=10)
+    with pytest.raises(PetastormTpuError, match="service_weight"):
+        make_batch_reader(url, service_weight=2.0)
+    with pytest.raises(PetastormTpuError, match="service_weight"):
+        make_batch_reader(url, service_priority=1)
+
+
+def test_client_weight_validation():
+    with pytest.raises(PetastormTpuError, match="weight must be > 0"):
+        ServiceExecutor("127.0.0.1:1", weight=0.0)
+
+
+# -- satellite: per-client counter cap ----------------------------------------
+
+def test_counter_cap_warns_once_and_stats_stay_exact(dispatcher, caplog):
+    """The 100-client registry-counter cap warns ONCE when it trips, adds
+    no new counter names past it, and leaves the exact per-client
+    accounting (stats()/qos) untouched."""
+    disp, _addr = dispatcher
+    disp._client_counter_ids.update(f"cid{i:03d}" for i in range(100))
+    with caplog.at_level("WARNING"):
+        disp._count_client_rows("overflow-client", 10)
+        disp._count_client_rows("overflow-client", 10)
+        disp._count_client_rows("another-over", 5)
+    warnings = [r for r in caplog.records
+                if "per-client counter cap" in r.message]
+    assert len(warnings) == 1, [r.message for r in caplog.records]
+    names = disp.telemetry.snapshot()["counters"]
+    assert not any("overflow-client"[:12] in k for k in names)
+    assert not any("another-over"[:12] in k for k in names)
+    # a pre-cap client still counts
+    disp._count_client_rows("cid000", 7)
+    names = disp.telemetry.snapshot()["counters"]
+    assert names.get("service.client.cid000.rows") == 7
+
+
+# -- satellite: scaling_signal edge cases -------------------------------------
+
+def test_scaling_signal_empty_window(dispatcher):
+    """No reports, no clients, no workers: pressure 0, verdict ok."""
+    disp, _addr = dispatcher
+    sig = disp.scaling_signal()
+    assert sig["pressure"] == 0.0
+    assert sig["recommendation"] == "ok"
+    assert sig["pending_items"] == 0
+
+
+def test_scaling_signal_excludes_reports_older_than_window(dispatcher):
+    disp, _addr = dispatcher
+    now = time.monotonic()
+    with disp._lock:
+        disp._starved_reports.append((now - 30.0, 50.0))  # stale
+        disp._starved_reports.append((now - 0.1, 0.5))    # live
+    sig = disp.scaling_signal(window_s=10.0)
+    assert sig["pressure"] == pytest.approx(0.05, abs=0.01), sig
+
+
+def test_scaling_signal_zero_queue_never_grows(dispatcher):
+    """Pressure without queued work must NOT recommend grow: the clients'
+    bottleneck is not fleet capacity if nothing is waiting for a worker."""
+    disp, addr = dispatcher
+    _start_worker(addr, capacity=2)
+    _wait_for(lambda: len(disp.stats()["workers"]) == 1)
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+    ex.start(PlainEchoFactory())
+    try:
+        # a loudly-starved client with an EMPTY queue
+        ex._starved_s = 50.0
+        ex._stats_sent_at = 0.0
+        ex._maybe_send_stats()
+        _wait_for(lambda: disp.scaling_signal()["pressure"] > 1.0,
+                  what="starved report folded")
+        sig = disp.scaling_signal()
+        assert sig["pending_items"] == 0
+        assert sig["recommendation"] != "grow", sig
+    finally:
+        ex.stop()
+        ex.join()
+
+
+def test_scaling_signal_purged_client_reports_never_grow(dispatcher):
+    """Reports from a client purged past its grace must not leave the
+    signal recommending growth for a fleet with no one to serve."""
+    disp, addr = dispatcher
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+    ex.start(PlainEchoFactory())
+    ex.put(VentilatedItem(0, "queued"))  # no workers: stays pending
+    ex._starved_s = 50.0
+    ex._stats_sent_at = 0.0
+    ex._maybe_send_stats()
+    _wait_for(lambda: disp.scaling_signal()["pressure"] > 1.0,
+              what="starved report folded")
+    assert disp.scaling_signal()["recommendation"] == "grow"
+    ex.stop()  # clean bye -> immediate purge
+    ex.join()
+    _wait_for(lambda: not disp.stats()["clients"], what="client purged")
+    sig = disp.scaling_signal()
+    assert sig["pressure"] > 1.0  # reports still in the window...
+    assert sig["recommendation"] != "grow", sig  # ...but no one to serve
+
+
+def test_scaling_signal_threshold_configurable(dispatcher):
+    """Satellite: the pressure threshold threads end to end - per call,
+    per dispatcher (ctor/--starved-threshold), instead of hard-reading the
+    AutotunePolicy class attribute."""
+    disp, addr = dispatcher
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+    ex.start(PlainEchoFactory())
+    try:
+        ex.put(VentilatedItem(0, "queued"))  # pending work, no workers
+        now = time.monotonic()
+        with disp._lock:
+            disp._starved_reports.append((now, 1.0))  # pressure 0.1
+        _wait_for(lambda: any(c["pending"] for c in
+                              disp.stats()["clients"].values()),
+                  what="queued item visible at the dispatcher")
+        assert disp.scaling_signal()["recommendation"] == "grow"  # capacity 0
+        sig = disp.scaling_signal(threshold=0.05)
+        assert sig["starved_threshold"] == 0.05
+        assert sig["recommendation"] == "grow"
+    finally:
+        ex.stop()
+        ex.join()
+    # dispatcher-level default
+    disp2 = Dispatcher(telemetry=Telemetry(), starved_threshold=0.33).start()
+    try:
+        assert disp2.scaling_signal()["starved_threshold"] == 0.33
+    finally:
+        disp2.stop()
+        disp2.join()
+
+
+def test_compute_recommendation_rule():
+    # grow needs clients AND pending
+    assert compute_recommendation(1.0, 0.2, pending=3, capacity=0,
+                                  busy_fraction=0, clients=1) == "grow"
+    assert compute_recommendation(1.0, 0.2, pending=0, capacity=0,
+                                  busy_fraction=0, clients=1) == "ok"
+    assert compute_recommendation(1.0, 0.2, pending=3, capacity=2,
+                                  busy_fraction=1.0, clients=0) == "ok"
+    # shrink: idle capacity, even with zero clients
+    assert compute_recommendation(0.0, 0.2, pending=0, capacity=4,
+                                  busy_fraction=0.0, clients=0) == "shrink"
+    assert compute_recommendation(0.0, 0.2, pending=0, capacity=4,
+                                  busy_fraction=0.5, clients=1) == "ok"
+
+
+# -- the supervisor (deterministic unit tests on canned signals) --------------
+
+def _sig(recommendation, pressure=0.5, pending=4, capacity=2,
+         busy=0.0, clients=1):
+    return {"pressure": pressure, "starved_threshold": 0.2,
+            "busy_fraction": busy, "pending_items": pending,
+            "worker_capacity": capacity, "workers": capacity,
+            "connected_clients": clients,
+            "recommendation": recommendation}
+
+
+class FakeDispatcher:
+    """Canned scaling signals, popped one per poll (last one repeats)."""
+
+    port = 0
+
+    def __init__(self, signals):
+        self.signals = list(signals)
+
+    def scaling_signal(self, window_s=10.0, threshold=None):
+        if len(self.signals) > 1:
+            return self.signals.pop(0)
+        return self.signals[0]
+
+
+class FakeSpawner:
+    external = False
+
+    def __init__(self, retire_ok=True):
+        self.spawned = []
+        self.retired = []
+        self.killed = []
+        self.retire_ok = retire_ok
+        self.dead = set()
+
+    def spawn(self, name):
+        self.spawned.append(name)
+        return name
+
+    def alive(self, handle):
+        return handle not in self.dead
+
+    def retire(self, handle, timeout_s):
+        self.retired.append(handle)
+        return self.retire_ok
+
+    def kill(self, handle):
+        self.killed.append(handle)
+
+
+def _supervisor(signals, spawner=None, **policy_kwargs):
+    policy_kwargs.setdefault("min_workers", 0)
+    policy_kwargs.setdefault("max_workers", 4)
+    policy_kwargs.setdefault("grow_windows", 2)
+    policy_kwargs.setdefault("shrink_windows", 2)
+    policy_kwargs.setdefault("settle_s", 0.2)
+    policy_kwargs.setdefault("poll_interval_s", 0.05)
+    return AutoscaleSupervisor(
+        dispatcher=FakeDispatcher(signals),
+        spawner=spawner or FakeSpawner(),
+        policy=AutoscalePolicy(**policy_kwargs))
+
+
+def test_supervisor_grows_only_on_sustained_pressure():
+    sup = _supervisor([_sig("grow"), _sig("ok"), _sig("grow"), _sig("grow")])
+    assert sup.step() is None      # grow x1: streak 1 < grow_windows
+    assert sup.step() is None      # ok: streak resets
+    assert sup.step() is None      # grow x1 again
+    assert sup.step() == "scale-up"
+    assert sup.spawner.spawned == ["as1"]
+
+
+def test_supervisor_settles_after_scale_event():
+    sup = _supervisor([_sig("grow")], settle_s=0.5)
+    sup.step()
+    assert sup.step() == "scale-up"
+    # inside the settle window verdicts do not accumulate
+    assert sup.step() is None
+    assert sup.step() is None
+    assert len(sup.spawner.spawned) == 1
+    time.sleep(0.6)
+    sup.step()
+    assert sup.step() == "scale-up"
+    assert len(sup.spawner.spawned) == 2
+
+
+def test_supervisor_respects_max_workers():
+    sup = _supervisor([_sig("grow")], grow_windows=1, settle_s=0.0,
+                      max_workers=2)
+    assert sup.step() == "scale-up"
+    assert sup.step() == "scale-up"
+    assert sup.step() is None  # at the ceiling
+    assert len(sup.spawner.spawned) == 2
+
+
+def test_supervisor_shrinks_gracefully_and_counts_force_kills():
+    spawner = FakeSpawner()
+    sup = _supervisor([_sig("grow"), _sig("shrink")], grow_windows=1,
+                      shrink_windows=2, settle_s=0.0, spawner=spawner)
+    assert sup.step() == "scale-up"
+    sup.step()
+    assert sup.step() == "scale-down"
+    assert spawner.retired == ["as1"]
+    assert not spawner.killed
+    assert sup.summary()["counters"]["workers_retired"] == 1
+    assert sup.summary()["counters"]["workers_force_killed"] == 0
+    # a drain that misses its budget is force-killed (and counted)
+    spawner2 = FakeSpawner(retire_ok=False)
+    sup2 = _supervisor([_sig("grow"), _sig("shrink")], grow_windows=1,
+                       shrink_windows=2, settle_s=0.0, spawner=spawner2,
+                       drain_timeout_s=0.1)
+    sup2.step()
+    sup2.step()
+    assert sup2.step() == "scale-down"
+    assert spawner2.killed == ["as1"]
+    assert sup2.summary()["counters"]["workers_force_killed"] == 1
+
+
+def test_supervisor_floor_is_self_healing():
+    spawner = FakeSpawner()
+    sup = _supervisor([_sig("ok")], min_workers=2, spawner=spawner)
+    assert sup.step() == "floor"
+    assert len(spawner.spawned) == 2
+    # one dies on its own: reaped + respawned by the floor, no verdict
+    spawner.dead.add(spawner.spawned[0])
+    assert sup.step() == "floor"
+    assert len(spawner.spawned) == 3
+    assert sup.summary()["counters"]["workers_lost"] == 1
+    assert sup.fleet_size(None) == 2
+
+
+def test_supervisor_stop_retires_spawned_fleet():
+    spawner = FakeSpawner()
+    sup = _supervisor([_sig("grow")], grow_windows=1, settle_s=0.0,
+                      spawner=spawner)
+    sup.step()
+    assert len(spawner.spawned) == 1
+    sup.stop()
+    assert spawner.retired == ["as1"]
+    assert sup.fleet_size(None) == 0
+
+
+def test_exec_hook_contract(tmp_path):
+    """The --exec-hook contract: one JSON object on stdin per scale event
+    with action/address/workers/target/pressure/policy fields; bounds
+    apply to the OBSERVED worker count for external fleets."""
+    out = tmp_path / "events.jsonl"
+    hook = ExecHookSpawner(f"cat >> {out}")
+    sup = AutoscaleSupervisor(
+        dispatcher=FakeDispatcher(
+            [_sig("grow", capacity=1), _sig("grow", capacity=1)]),
+        spawner=hook,
+        policy=AutoscalePolicy(min_workers=0, max_workers=4, grow_windows=1,
+                               settle_s=0.0, poll_interval_s=0.05))
+    assert sup.step() == "scale-up"
+    events = [json.loads(line) for line in
+              out.read_text().strip().splitlines()]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["action"] == "scale_up"
+    assert ev["workers"] == 1 and ev["target"] == 2
+    assert ev["policy"] == {"min_workers": 0, "max_workers": 4}
+    assert "pressure" in ev and "reason" in ev
+    # a failing hook is counted, not raised
+    sup_fail = AutoscaleSupervisor(
+        dispatcher=FakeDispatcher([_sig("grow")]),
+        spawner=ExecHookSpawner("exit 3"),
+        policy=AutoscalePolicy(min_workers=0, grow_windows=1, settle_s=0.0))
+    sup_fail.step()
+    assert sup_fail.summary()["counters"]["exec_hook_failures"] == 1
+
+
+def test_exec_hook_floor_never_actuates_on_a_failed_probe(tmp_path):
+    """An external fleet is sized off the OBSERVED worker count; a failed
+    probe makes that a guess.  The floor branch must NOT hand the
+    orchestrator target=min_workers off a guessed fleet of 0 - that would
+    shrink a healthy fleet the supervisor cannot see (and re-fire every
+    poll)."""
+    out = tmp_path / "events.jsonl"
+    sup = AutoscaleSupervisor(
+        "127.0.0.1:1",  # dead address: every probe fails
+        spawner=ExecHookSpawner(f"cat >> {out}"),
+        policy=AutoscalePolicy(min_workers=2, max_workers=8,
+                               poll_interval_s=0.05, settle_s=0.2))
+    assert sup.step() is None
+    assert sup.step() is None
+    assert not out.exists(), out.read_text()
+    # a live signal showing a short fleet DOES hold the floor...
+    sup2 = AutoscaleSupervisor(
+        dispatcher=FakeDispatcher([_sig("ok", capacity=1)]),
+        spawner=ExecHookSpawner(f"cat >> {out}"),
+        policy=AutoscalePolicy(min_workers=2, max_workers=8,
+                               poll_interval_s=0.05, settle_s=60.0))
+    assert sup2.step() == "floor"
+    events = [json.loads(l) for l in out.read_text().strip().splitlines()]
+    assert len(events) == 1 and events[0]["target"] == 2
+    # ...and settles instead of re-firing while registration lags
+    assert sup2.step() is None
+    assert len(out.read_text().strip().splitlines()) == 1
+
+
+def test_admission_counts_only_connected_sessions():
+    """A crashed trainer riding out its reconnect grace must not hold a
+    seat against its replacement: the max_clients cap counts CONNECTED
+    sessions only."""
+    disp = Dispatcher(telemetry=Telemetry(), max_clients=1).start()
+    addr = f"127.0.0.1:{disp.port}"
+    try:
+        _start_worker(addr, capacity=1)
+        ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+        ex.start(PlainEchoFactory())
+        ex.put(VentilatedItem(0, "a"))
+        assert ex.get(timeout=15.0) == "a"
+        # simulate an unclean death mid-grace: the session state lingers
+        # but the seat frees the moment the connection is gone
+        with disp._lock:
+            cid = next(iter(disp._clients))
+            disp._clients[cid].connected = False
+            disp._clients[cid].disconnected_at = time.monotonic()
+        ex2 = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+        ex2.start(PlainEchoFactory())  # must be ADMITTED
+        ex2.put(VentilatedItem(0, "b"))
+        assert ex2.get(timeout=15.0) == "b"
+        ex2.stop()
+        ex2.join()
+        ex.stop()
+        ex.join()
+    finally:
+        disp.stop()
+        disp.join()
+
+
+def test_supervisor_remote_probe_and_threshold_override(dispatcher):
+    """The address-mode supervisor probes stats frames and re-judges the
+    verdict under its own --starved-threshold using the shared rule."""
+    disp, addr = dispatcher
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=4)
+    ex.start(PlainEchoFactory())
+    try:
+        ex.put(VentilatedItem(0, "queued"))
+        now = time.monotonic()
+        with disp._lock:
+            disp._starved_reports.append((now, 1.0))  # pressure 0.1
+        _start_worker(addr, capacity=1)  # so capacity > 0: verdict hinges
+        _wait_for(lambda: len(disp.stats()["workers"]) == 1)
+        #                  purely on the threshold
+        sup = AutoscaleSupervisor(
+            addr, spawner=FakeSpawner(),
+            policy=AutoscalePolicy(min_workers=0, starved_threshold=0.05))
+        sig = sup.signal()
+        assert sig is not None
+        assert sig["starved_threshold"] == 0.05
+        # 0.1 > 0.05 and work is pending (the worker may or may not have
+        # drained the one item yet; accept both verdicts consistently)
+        expected = compute_recommendation(
+            sig["pressure"], 0.05, sig["pending_items"],
+            sig["worker_capacity"], sig["busy_fraction"],
+            sig["connected_clients"])
+        assert sig["recommendation"] == expected
+        # probe failure path: dead address
+        sup2 = AutoscaleSupervisor(
+            "127.0.0.1:1", spawner=FakeSpawner(),
+            policy=AutoscalePolicy(min_workers=0))
+        assert sup2.signal() is None
+        assert sup2.summary()["counters"]["probe_failures"] == 1
+    finally:
+        ex.stop()
+        ex.join()
+
+
+def test_supervisor_ctor_validation():
+    with pytest.raises(PetastormTpuError, match="exactly one"):
+        AutoscaleSupervisor()
+    with pytest.raises(PetastormTpuError, match="exactly one"):
+        AutoscaleSupervisor("127.0.0.1:1", dispatcher=FakeDispatcher([]))
+    with pytest.raises(PetastormTpuError, match="explicit spawner"):
+        AutoscaleSupervisor(dispatcher=FakeDispatcher([]))
+    with pytest.raises(PetastormTpuError, match="max_workers"):
+        AutoscalePolicy(min_workers=4, max_workers=2)
+    with pytest.raises(PetastormTpuError, match="non-empty"):
+        ExecHookSpawner("  ")
+
+
+# -- graceful worker retirement (the scale-down primitive) --------------------
+
+def test_worker_graceful_retire_finishes_inflight(dispatcher):
+    """retire() drains: every item the worker held is DELIVERED (not
+    requeued), the dispatcher stops assigning to it the moment it
+    announces, and the worker exits clean."""
+    disp, addr = dispatcher
+    worker = ServiceWorker(addr, capacity=2, heartbeat_interval_s=0.3)
+    thread = threading.Thread(target=worker.run, daemon=True)
+    thread.start()
+    _wait_for(lambda: len(disp.stats()["workers"]) == 1)
+    ex = ServiceExecutor(addr, telemetry=Telemetry(), window=8)
+    ex.start(SlowEchoFactory(0.05))
+    n = 10
+    got = []
+
+    def feed():
+        for i in range(n):
+            ex.put(VentilatedItem(i, f"i-{i}"))
+
+    feeder = threading.Thread(target=feed)
+    feeder.start()
+    got.append(ex.get(timeout=15.0))  # the worker is mid-stream now
+    retire_done = {}
+
+    def retire():
+        retire_done["graceful"] = worker.retire(timeout=30.0)
+
+    retirer = threading.Thread(target=retire)
+    retirer.start()
+    # the retiring worker must still complete what it holds; remaining
+    # items stay PENDING at the dispatcher (no free non-draining workers)
+    # until a replacement joins
+    _wait_for(lambda: disp.stats()["workers"].get(
+        worker.worker_name, {}).get("draining", False) or
+        worker.worker_name not in disp.stats()["workers"],
+        what="draining visible in stats")
+    _start_worker(addr, capacity=2, name="replacement")
+    while len(got) < n:
+        got.append(ex.get(timeout=30.0))
+    retirer.join(timeout=30.0)
+    feeder.join()
+    assert retire_done.get("graceful") is True
+    assert worker.retired_gracefully
+    assert sorted(int(v.split("-")[1]) for v in got) == list(range(n))
+    counters = disp.stats()["counters"]
+    assert counters.get("service.requeued_items", 0) == 0, counters
+    assert counters.get("service.qos.workers_draining", 0) == 1
+    ex.stop()
+    ex.join()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()

@@ -65,6 +65,15 @@ pure property of the packer + corpus shape and carries the 0.85 absolute
 floor from the ISSUE 11 acceptance bar; the two absolute-rate members
 (``sequence_packed_tokens_per_sec`` / ``..._padded_anchor_...``) drift
 with the host like any rate.
+
+Autoscale metrics (BENCH_r12+, docs/operations.md "Fleet autoscaling &
+QoS"): ``autoscale_vs_static_ratio`` prices the closed loop - an
+undersized 1-worker fleet plus a live ``AutoscaleSupervisor`` over a
+fleet statically sized right from the start, same session (drift-immune),
+INCLUDING the loop's detect->spawn->register reaction window.  Absolute
+floor 0.8x (the ISSUE 14 acceptance bar); the two absolute-rate members
+(``autoscale_fleet_samples_per_sec`` /
+``autoscale_static_anchor_samples_per_sec``) drift with the host.
 """
 
 from __future__ import annotations
@@ -95,6 +104,10 @@ ABSOLUTE_FLOORS = {
     # runtimes where the arena plane is live, python >= 3.12)
     "service_vs_inprocess_ratio": 0.7,
     "service_colocated_vs_inprocess_ratio": 0.9,
+    # ISSUE 14: a 1-worker fleet + the live autoscale supervisor must land
+    # within 0.8x of a statically right-sized fleet on the same read -
+    # the closed loop's detect->spawn->register latency is what's priced
+    "autoscale_vs_static_ratio": 0.8,
 }
 
 
