@@ -318,6 +318,125 @@ print("warm-cache smoke OK"
       f" {int(cb['cache.hits'])} hits, 0 decodes, rows exact)")
 PY
 
+echo "== transform-warm smoke (post-transform caching: warm epoch skips decode AND transform) =="
+# a deterministic transform over the shared tier: reader A decodes+transforms
+# cold; reader B - a NEW reader, same tier - must deliver the exact
+# transformed rows with cache.transform_hits > 0, ZERO additional rowgroup
+# decodes AND zero transform stage samples - the ISSUE 15 contract that warm
+# epochs skip both stages (docs/operations.md "Transform caching & the
+# pipeline planner")
+JAX_PLATFORMS=cpu timeout -k 10 120 python - <<'PY'
+import tempfile
+import numpy as np
+from petastorm_tpu.cache_shared import SharedWarmCache
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.test_util.synthetic import synthetic_rgb_image
+from petastorm_tpu.transform import TransformSpec
+
+tmp = tempfile.mkdtemp(prefix="petastorm_tpu_tfwarm_smoke_")
+tier = tempfile.mkdtemp(prefix="petastorm_tpu_tfwarm_tier_")
+schema = Schema("TfWarmSmoke", [
+    Field("label", np.int64, (), ScalarCodec()),
+    Field("image", np.uint8, (48, 48, 3), CompressedImageCodec("jpeg", quality=90)),
+])
+write_dataset(tmp, schema,
+              [{"label": i, "image": synthetic_rgb_image(i, 48, 48)}
+               for i in range(48)], row_group_size_rows=8)
+
+def brighten(cols):
+    out = dict(cols)
+    out["label"] = cols["label"] + 1000
+    return out
+
+def read(tele):
+    spec = TransformSpec(brighten, deterministic=True)
+    with make_batch_reader(tmp, reader_pool_type="thread", workers_count=2,
+                           shuffle_row_groups=False, cache_type="shared",
+                           cache_location=tier, transform_spec=spec,
+                           telemetry=tele) as reader:
+        return sorted(int(x) for b in reader.iter_batches()
+                      for x in b.columns["label"])
+
+tele_a, tele_b = Telemetry(), Telemetry()
+rows_a = read(tele_a)
+rows_b = read(tele_b)
+assert rows_a == rows_b == [i + 1000 for i in range(48)], (rows_a[:3], rows_b[:3])
+ca = tele_a.snapshot()["counters"]
+cb = tele_b.snapshot()["counters"]
+assert ca["cache.transform_stores"] == 6, ca           # cold: 6 rowgroups stored
+assert ca["stage.transform.count"] == 6, ca            # transform ran cold only
+assert cb["cache.transform_hits"] >= 6, cb             # warm re-read hit the tier
+assert cb.get("decode.batch_calls", 0) == 0, cb        # ZERO extra decodes
+assert cb.get("stage.transform.count", 0) == 0, cb     # ZERO transform samples
+assert cb.get("stage.decode.count", 0) == 0, cb        # ZERO decode samples
+SharedWarmCache(location=tier).cleanup()
+print("transform-warm smoke OK"
+      f" (cold: {int(ca['cache.transform_stores'])} post-transform stores,"
+      f" {int(ca['stage.transform.count'])} transform runs; warm re-read:"
+      f" {int(cb['cache.transform_hits'])} transform hits, 0 decodes,"
+      " 0 transform stage samples, rows exact)")
+PY
+
+echo "== planner smoke (cold read writes a flight profile, a second process starts from it) =="
+# the ISSUE 15 planner contract across REAL processes: an autotuned cold
+# read plans from parquet metadata, converges, and persists a flight
+# profile at stop; a SECOND reader process over the same cache location
+# must plan >= 1 knob from that profile (provenance 'profile'), deliver the
+# exact rows, and surface the verdict in diagnostics['planner']
+PLANNER_SMOKE_DIR="$(mktemp -d /tmp/petastorm_tpu_planner_smoke_XXXXXX)"
+PLANNER_SMOKE="$(mktemp /tmp/petastorm_tpu_planner_smoke_XXXXXX.py)"
+cat > "$PLANNER_SMOKE" <<'PY'
+import json
+import os
+import sys
+
+import numpy as np
+
+from petastorm_tpu.autotune import AutotunePolicy
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+
+base, phase = sys.argv[1], sys.argv[2]
+url = os.path.join(base, "ds")
+loc = os.path.join(base, "profiles")
+if phase == "cold":
+    schema = Schema("PlannerSmoke", [Field("x", np.int64)])
+    write_dataset(url, schema, [{"x": i} for i in range(400)],
+                  row_group_size_rows=4)
+policy = AutotunePolicy(warmup_s=0.2, settle_s=0.2, tick_s=0.05,
+                        eval_points=2, cooldown_s=0.1)
+with make_batch_reader(url, reader_pool_type="thread", workers_count="auto",
+                       shuffle_row_groups=False, autotune=policy,
+                       cache_location=loc, sample_interval_s=0.1) as r:
+    assert r.planner is not None, "planner did not run"
+    rows = sorted(int(v) for b in r.iter_batches() for v in b.columns["x"])
+    diag = r.diagnostics["planner"]
+    profile_path = r.planner.profile_path
+assert rows == list(range(400)), len(rows)
+knobs = diag["knobs"]
+if phase == "warm":
+    srcs = {k: v["source"] for k, v in knobs.items()}
+    assert any(s == "profile" for s in srcs.values()), srcs
+    nondefault = [k for k, v in knobs.items()
+                  if v["source"] in ("profile", "metadata")]
+    assert nondefault, knobs
+    print("warm plan sources:", json.dumps(srcs))
+print(f"{phase} OK: planned {json.dumps({k: v['value'] for k, v in knobs.items()})}")
+PY
+JAX_PLATFORMS=cpu timeout -k 10 120 python "$PLANNER_SMOKE" "$PLANNER_SMOKE_DIR" cold
+PROFILE_COUNT=$(find "$PLANNER_SMOKE_DIR" -name 'profile-*.json' | wc -l)
+[ "$PROFILE_COUNT" -ge 1 ] || {
+    echo "planner smoke FAILED: cold run wrote no flight profile"; exit 1; }
+JAX_PLATFORMS=cpu timeout -k 10 120 python "$PLANNER_SMOKE" "$PLANNER_SMOKE_DIR" warm
+rm -rf "$PLANNER_SMOKE_DIR" "$PLANNER_SMOKE"
+echo "planner smoke OK (cold run persisted a flight profile; a second"
+echo "  process planned from it with >= 1 non-default knob + exact rows)"
+
 echo "== service smoke (disaggregated ingest: dispatcher + fleet + 2 clients, one worker SIGKILLed) =="
 # the full service topology as REAL subprocesses: a dispatcher (CLI), two
 # fleet workers (CLI), and two trainer clients, with one worker SIGKILLed

@@ -30,7 +30,9 @@ API = [
                               "CompressedNdarrayCodec", "CompressedImageCodec",
                               "register_codec"]),
     ("petastorm_tpu.transform", ["TransformSpec", "transform_schema",
-                                 "transform_signature"]),
+                                 "transform_signature",
+                                 "transform_output_cacheable",
+                                 "transform_cache_info"]),
     ("petastorm_tpu.predicates", ["in_set", "in_intersection", "in_lambda",
                                   "in_negate", "in_reduce",
                                   "in_pseudorandom_split"]),
@@ -123,8 +125,13 @@ API = [
                                         "render_prometheus", "write_jsonl"]),
     ("petastorm_tpu.autotune", ["AutotunePolicy", "AutotuneController",
                                 "resolve_autotune"]),
+    ("petastorm_tpu.planner", ["plan_reader", "PlanVerdict", "PlannedKnob",
+                               "ProfileStore", "footer_stats",
+                               "dataset_fingerprint", "schema_hash",
+                               "build_profile", "write_profile"]),
     ("petastorm_tpu.tools.diagnose", ["run_diagnosis",
                                       "render_autotune_verdict",
+                                      "render_planner_verdict",
                                       "render_liveness_verdict",
                                       "render_stream_digest",
                                       "render_watch_frame"]),

@@ -119,6 +119,13 @@ class AutotunePolicy:
     #: that do not show up as queue waits, e.g. GIL contention); reverts
     #: clean up wrong guesses
     explore: bool = True
+    #: run the STATIC pipeline planner (petastorm_tpu.planner) at reader
+    #: construction: one parquet-footer pass + the recorded per-dataset
+    #: flight profile seed the initial knob values, so this runtime loop
+    #: starts near the optimum and only fine-tunes (docs/operations.md
+    #: "Transform caching & the pipeline planner").  False = the old
+    #: explore-from-static-defaults cold start.
+    planner: bool = True
     #: knob names the controller must never attach or move ('workers',
     #: 'results_queue', 'prefetch', 'cache_mem', 'decode_split').  Set by
     #: make_reader for knobs whose moves would change delivered CONTENT

@@ -38,9 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
                         metavar="FIELD",
                         help="decode these jpeg fields on-chip"
                              " (decode_placement='device'; --method jax only)")
-    parser.add_argument("--prefetch", type=int, default=2,
+    parser.add_argument("--prefetch", type=int, default=None,
                         help="loader queue depth per producer stage"
-                             " (--method jax only)")
+                             " (--method jax only; default: the pipeline"
+                             " planner's verdict under --autotune, else 2)")
     parser.add_argument("--no-shuffle", action="store_true",
                         help="disable rowgroup shuffling")
     parser.add_argument("--telemetry", action="store_true",
@@ -184,6 +185,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             line += (f", input stall {result.input_stall_percent:.1f}%"
                      f" (prefetch depth {result.prefetch_depth_avg:.1f})")
         print(line)
+        if result.planner:
+            # the static planner's seed verdict (per-knob provenance), so an
+            # --autotune run shows where its starting knobs came from
+            from petastorm_tpu.tools.diagnose import render_planner_verdict
+            print(render_planner_verdict(result.planner))
         if result.metrics:
             # metrics may come from THIS process' recorder or from the
             # isolated child's JSON snapshot; the report renders either

@@ -45,6 +45,9 @@ class BenchmarkResult:
     #: the run was telemetered - stage busy seconds, queue waits, counters;
     #: feed it to telemetry.render_pipeline_report() for the bottleneck view
     metrics: "dict | None" = None
+    #: static planner verdict (petastorm_tpu.planner.PlanVerdict.to_dict())
+    #: when the run was autotuned - planned knobs with per-knob provenance
+    planner: "dict | None" = None
 
     def to_json(self) -> str:
         d = {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
@@ -151,9 +154,12 @@ def reader_throughput(dataset_url: str,
         samples = consume(measure_cycles)
         wall = time.perf_counter() - t0
         cpu = clock.stop()
+        planner = (reader.planner.to_dict()
+                   if reader.planner is not None else None)
     return BenchmarkResult(samples_per_sec=samples / wall, wall_s=wall,
                            samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu,
-                           metrics=tele.snapshot() if tele.enabled else None)
+                           metrics=tele.snapshot() if tele.enabled else None,
+                           planner=planner)
 
 
 def jax_loader_throughput(dataset_url: str,
@@ -167,7 +173,7 @@ def jax_loader_throughput(dataset_url: str,
                           storage_options: Optional[dict] = None,
                           simulated_step_s: float = 0.0,
                           device_decode_fields: Sequence[str] = (),
-                          prefetch: int = 2,
+                          prefetch: Optional[int] = None,
                           telemetry=None, chaos=None,
                           on_error="raise",
                           item_deadline_s: Optional[float] = None,
@@ -252,11 +258,14 @@ def jax_loader_throughput(dataset_url: str,
         samples = consume(measure_batches)
         wall = time.perf_counter() - t0
         cpu = clock.stop()
+        planner = (reader.planner.to_dict()
+                   if reader.planner is not None else None)
     return BenchmarkResult(samples_per_sec=samples / wall, wall_s=wall,
                            samples=samples, rss_mb=_rss_mb(), cpu_percent=cpu,
                            input_stall_percent=100.0 * wait_s / wall,
                            prefetch_depth_avg=depth_sum / max(depth_n, 1),
-                           metrics=tele.snapshot() if tele.enabled else None)
+                           metrics=tele.snapshot() if tele.enabled else None,
+                           planner=planner)
 
 
 def run_isolated(cli_args: List[str]) -> BenchmarkResult:

@@ -91,7 +91,12 @@ _HEADER_DTYPE = np.dtype([
     ("magic", "<u8"), ("nslots", "<u8"), ("tick", "<u8"),
     ("hits", "<u8"), ("misses", "<u8"), ("l2_hits", "<u8"),
     ("stores", "<u8"), ("rejected_stores", "<u8"), ("evictions", "<u8"),
-    ("bytes", "<u8"), ("target_bytes", "<u8"), ("pad", "V40")])
+    ("bytes", "<u8"), ("target_bytes", "<u8"),
+    # post-transform entries (ISSUE 15): lookups of transform-stage keys,
+    # refined out of hits/misses so operators can tell the tiers apart.
+    # Carved out of the old pad space, so the layout (and magic) is
+    # unchanged for existing segments - they just read 0 here.
+    ("transform_hits", "<u8"), ("transform_stores", "<u8"), ("pad", "V24")])
 
 _SLOT_DTYPE = np.dtype([
     ("digest0", "<u8"), ("digest1", "<u8"),
@@ -100,6 +105,12 @@ _SLOT_DTYPE = np.dtype([
     ("tick", "<u8"), ("pin_wall", "<f8"), ("pad", "V8")])
 
 _EMPTY, _VALID = 0, 1
+
+#: shared-header counters the owning reader folds into telemetry as the
+#: ``cache.*`` series (publish_telemetry); one list, three consumers
+#: (publish baseline, publish loop, stats)
+_PUBLISHED_COUNTERS = ("hits", "misses", "l2_hits", "stores", "evictions",
+                       "transform_hits", "transform_stores")
 
 assert _HEADER_DTYPE.itemsize == 128 and _SLOT_DTYPE.itemsize == 64
 
@@ -218,8 +229,7 @@ class SharedWarmCache(CacheBase):
             # baseline for publish deltas: tier activity before this
             # instance existed belongs to other readers' ledgers
             self._published = {k: int(self._header[k][0])
-                               for k in ("hits", "misses", "l2_hits",
-                                         "stores", "evictions")}
+                               for k in _PUBLISHED_COUNTERS}
             return True
         except Exception as exc:  # noqa: BLE001 - degrade, never break reads
             logger.warning(
@@ -628,6 +638,24 @@ class SharedWarmCache(CacheBase):
             if tick:
                 self._header["tick"] += 1
 
+    def note_transform_event(self, hit: bool) -> None:
+        """Count one POST-TRANSFORM cache lookup (worker.py calls this right
+        after a transform-stage ``get``).  These refine hits/misses: a warm
+        transform hit skipped decode AND transform, a transform store just
+        paid both once for every later reader on the tier.  Lands in the
+        shared header, so process-pool workers' events survive the process
+        boundary and publish through the owning reader like every cache.*
+        counter."""
+        if not self._ensure_ready():
+            # L1 down (disk-only tier): keep counting - the header is gone,
+            # so fall back to this instance's telemetry directly
+            tele = self._telemetry
+            if tele is not None and tele.enabled:
+                tele.counter("cache.transform_hits" if hit
+                             else "cache.transform_stores").add(1)
+            return
+        self._bump("transform_hits" if hit else "transform_stores")
+
     @property
     def l1_enabled(self) -> bool:
         """True when the shared-memory level is live (attached or
@@ -683,6 +711,8 @@ class SharedWarmCache(CacheBase):
                 "stores": int(h["stores"][0]),
                 "rejected_stores": int(h["rejected_stores"][0]),
                 "evictions": int(h["evictions"][0]),
+                "transform_hits": int(h["transform_hits"][0]),
+                "transform_stores": int(h["transform_stores"][0]),
                 "bytes": int(h["bytes"][0]),
                 "target_bytes": int(h["target_bytes"][0]),
                 "arena_bytes": self._arena.size,
@@ -702,8 +732,7 @@ class SharedWarmCache(CacheBase):
             return
         with self._lock:
             current = {k: int(self._header[k][0])
-                       for k in ("hits", "misses", "l2_hits", "stores",
-                                 "evictions")}
+                       for k in _PUBLISHED_COUNTERS}
             resident = int(self._header["bytes"][0])
             target = int(self._header["target_bytes"][0])
         for name, value in current.items():

@@ -203,7 +203,7 @@ class JaxDataLoader:
                  pad_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
                  pad_values: Union[float, Dict[str, float]] = 0,
                  drop_last: bool = True,
-                 prefetch: int = 2,
+                 prefetch: Optional[int] = None,
                  keep_wide_dtypes: bool = False,
                  transform_fn: Optional[Callable[[Dict[str, np.ndarray]],
                                                  Dict[str, np.ndarray]]] = None,
@@ -451,6 +451,18 @@ class JaxDataLoader:
         else:
             self._make_buffer = NoopShufflingBuffer
 
+        if prefetch is None:
+            # None = planner-seeded: a reader that ran the static pipeline
+            # planner (petastorm_tpu.planner) carries a planned prefetch
+            # depth with provenance; everything else keeps the historical
+            # default of 2.  An explicit int pins the depth.
+            prefetch = 2
+            verdict = getattr(reader, "planner", None)
+            planned = getattr(verdict, "knobs", {}).get("prefetch") \
+                if verdict is not None else None
+            if planned is not None and planned.source in ("profile",
+                                                          "metadata"):
+                prefetch = int(planned.value)
         self._out: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
         # two-stage producer: the assembly thread does the numpy work (batch
         # formation, shuffle, pad) and the transfer thread does the device

@@ -74,7 +74,7 @@ def make_reader(dataset_url: str,
                 schema_fields: Optional[Sequence] = None,
                 reader_pool_type: str = "thread",
                 workers_count: Union[int, str] = 4,
-                results_queue_size: int = _DEFAULT_RESULTS_QUEUE_BATCHES,
+                results_queue_size: Optional[int] = None,
                 shuffle_row_groups: bool = True,
                 shuffle_row_drop_partitions: int = 1,
                 shuffle_seed: Optional[int] = None,
@@ -185,7 +185,14 @@ def make_reader(dataset_url: str,
     ``cache_size_limit`` sizes the shared-memory arena.  Composes with the
     process pool and its zero-copy batch-slot decode; hit/miss/eviction
     rates ride the ``cache.*`` telemetry series, and an armed autotune
-    controller trades cache memory against worker count live.
+    controller trades cache memory against worker count live.  A
+    ``transform_spec`` that is provably deterministic (declared via
+    ``TransformSpec(deterministic=True)`` or concluded by the conservative
+    ``'auto'`` bytecode + closure-constant analysis) additionally caches
+    its OUTPUT under a stage-tagged key, so warm epochs skip decode AND
+    transform (``cache.transform_hits`` / ``cache.transform_stores``
+    counters; docs/operations.md "Transform caching & the pipeline
+    planner").
 
     ``io_retries``: transient remote-IO policy (petastorm_tpu.retry).
     ``'auto'`` = bounded retry-with-backoff on remote filesystems (GCS/S3/
@@ -259,8 +266,15 @@ def make_reader(dataset_url: str,
     shrinks the worker pool, resizes the results-queue bound and - once a
     ``JaxDataLoader`` wraps this reader - its prefetch depth, judging each
     move by delivered samples/s and reverting regressions.
-    ``workers_count='auto'`` now implies it (static core-count seed +
-    runtime loop; pass ``autotune=False`` for the old static-only 'auto').
+    ``workers_count='auto'`` now implies it (pass ``autotune=False`` for
+    the old static-only 'auto').  An armed policy also runs the STATIC
+    pipeline planner first (petastorm_tpu.planner, unless
+    ``AutotunePolicy(planner=False)``): parquet footer metadata plus the
+    per-dataset flight profile recorded at previous readers' stop seed the
+    starting workers / decode_threads / results-bound / prefetch /
+    cache_mem, so the runtime loop only fine-tunes; the verdict with
+    per-knob provenance is ``Reader.diagnostics['planner']`` and renders
+    as a ``planner:`` line in ``diagnose --watch``.
     Auto-enables telemetry + the sampler; inoperative on the serial pool.
     Every decision is visible as ``autotune.*`` counters/gauges, trace
     events, and ``Reader.diagnostics['autotune']``.
@@ -349,7 +363,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       schema_fields: Optional[Sequence] = None,
                       reader_pool_type: str = "thread",
                       workers_count: Union[int, str] = 4,
-                      results_queue_size: int = _DEFAULT_RESULTS_QUEUE_BATCHES,
+                      results_queue_size: Optional[int] = None,
                       shuffle_row_groups: bool = True,
                       shuffle_row_drop_partitions: int = 1,
                       shuffle_seed: Optional[int] = None,
@@ -456,6 +470,18 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
 
     telemetry = _resolve_telemetry(telemetry)
     deterministic = resolve_deterministic(deterministic, shuffle_seed)
+    # None = default bound (10); an EXPLICIT int - even 10 - is pinned and
+    # the planner never overrides it (a plain `= 10` default could not
+    # distinguish "user asked for 10" from "user said nothing")
+    results_queue_pinned = results_queue_size is not None
+    if results_queue_size is None:
+        results_queue_size = _DEFAULT_RESULTS_QUEUE_BATCHES
+    # ONE transform-analysis walk per reader (it md5s bytecode + captured
+    # arrays): the planner's schema hash and the worker's cache signature /
+    # output-caching verdict all derive from this triple
+    from petastorm_tpu.transform import transform_cache_info
+
+    tf_cache_info = transform_cache_info(transform_spec)
     autotune_policy = resolve_autotune(autotune, workers_count,
                                        reader_pool_type)
     if deterministic == "seed" and autotune_policy is not None \
@@ -694,6 +720,51 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
         cores = len(os.sched_getaffinity(0))
     except AttributeError:
         cores = os.cpu_count() or 1
+    planner_verdict = None
+    if (autotune_policy is not None and service_address is None
+            and getattr(autotune_policy, "planner", True)):
+        # the static planner pass (petastorm_tpu.planner): parquet footer
+        # metadata + the recorded per-dataset flight profile seed the knobs
+        # the runtime autotune loop starts from, so a cold start begins near
+        # the optimum instead of exploring from static defaults.  Verdict +
+        # per-knob provenance land in Reader.diagnostics['planner'].
+        from petastorm_tpu import planner as _planner
+        from petastorm_tpu.codecs import CompressedImageCodec
+
+        try:
+            planner_verdict = _planner.plan_reader(
+                info, read_fields, policy=autotune_policy, cores=cores,
+                cache_type=cache_type, cache_location=cache_location,
+                transform_signature=tf_cache_info[0],
+                split_fields=split_fields,
+                workers_count=workers_count, decode_threads=decode_threads,
+                results_queue_size=results_queue_size,
+                results_queue_pinned=results_queue_pinned,
+                image_fields=[f.name for f in view
+                              if isinstance(f.codec, CompressedImageCodec)])
+        except Exception:  # noqa: BLE001 - planning must not fail the read
+            logger.warning("pipeline planner failed; starting from static"
+                           " defaults", exc_info=True)
+    if planner_verdict is not None:
+        planned = planner_verdict.knobs
+        if workers_count == "auto" and "workers" in planned:
+            workers_count = planned["workers"].value
+        if decode_threads == "auto" and "decode_threads" in planned:
+            decode_threads = planned["decode_threads"].value
+        if ("results_queue" in planned
+                and planned["results_queue"].source in ("profile",
+                                                        "metadata")):
+            results_queue_size = planned["results_queue"].value
+        if ("decode_split" in planned and decode_split_cell is not None
+                and "decode_split" not in autotune_policy.exclude_knobs):
+            # profile-recorded converged split side: start there instead of
+            # the static device-side default.  NEVER under
+            # deterministic='seed' (which puts 'decode_split' in
+            # exclude_knobs): the split changes delivered CONTENT, and a
+            # seed-stable run must not depend on hidden on-disk profile
+            # state - two hosts with different profiles would certify
+            # different streams for the same command
+            decode_split_cell.value = planned["decode_split"].value
     if workers_count == "auto":
         # resolved here (it used to happen just before make_executor) so
         # decode_threads='auto' below can size against the real pool width:
@@ -720,7 +791,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                                    decode_threads=int(decode_threads),
                                    decode_roi=decode_roi,
                                    split_fields=split_fields,
-                                   decode_split=decode_split_cell)
+                                   decode_split=decode_split_cell,
+                                   transform_cache_info=tf_cache_info)
     if chaos is not None and chaos.affects_worker():
         from petastorm_tpu.test_util.chaos import ChaosWorker
 
@@ -808,12 +880,30 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
     #: split cell's value when the rowgroup decoded
     reader.device_decode_split = split_fields
     reader._decode_split_cell = decode_split_cell
+    #: the static planner's verdict (petastorm_tpu.planner.PlanVerdict;
+    #: None when the planner did not run) - knob provenance in
+    #: diagnostics['planner'], flight profile written at stop()
+    reader.planner = planner_verdict
     from petastorm_tpu.cache_shared import SharedWarmCache
 
     if isinstance(cache, SharedWarmCache):
         # the reader is the tier's telemetry publisher (cache.* series) and
         # surfaces tier stats in diagnostics; the tier itself is host-wide
         reader.warm_cache = cache
+        if (planner_verdict is not None
+                and "cache_mem" in planner_verdict.knobs
+                and cache.l1_enabled
+                and cache.get_target_bytes() == int(0.8 * cache.l1_size_bytes)
+                and cache.stats().get("bytes", 0)
+                <= planner_verdict.knobs["cache_mem"].value * 2 ** 20):
+            # seed the L1 residency target ONLY while it still sits at its
+            # creation default AND applying it cannot evict: the cap lives
+            # in the tier's shared header, so a value another job (or its
+            # autotune loop) already moved must not be clobbered - and a
+            # concurrent job's resident entries under the untouched default
+            # must not be evicted down to fit THIS reader's smaller dataset
+            cache.set_target_bytes(
+                planner_verdict.knobs["cache_mem"].value * 2 ** 20)
         if reader.autotune is not None and cache.l1_enabled:
             # the memory-vs-worker-count trade becomes a live knob: the L1
             # residency cap (MB) rides the same starved/blocked signals as
@@ -1133,6 +1223,11 @@ class Reader:
         #: this registry as the cache.* series on the consume path
         self.warm_cache = None
         self._cache_publish_at = 0.0
+        #: static planner verdict (petastorm_tpu.planner.PlanVerdict), set
+        #: by make_reader when the planner ran; stop() persists this run's
+        #: converged knobs as the dataset's flight profile
+        self.planner = None
+        self._profile_written = False
 
         self._start_item = start_item
         self._consumed_items = 0
@@ -1855,6 +1950,18 @@ class Reader:
         its counters just because nobody held the ``Telemetry`` object.
         """
         self._stopped = True
+        if self.planner is not None and not self._profile_written:
+            # persist the flight profile BEFORE observability teardown: the
+            # payload reads the sampler's trailing points + the autotune
+            # controller's converged knobs (petastorm_tpu.planner).  Once
+            # per reader, best-effort - teardown must never fail on it.
+            self._profile_written = True
+            try:
+                from petastorm_tpu import planner as _planner
+
+                _planner.write_profile(self)
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.debug("flight-profile write failed", exc_info=True)
         if self.warm_cache is not None:
             # final fold BEFORE the observability close latches the final
             # telemetry snapshot: a short run's cache.* activity must not
@@ -2020,6 +2127,15 @@ class Reader:
         if self.autotune is not None:
             # knob values + bounded decision log (what the tuner did and why)
             diag["autotune"] = self.autotune.diagnostics
+        if self.planner is not None:
+            # the static planner's verdict: planned knob values with per-knob
+            # provenance (profile / metadata / default / pinned) plus the
+            # footer summary and profile path it planned from
+            try:
+                diag["planner"] = self.planner.to_dict()
+            except Exception:  # noqa: BLE001 - diagnostics must not raise
+                logger.debug("planner verdict serialization failed",
+                             exc_info=True)
         if self._flight_record is not None:
             # the sampled series + trace tail leading into a terminal failure
             diag["flight_recorder"] = self._flight_record

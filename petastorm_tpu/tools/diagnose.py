@@ -272,6 +272,9 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
             "liveness": liveness,
             # knob values + decision log when --autotune tuned the run
             "autotune": final_diag.get("autotune"),
+            # the static planner's seed verdict (per-knob provenance:
+            # profile / metadata / default / pinned) when it ran
+            "planner": final_diag.get("planner"),
             # the run's stream certificate (docs/operations.md
             # "Reproducibility"); operators and the CI determinism smoke
             # share this one code path via --stream-digest
@@ -364,7 +367,10 @@ def render_watch_frame(point: Dict, diagnostics: Optional[Dict] = None,
             f"  {rates.get('cache.l2_hits', 0.0):5.1f} l2hit/s"
             f"  hit-rate {100.0 * hit_rate:5.1f}%"
             f"  L1 {gauges.get('cache.bytes', 0.0) / 2 ** 20:.0f}MB"
-            f"  evictions {counters.get('cache.evictions', 0):g}")
+            f"  evictions {counters.get('cache.evictions', 0):g}"
+            # post-transform entries (decode AND transform skipped on a hit)
+            f"  xform {counters.get('cache.transform_hits', 0):g}h"
+            f"/{counters.get('cache.transform_stores', 0):g}s")
     if any(n.startswith("service.") for n in counters) \
             or any(n.startswith("service.") for n in gauges):
         # the disaggregated ingest plane's pulse (client-side series): a
@@ -392,6 +398,11 @@ def render_watch_frame(point: Dict, diagnostics: Optional[Dict] = None,
         lines.append("faults/liveness (totals): " + "  ".join(
             f"{n}={v:g}" for n, v in sorted(faults.items())))
     if diagnostics:
+        if diagnostics.get("planner"):
+            # where this run's starting knobs came from (one compact line;
+            # the full provenance rides the post-run report)
+            lines.append(render_planner_verdict(diagnostics["planner"],
+                                                compact=True))
         busy = diagnostics.get("workers_busy", [])
         if busy:
             oldest = max(age for _i, _o, age in busy)
@@ -512,9 +523,39 @@ def _watch(args, url: str, chaos) -> int:
               f" read {result['rows']} rows")
         print(result["report"])
         print(render_liveness_verdict(result["liveness"]))
+        if result.get("planner"):
+            print(render_planner_verdict(result["planner"]))
         if result.get("autotune"):
             print(render_autotune_verdict(result["autotune"]))
     return 0
+
+
+def render_planner_verdict(planner: dict, compact: bool = False) -> str:
+    """The static planner's seed verdict as text: every planned knob with
+    its provenance, plus (non-compact) the flight profile it planned from.
+    ``compact=True`` is the one-line ``--watch`` form."""
+    knobs = planner.get("knobs", {})
+    parts = [f"{name}={knob['value']}({knob['source']})"
+             for name, knob in sorted(knobs.items())]
+    line = "planner: " + ("  ".join(parts) if parts else "(no knobs planned)")
+    if compact:
+        return line
+    lines = [line]
+    for name, knob in sorted(knobs.items()):
+        lines.append(f"  {name}={knob['value']} [{knob['source']}]"
+                     f" {knob.get('why', '')}")
+    profile = planner.get("profile")
+    if profile:
+        observed = profile.get("observed_rows_per_sec")
+        lines.append(
+            f"  flight profile: {planner.get('profile_path')}"
+            + (f" (observed {observed:.0f} rows/s)"
+               if isinstance(observed, (int, float)) else ""))
+    else:
+        lines.append(
+            "  no flight profile yet (written at reader stop; next cold"
+            f" start seeds from {planner.get('profile_path')})")
+    return "\n".join(lines)
 
 
 def render_autotune_verdict(autotune: dict) -> str:
@@ -640,6 +681,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   result["quarantined_rowgroups"],
                               "liveness": result["liveness"],
                               "autotune": result["autotune"],
+                              "planner": result["planner"],
                               "stream_digest": result["stream_digest"],
                               "deterministic": result["deterministic"],
                               "snapshot": result["snapshot"]}))
@@ -651,6 +693,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   + f" from {what}")
             print(result["report"])
             print(render_liveness_verdict(result["liveness"]))
+            if result.get("planner"):
+                print(render_planner_verdict(result["planner"]))
             if args.stream_digest:
                 print(render_stream_digest(result["stream_digest"],
                                            result["deterministic"]))

@@ -38,6 +38,11 @@ logger = logging.getLogger(__name__)
 
 _MAX_OPEN_FILES = 8
 
+#: cache-key stage tag for post-transform entries.  Versioned like the
+#: rawcoef tags: bump it if the cached post-transform form ever changes, so
+#: a warm persistent tier from an older build can never poison the pipeline.
+_TRANSFORM_STAGE = "xform1"
+
 
 class RowGroupDecoderWorker:
     """Picklable worker factory (pool.WorkerFactory protocol).
@@ -65,7 +70,8 @@ class RowGroupDecoderWorker:
                  decode_threads: int = 1,
                  decode_roi: Optional[Dict[str, tuple]] = None,
                  split_fields: Sequence[str] = (),
-                 decode_split=None):
+                 decode_split=None,
+                 transform_cache_info=None):
         self._fs_factory = fs_factory
         self._schema = schema
         self._read_fields = list(read_fields)
@@ -124,6 +130,38 @@ class RowGroupDecoderWorker:
         self._allow_batch_slots = not getattr(
             self._cache, "retains_value_references", True)
         self._cache_is_null = isinstance(self._cache, NullCache)
+        from petastorm_tpu import transform as _transform_mod
+        from petastorm_tpu.transform import log_output_cache_disabled
+
+        # ONE analysis walk yields both halves (it md5s bytecode + any
+        # captured arrays - too heavy to repeat, and _cache_key is on the
+        # per-item hot path so the signature is memoized here); make_reader
+        # precomputes the triple (the planner's schema hash shares it) and
+        # passes it in, direct constructions compute their own:
+        #: content signature (closure cells + read globals folded)
+        #: post-transform output caching (MinatoLoader-style, docs/
+        #: operations.md "Transform caching & the pipeline planner"): when
+        #: the transform is provably deterministic the cache stores its
+        #: OUTPUT under the decode key + a stage tag, so warm epochs skip
+        #: decode AND transform.  Ngram readers are excluded (windows form
+        #: after the transform with slice-dependent anchors - small win,
+        #: wide blast radius), as is anything uncertain about determinism.
+        if transform_cache_info is None:
+            transform_cache_info = _transform_mod.transform_cache_info(
+                self._transform)
+        self._transform_signature, cacheable, reason = transform_cache_info
+        self._transform_output_cached = False
+        if (self._transform is not None and not self._cache_is_null
+                and ngram is None):
+            if cacheable:
+                self._transform_output_cached = True
+                logger.info(
+                    "post-transform output caching armed (%s; signature %s,"
+                    " stage tag %r)", reason, self._transform_signature,
+                    _TRANSFORM_STAGE)
+            else:
+                log_output_cache_disabled(self._transform, reason,
+                                          self._transform_signature)
         #: per-file (size, mtime) fingerprints for cache keys - a dataset
         #: rewritten in place must never serve stale warm-tier entries.
         #: Plain dict: GIL-atomic set; a racing duplicate stat is benign.
@@ -272,6 +310,35 @@ class RowGroupDecoderWorker:
             # key covers the rows ACTUALLY loaded (incl. ngram lookahead), so
             # readers with different ngram lengths never share an entry
             span = row_range if row_range is not None else load_item.row_slice()
+            if self._transform_output_cached:
+                # the cached value is the TRANSFORM's output, keyed by the
+                # decode key + a stage tag: decode-only entries (other jobs,
+                # or this transform with caching off) live under the
+                # untagged key and never cross-serve.  Stage spans live
+                # INSIDE the fill, so a warm hit records zero decode/
+                # transform samples - the observable proof both ran nowhere.
+                key = self._cache_key(load_item, span, fs,
+                                      stage=_TRANSFORM_STAGE)
+                filled: list = []
+
+                def _decode_and_transform() -> ColumnBatch:
+                    filled.append(True)
+                    with (tele.stage("decode", path=item.row_group.path,
+                                     rowgroup=item.row_group.row_group)
+                          if traced else _NULL_CONTEXT):
+                        fresh = self._load(parquet_file, load_item,
+                                           self._read_fields,
+                                           row_range=row_range)
+                    if fresh.num_rows == 0:
+                        # transforms must not see 0-row columns (same
+                        # contract as the uncached path below)
+                        return fresh
+                    with tele.stage("transform") if traced else _NULL_CONTEXT:
+                        return self._apply_transform(fresh)
+
+                batch = self._cache.get(key, _decode_and_transform)
+                self._note_transform_cache(hit=not filled)
+                return batch
             key = self._cache_key(load_item, span, fs)
             with decode_stage:
                 batch = self._cache.get(key, lambda: self._load(
@@ -314,10 +381,9 @@ class RowGroupDecoderWorker:
             self._file_fps[path] = fp
         return fp
 
-    def _cache_key(self, item: WorkItem, span: tuple, fs=None) -> str:
+    def _cache_key(self, item: WorkItem, span: tuple, fs=None,
+                   stage: str = "decode") -> str:
         start, stop = span
-        from petastorm_tpu.transform import transform_signature
-
         # 'rawcoef1' versions the stored form of raw/device fields (coefficient
         # plane columns); bump it whenever that format changes, or a warm
         # persistent cache from an older version poisons the pipeline
@@ -329,16 +395,38 @@ class RowGroupDecoderWorker:
                + "|split:" + ("-" if self._decode_split is None
                               else str(int(self._decode_split.value)))
                + "|roi:" + repr(sorted(self._decode_roi.items()))
-               # the cached value is the PRE-transform decode, but the key
-               # carries the transform signature anyway: the warm tier is
-               # shared across jobs, and cross-transform sharing is not worth
-               # the blast radius of a signature collision serving job B a
-               # batch decoded under job A's settings (ISSUE 7 satellite)
-               + "|tf:" + transform_signature(self._transform))
+               # under stage='decode' the cached value is the PRE-transform
+               # decode, but the key carries the transform signature anyway:
+               # the warm tier is shared across jobs, and cross-transform
+               # sharing is not worth the blast radius of a signature
+               # collision serving job B a batch decoded under job A's
+               # settings (ISSUE 7 satellite)
+               + "|tf:" + self._transform_signature)
+        if stage != "decode":
+            # post-transform entries: a distinct stage tag keeps decode-only
+            # and decode+transform values apart in ONE shared tier - editing
+            # the transform bytecode or flipping `deterministic` mid-job
+            # misses cleanly instead of cross-serving (ISSUE 15 satellite)
+            tag += f"|stage:{stage}"
         fields_tag = hashlib.md5(tag.encode()).hexdigest()[:8]
         fp = self._file_fingerprint(item.row_group.path, fs)
         return (f"{self._cache_prefix}:{item.row_group.path}:{item.row_group.row_group}"
                 f":{start}:{stop}:{fields_tag}:{fp}")
+
+    def _note_transform_cache(self, hit: bool) -> None:
+        """Count one post-transform cache event.  The shared tier keeps the
+        counters in its cross-process header (visible to every job, published
+        by the owning reader as ``cache.transform_*``); per-process caches
+        bump this worker's telemetry directly - one path per cache flavor,
+        so nothing double-counts."""
+        note = getattr(self._cache, "note_transform_event", None)
+        if note is not None:
+            note(hit)
+            return
+        tele = self._telemetry
+        if tele is not None and tele.enabled:
+            tele.counter("cache.transform_hits" if hit
+                         else "cache.transform_stores").add(1)
 
     def _apply_transform(self, batch: ColumnBatch) -> ColumnBatch:
         if self._transform is None:

@@ -464,8 +464,10 @@ def test_batch_reader_over_multiple_urls(tmp_path):
 
 
 def test_workers_count_auto(tmp_path):
-    """'auto' sizes the pool to usable cores (affinity-aware), capped at the
-    reference's default of 10, leaving one core for the consumer."""
+    """'auto' with the default autotune arming seeds the pool from the
+    static PLANNER's verdict (petastorm_tpu.planner - parquet metadata or a
+    recorded flight profile); ``autotune=False`` restores the old static
+    core heuristic (usable cores - 1, capped at 10)."""
     import os
 
     from petastorm_tpu.etl.writer import write_dataset
@@ -478,9 +480,19 @@ def test_workers_count_auto(tmp_path):
     with make_batch_reader(url, workers_count="auto", num_epochs=1) as r:
         got = sorted(int(v) for b in r.iter_batches() for v in b.columns["id"])
         workers = r.diagnostics["workers_count"]
+        verdict = r.planner
     assert got == list(range(16))
+    assert verdict is not None
+    assert workers == verdict.knobs["workers"].value
+    assert verdict.knobs["workers"].source in ("metadata", "default",
+                                               "profile")
+    with make_batch_reader(url, workers_count="auto", num_epochs=1,
+                           autotune=False) as r:
+        list(r.iter_batches())
+        static_workers = r.diagnostics["workers_count"]
+        assert r.planner is None
     try:
         cores = len(os.sched_getaffinity(0))
     except AttributeError:
         cores = os.cpu_count() or 1
-    assert workers == max(1, min(10, cores - 1))
+    assert static_workers == max(1, min(10, cores - 1))

@@ -1,0 +1,558 @@
+"""Post-transform warm caching tests (ISSUE 15 tentpole a + satellites):
+the closure-folded transform signature (stable across PYTHONHASHSEEDs,
+changed by editing a wrapped function's body), the conservative
+determinism gate (a non-deterministic / closure-opaque transform provably
+never serves a cached output), transform-stage cache-key isolation in one
+shared tier (editing bytecode or flipping ``deterministic`` misses
+cleanly), the ``cache.transform_hits``/``cache.transform_stores``
+telemetry, slot composition of a warm transform hit, and seed-stable
+delivery staying bit-identical with transform caching armed."""
+
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.batch import ColumnBatch
+from petastorm_tpu.cache_shared import SharedWarmCache
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.transform import (TransformSpec, row_transform,
+                                     transform_output_cacheable,
+                                     transform_signature)
+
+
+def _arena_ok() -> bool:
+    from petastorm_tpu.native import allocator_available
+
+    return allocator_available()
+
+
+needs_arena = pytest.mark.skipif(
+    not _arena_ok() and not os.environ.get("PETASTORM_TPU_REQUIRE_ARENA"),
+    reason="native shm_arena library unavailable")
+
+
+def _write_ds(path, rows=64, rg=8):
+    schema = Schema("T", [Field("x", np.int64, (), ScalarCodec())])
+    write_dataset(str(path), schema, [{"x": i} for i in range(rows)],
+                  row_group_size_rows=rg)
+    return str(path)
+
+
+def _scaled(k):
+    def scale(cols):
+        return {"x": cols["x"] * k}
+    return scale
+
+
+# -- closure folding (satellite 1) --------------------------------------------
+
+def test_wrapped_function_body_changes_signature():
+    """row_transform(f1) vs row_transform(f2) share the wrapper's bytecode;
+    the signature must fold the CAPTURED function's code (the PR 7 closure
+    caveat this PR closes)."""
+    def f1(row):
+        return {"x": row["x"] + 1}
+
+    def f2(row):
+        return {"x": row["x"] + 2}
+
+    s1 = transform_signature(TransformSpec(row_transform(f1)))
+    s2 = transform_signature(TransformSpec(row_transform(f2)))
+    assert s1 != s2
+    assert s1 == transform_signature(TransformSpec(row_transform(f1)))
+
+
+def test_closure_constants_fold_into_signature():
+    assert (transform_signature(TransformSpec(_scaled(2)))
+            != transform_signature(TransformSpec(_scaled(3))))
+    assert (transform_signature(TransformSpec(_scaled(2)))
+            == transform_signature(TransformSpec(_scaled(2))))
+
+    def norm(mean):
+        def t(cols):
+            return {"x": cols["x"] - mean}
+        return TransformSpec(t)
+
+    # captured ndarrays fold by VALUE: different normalization constants
+    # key different cache entries
+    assert (transform_signature(norm(np.ones(3)))
+            != transform_signature(norm(np.zeros(3))))
+    assert (transform_signature(norm(np.ones(3)))
+            == transform_signature(norm(np.ones(3))))
+
+
+def test_signature_stable_across_hashseeds():
+    """Closure folding must not reintroduce hash-randomization sensitivity:
+    two subprocesses under different PYTHONHASHSEEDs (and a third repeating
+    the first) must compute the SAME signature for a transform capturing a
+    frozenset + str + wrapped function."""
+    code = (
+        "from petastorm_tpu.transform import TransformSpec,"
+        " transform_signature\n"
+        "def inner(row):\n"
+        "    return {'x': row['x']}\n"
+        "def make():\n"
+        "    keep = frozenset({'a', 'b', 'zz'})\n"
+        "    tag = 'v1'\n"
+        "    def t(cols):\n"
+        "        assert tag and keep\n"
+        "        return inner(cols)\n"
+        "    return TransformSpec(t)\n"
+        "print(transform_signature(make()))\n")
+    sigs = []
+    for seed in ("0", "1", "0"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, check=True)
+        sigs.append(out.stdout.strip())
+    assert sigs[0] == sigs[1] == sigs[2], sigs
+
+
+#: module-level state for the GLOBAL-analog guard tests (a transform
+#: reading/writing these is stateful without closing over anything)
+_GLOBAL_STATE: list = []
+_GLOBAL_FACTOR = 3
+
+
+def _global_stateful(cols):
+    _GLOBAL_STATE.append(1)
+    return dict(cols)
+
+
+def _global_scaled(cols):
+    return {"x": cols["x"] * _GLOBAL_FACTOR}
+
+
+def _global_writer(cols):
+    global _GLOBAL_FACTOR
+    _GLOBAL_FACTOR = 4
+    return dict(cols)
+
+
+def test_mutable_global_state_disables_caching():
+    """The global analog of the closure guard (found by a live drive): a
+    transform touching a module-level mutable object must never have its
+    output cached, even declared deterministic=True."""
+    ok, why = transform_output_cacheable(
+        TransformSpec(_global_stateful, deterministic=True))
+    assert not ok and "_GLOBAL_STATE" in why
+
+    ok, why = transform_output_cacheable(
+        TransformSpec(_global_writer, deterministic=True))
+    assert not ok and "writes global" in why
+
+
+def test_global_constants_fold_by_value(monkeypatch):
+    """A module-level scalar a transform reads keys the cache by VALUE:
+    changing it changes the signature (so a stale entry cannot serve), and
+    the spec stays cacheable."""
+    assert transform_output_cacheable(TransformSpec(_global_scaled))[0]
+    s3 = transform_signature(TransformSpec(_global_scaled))
+    # patch the dict the function actually reads from (its __globals__):
+    # the test module can be imported under two names, so attribute
+    # patching one instance would miss the other
+    monkeypatch.setitem(_global_scaled.__globals__, "_GLOBAL_FACTOR", 5)
+    s5 = transform_signature(TransformSpec(_global_scaled))
+    assert s3 != s5
+
+
+def _stochastic_helper(x):
+    import random
+
+    return x + random.random()
+
+
+def _delegating_transform(cols):
+    return {k: _stochastic_helper(v) for k, v in cols.items()}
+
+
+class _SlottedScale:
+    __slots__ = ("factor",)
+
+    def __init__(self, factor):
+        self.factor = factor
+
+    def __call__(self, cols):
+        return {k: v * self.factor for k, v in cols.items()}
+
+
+class _SlottedStateful:
+    __slots__ = ("seen",)
+
+    def __init__(self):
+        self.seen = []
+
+    def __call__(self, cols):
+        self.seen.append(1)
+        return dict(cols)
+
+
+def test_stochastic_helper_functions_refuse_caching():
+    """The 'auto' name scan must cover REFERENCED and CAPTURED helper
+    functions, not just the top-level body (review finding: a transform
+    delegating its RNG call to a module-level helper was wrongly concluded
+    cacheable)."""
+    ok, why = transform_output_cacheable(TransformSpec(_delegating_transform))
+    assert not ok and "random" in why
+
+    def make():
+        def jitter(x):
+            return x + np.random.rand()
+
+        def t(cols):
+            return {k: jitter(v) for k, v in cols.items()}
+        return TransformSpec(t)
+
+    assert not transform_output_cacheable(make())[0]
+
+
+class _ClassRoutedJitter:
+    def apply(self, cols):
+        return {k: v + np.random.normal() for k, v in cols.items()}
+
+
+def _class_routed_transform(cols):
+    return _ClassRoutedJitter().apply(cols)
+
+
+def test_stochastic_class_method_refuses_caching():
+    """The name scan must reach a referenced class's METHOD bodies: a
+    transform routing its RNG call through Jitter().apply() refuses like
+    an inline np.random call would (review finding)."""
+    ok, why = transform_output_cacheable(
+        TransformSpec(_class_routed_transform))
+    assert not ok and ("normal" in why or "random" in why), (ok, why)
+    # and editing a method changes the signature (the class's code folds)
+    s1 = transform_signature(TransformSpec(_class_routed_transform))
+    original = _ClassRoutedJitter.apply
+    try:
+        _ClassRoutedJitter.apply = lambda self, cols: dict(cols)
+        s2 = transform_signature(TransformSpec(_class_routed_transform))
+    finally:
+        _ClassRoutedJitter.apply = original
+    assert s1 != s2
+
+
+def test_slotted_and_class_attr_callable_state_folds():
+    """Callable-object state must fold (or refuse) regardless of where it
+    lives: __slots__, instance __dict__, or class-level data attributes
+    (review finding: slotted instances with different config shared one
+    signature)."""
+    s2 = transform_signature(TransformSpec(_SlottedScale(2),
+                                           deterministic=True))
+    s3 = transform_signature(TransformSpec(_SlottedScale(3),
+                                           deterministic=True))
+    assert s2 != s3
+    assert transform_output_cacheable(
+        TransformSpec(_SlottedScale(2), deterministic=True))[0]
+    # mutable slotted state -> opaque, even declared deterministic
+    ok, why = transform_output_cacheable(
+        TransformSpec(_SlottedStateful(), deterministic=True))
+    assert not ok and "seen" in why
+
+
+# -- the determinism gate ------------------------------------------------------
+
+def test_output_cacheable_matrix():
+    def pure(cols):
+        return dict(cols)
+
+    assert transform_output_cacheable(TransformSpec(pure))[0]
+    assert not transform_output_cacheable(
+        TransformSpec(pure, deterministic=False))[0]
+    assert transform_output_cacheable(
+        TransformSpec(pure, deterministic=True))[0]
+    assert not transform_output_cacheable(None)[0]
+    # no func = pure field selection
+    assert transform_output_cacheable(
+        TransformSpec(removed_fields=["x"]))[0]
+
+    def noisy(cols):
+        return {k: v + np.random.rand() for k, v in cols.items()}
+
+    ok, why = transform_output_cacheable(TransformSpec(noisy))
+    assert not ok and "stochastic" in why
+    # an explicit declaration overrides the name heuristic (the user owns
+    # the assertion), but never the opaque-closure refusal below
+    assert transform_output_cacheable(
+        TransformSpec(noisy, deterministic=True))[0]
+
+
+def test_opaque_closure_disables_caching_with_one_warning(caplog):
+    def make():
+        state = []
+
+        def t(cols):
+            state.append(1)
+            return dict(cols)
+        return TransformSpec(t, deterministic=True)
+
+    ok, why = transform_output_cacheable(make())
+    assert not ok and "not foldable" in why and "state" in why
+
+    from petastorm_tpu.transform import log_output_cache_disabled
+
+    spec = make()
+    sig = transform_signature(spec)
+    with caplog.at_level(logging.WARNING, logger="petastorm_tpu.transform"):
+        log_output_cache_disabled(spec, why, sig)
+        log_output_cache_disabled(spec, why, sig)
+    warnings = [r for r in caplog.records
+                if "output caching DISABLED" in r.getMessage()]
+    assert len(warnings) == 1
+
+
+def test_invalid_deterministic_value_refused():
+    from petastorm_tpu.errors import PetastormTpuError
+
+    with pytest.raises(PetastormTpuError, match="deterministic"):
+        TransformSpec(lambda c: c, deterministic="yes")
+
+
+# -- e2e: warm epochs skip decode AND transform --------------------------------
+
+@needs_arena
+def test_warm_epoch_skips_decode_and_transform(tmp_path):
+    url = _write_ds(tmp_path / "ds")
+    tier = str(tmp_path / "tier")
+    tele = Telemetry()
+    # one worker: epoch boundaries stay strict, so the counter assertions
+    # are exact (with N workers an epoch-2 item can legitimately start
+    # before epoch-1's identical item finished storing)
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=1,
+                           shuffle_row_groups=False, num_epochs=2,
+                           cache_type="shared", cache_location=tier,
+                           transform_spec=TransformSpec(_scaled(3)),
+                           telemetry=tele) as r:
+        rows = sorted(int(v) for b in r.iter_batches()
+                      for v in b.columns["x"])
+        stats = r.warm_cache.stats()
+    try:
+        assert rows == sorted([i * 3 for i in range(64)] * 2)
+        # 8 rowgroups: cold epoch stores 8 post-transform entries, warm
+        # epoch serves all 8 - skipping decode AND transform
+        assert stats["transform_stores"] == 8, stats
+        assert stats["transform_hits"] == 8, stats
+        c = tele.snapshot()["counters"]
+        assert c["cache.transform_hits"] == 8
+        assert c["cache.transform_stores"] == 8
+        # the stage proof: decode and transform each ran exactly once per
+        # rowgroup over TWO epochs (the warm epoch recorded zero samples)
+        assert c["stage.transform.count"] == 8, c["stage.transform.count"]
+        assert c["stage.decode.count"] == 8, c["stage.decode.count"]
+    finally:
+        SharedWarmCache(location=tier).cleanup()
+
+
+def test_memory_cache_transform_counters(tmp_path):
+    """Per-process caches count transform events through worker telemetry
+    (no shared header to ride)."""
+    url = _write_ds(tmp_path / "ds")
+    tele = Telemetry()
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=1,
+                           shuffle_row_groups=False, num_epochs=2,
+                           cache_type="memory",
+                           transform_spec=TransformSpec(_scaled(2)),
+                           telemetry=tele) as r:
+        rows = sorted(int(v) for b in r.iter_batches()
+                      for v in b.columns["x"])
+    assert rows == sorted([i * 2 for i in range(64)] * 2)
+    c = tele.snapshot()["counters"]
+    assert c["cache.transform_stores"] == 8
+    assert c["cache.transform_hits"] == 8
+
+
+# -- the acceptance guarantee: non-deterministic never served from cache ------
+
+def test_undeclared_stateful_transform_reruns_every_epoch(tmp_path):
+    """A transform over opaque closure state (undeclared, 'auto') must
+    re-run for every rowgroup of every epoch - the cache may hold decode
+    output, never this transform's."""
+    url = _write_ds(tmp_path / "ds")
+    calls = []
+
+    def counting(cols):
+        calls.append(1)
+        return {"x": cols["x"] + 1}
+    # `calls` is a list -> opaque closure state -> output caching disabled
+
+    tele = Telemetry()
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=1,
+                           shuffle_row_groups=False, num_epochs=2,
+                           cache_type="memory",
+                           transform_spec=TransformSpec(counting),
+                           telemetry=tele) as r:
+        rows = sorted(int(v) for b in r.iter_batches()
+                      for v in b.columns["x"])
+    assert rows == sorted([i + 1 for i in range(64)] * 2)
+    assert len(calls) == 16  # 8 rowgroups x 2 epochs: transform never cached
+    c = tele.snapshot()["counters"]
+    assert c.get("cache.transform_stores", 0) == 0
+    assert c.get("cache.transform_hits", 0) == 0
+    # the decode tier still warms (epoch 2 decode served from cache)
+    assert c.get("cache.hits", 0) == 8
+
+
+def test_stochastic_transform_outputs_differ_across_epochs(tmp_path):
+    """The end-to-end proof for the acceptance bullet: an RNG-sampling
+    transform left on deterministic='auto' delivers DIFFERENT values each
+    epoch even with a cache armed - a cached output would repeat epoch 1."""
+    url = _write_ds(tmp_path / "ds", rows=16, rg=16)
+
+    def jitter(cols):
+        return {"x": cols["x"] * 1000 + np.random.randint(0, 1000)}
+
+    with make_batch_reader(url, reader_pool_type="thread", workers_count=1,
+                           shuffle_row_groups=False, num_epochs=2,
+                           cache_type="memory",
+                           transform_spec=TransformSpec(jitter)) as r:
+        batches = [list(b.columns["x"]) for b in r.iter_batches()]
+    assert len(batches) == 2
+    assert batches[0] != batches[1]
+
+
+# -- cache-key invalidation (satellite 3) -------------------------------------
+
+@needs_arena
+def test_decode_and_transform_entries_never_cross_serve(tmp_path):
+    """One shared tier, three readers: transform-cached, plain (no
+    transform), and the same transform declared non-deterministic.  Each
+    must see its own values - no entry crosses stages or declarations."""
+    url = _write_ds(tmp_path / "ds")
+    tier = str(tmp_path / "tier")
+    try:
+        def read(spec):
+            with make_batch_reader(url, reader_pool_type="thread",
+                                   workers_count=2, shuffle_row_groups=False,
+                                   cache_type="shared", cache_location=tier,
+                                   transform_spec=spec) as r:
+                return sorted(int(v) for b in r.iter_batches()
+                              for v in b.columns["x"])
+
+        plus = TransformSpec(_scaled(10), deterministic=True)
+        assert read(plus) == [i * 10 for i in range(64)]
+        # a plain reader over the SAME tier must never receive the cached
+        # post-transform batches
+        assert read(None) == list(range(64))
+        # flipping deterministic False must recompute, not serve the entry
+        # stored under deterministic=True
+        calls = []
+
+        def observed(cols):
+            calls.append(1)
+            return {"x": cols["x"] * 10}
+
+        spec_off = TransformSpec(observed, deterministic=False)
+        assert read(spec_off) == [i * 10 for i in range(64)]
+        assert len(calls) == 8  # ran for every rowgroup despite the tier
+    finally:
+        SharedWarmCache(location=tier).cleanup()
+
+
+@needs_arena
+def test_edited_transform_bytecode_misses_cleanly(tmp_path):
+    url = _write_ds(tmp_path / "ds")
+    tier = str(tmp_path / "tier")
+    try:
+        def read(k):
+            with make_batch_reader(url, reader_pool_type="thread",
+                                   workers_count=2, shuffle_row_groups=False,
+                                   cache_type="shared", cache_location=tier,
+                                   transform_spec=TransformSpec(
+                                       _scaled(k), deterministic=True)) as r:
+                rows = sorted(int(v) for b in r.iter_batches()
+                              for v in b.columns["x"])
+                return rows, r.warm_cache.stats()
+
+        rows1, _ = read(2)
+        assert rows1 == [i * 2 for i in range(64)]
+        # "edited" transform (different captured constant = different code
+        # identity): must miss and deliver ITS values, never k=2's entries
+        rows2, stats = read(3)
+        assert rows2 == [i * 3 for i in range(64)]
+        assert stats["transform_stores"] == 16  # 8 entries per variant
+    finally:
+        SharedWarmCache(location=tier).cleanup()
+
+
+# -- slot composition ---------------------------------------------------------
+
+@needs_arena
+def test_transform_hit_materializes_into_armed_slot(tmp_path):
+    """A warm POST-TRANSFORM hit still composes with the process-pool
+    transport: fixed-shape columns copy straight into an armed arena batch
+    slot, exactly like decode-stage hits."""
+    from petastorm_tpu.native import SharedArena
+    from petastorm_tpu.native.transport import SlotAllocator, _slot_scope
+
+    tier = SharedWarmCache(location=str(tmp_path / "tier"),
+                           l1_bytes=16 * 2 ** 20)
+    got = None
+    arena = None
+    try:
+        transformed = ColumnBatch(
+            {"x": np.arange(32, dtype=np.float32) * 2.0}, 32)
+        tier.get("rg0|stage:xform1", lambda: transformed)
+        tier.note_transform_event(hit=False)
+        arena = SharedArena.create(8 * 2 ** 20)
+        allocator = SlotAllocator(arena)
+        with _slot_scope(allocator):
+            got = tier.get("rg0|stage:xform1",
+                           lambda: pytest.fail("should hit"))
+        tier.note_transform_event(hit=True)
+        assert allocator.claim(got.columns["x"]) is not None
+        allocator.rollback_claims()
+        allocator.finalize(None)
+        stats = tier.stats()
+        assert stats["transform_hits"] == 1
+        assert stats["transform_stores"] == 1
+    finally:
+        if got is not None:
+            del got
+        if arena is not None:
+            arena.close()
+        tier.cleanup()
+
+
+# -- determinism stays bit-identical with transform caching armed -------------
+
+@needs_arena
+def test_seed_stable_delivery_with_transform_cache(tmp_path):
+    """deterministic='seed' + a warm transform tier: a cold 2-worker run
+    and a warm 4-worker run over the same tier must produce IDENTICAL
+    stream digests and delivered bytes, with the warm run provably served
+    from the transform tier."""
+    url = _write_ds(tmp_path / "ds")
+    tier = str(tmp_path / "tier")
+
+    def run(workers):
+        tele = Telemetry()
+        with make_batch_reader(url, reader_pool_type="thread",
+                               workers_count=workers, shuffle_seed=7,
+                               deterministic="seed", num_epochs=2,
+                               cache_type="shared", cache_location=tier,
+                               transform_spec=TransformSpec(
+                                   _scaled(5), deterministic=True),
+                               telemetry=tele) as r:
+            payload = [bytes(np.ascontiguousarray(b.columns["x"]))
+                       for b in r.iter_batches()]
+            digest = r.diagnostics["stream_digest"]["combined"]
+        return payload, digest, tele.snapshot()["counters"]
+
+    try:
+        cold_payload, cold_digest, _cold = run(2)
+        warm_payload, warm_digest, warm = run(4)
+        assert cold_digest == warm_digest
+        assert cold_payload == warm_payload
+        assert warm.get("cache.transform_hits", 0) >= 8, warm
+    finally:
+        SharedWarmCache(location=tier).cleanup()

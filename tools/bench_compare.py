@@ -66,6 +66,20 @@ floor from the ISSUE 11 acceptance bar; the two absolute-rate members
 (``sequence_packed_tokens_per_sec`` / ``..._padded_anchor_...``) drift
 with the host like any rate.
 
+Transform-cache / planner metrics (BENCH_r13+, docs/operations.md
+"Transform caching & the pipeline planner"): ``transform_warm_vs_cold_ratio``
+prices a warm epoch of a transform-DOMINATED pipeline with post-transform
+output caching armed against its own cold epoch (same session, fresh tier
+per round - drift-immune); its ``vs_baseline`` compares against the 13.5x
+decode-only warm ratio of BENCH_r07, and the 3.0 absolute floor catches
+output caching silently disarming.  ``transform_warm_vs_decode_only_warm_
+ratio`` (floor 1.2) isolates what caching the transform's OUTPUT adds over
+caching only the decode.  ``planner_cold_start_ratio`` (floor 1.2) is
+explore-from-bad-knobs time-to-90%-of-steady over flight-profile-seeded
+time-to-90% - the planner's cold-start win; ``planner_time_to_90pct_seconds``
+is the seeded arm's absolute t90 (lower is better via the ``time_to``
+marker).
+
 Autoscale metrics (BENCH_r12+, docs/operations.md "Fleet autoscaling &
 QoS"): ``autoscale_vs_static_ratio`` prices the closed loop - an
 undersized 1-worker fleet plus a live ``AutoscaleSupervisor`` over a
@@ -84,9 +98,10 @@ import sys
 from typing import Dict, List, Optional
 
 #: substrings marking a metric where SMALLER is better (idle/stall
-#: percentages, latency ratios); everything else is treated as a rate
+#: percentages, latency ratios, time-to-threshold seconds); everything else
+#: is treated as a rate
 LOWER_IS_BETTER_MARKERS = ("idle_pct", "stall_pct", "latency",
-                           "latent_vs_local")
+                           "latent_vs_local", "time_to")
 
 #: metric -> minimum acceptable value: an armed gate fails a candidate
 #: BELOW the floor regardless of the baseline (absolute acceptance bars,
@@ -108,6 +123,17 @@ ABSOLUTE_FLOORS = {
     # within 0.8x of a statically right-sized fleet on the same read -
     # the closed loop's detect->spawn->register latency is what's priced
     "autoscale_vs_static_ratio": 0.8,
+    # ISSUE 15: a transform-dominated warm epoch with post-transform caching
+    # must run >= 3x its cold epoch (the headline target is beating the
+    # decode-only 13.5x - gated via vs_baseline in the note - but the
+    # absolute floor catches output caching silently disarming), and output
+    # caching must beat decode-only caching on the same warm epoch by 1.2x
+    "transform_warm_vs_cold_ratio": 3.0,
+    "transform_warm_vs_decode_only_warm_ratio": 1.2,
+    # ISSUE 15: a flight-profile-seeded cold start must reach 90% of
+    # steady-state delivery at least 1.2x sooner than the runtime loop
+    # climbing from bad static knobs
+    "planner_cold_start_ratio": 1.2,
 }
 
 
