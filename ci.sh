@@ -656,6 +656,147 @@ PY
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 200 python "$KILL_SMOKE"
 rm -f "$KILL_SMOKE"
 
+echo "== failover smoke (SIGKILL the primary mid-epoch, hot standby promotes, both clients exact) =="
+# the ISSUE 17 hot-standby HA contract, end to end with REAL subprocesses:
+# a journaled CLI primary feeds a CLI standby over journal_sync; two
+# rejoin-armed workers and two trainer clients dial the failover address
+# list.  With BOTH clients holding in-flight work and the standby at lag
+# 0 (asserted BEFORE the kill), the primary is SIGKILLed: the standby
+# must promote and serve its first assignment within 5s, both clients
+# must finish with the exact row multiset (zero duplicate deliveries off
+# the warm mirror), and the promoted standby must count exactly one
+# failover with a bumped epoch.  docs/operations.md "Dispatcher HA".
+HA_SMOKE="$(mktemp /tmp/petastorm_tpu_ha_smoke_XXXXXX.py)"
+cat > "$HA_SMOKE" <<'PY'
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service.protocol import connect_frames, parse_address
+
+CLIENT = """
+import sys
+from petastorm_tpu.reader import make_batch_reader
+with make_batch_reader(sys.argv[1], service_address=sys.argv[2],
+                       shuffle_row_groups=False) as reader:
+    rows = sorted(x for b in reader.iter_batches() for x in b.columns["x"])
+    diag = reader.diagnostics
+assert rows == list(range(400)), (
+    f"row multiset wrong: {len(rows)} rows"  # exact = zero dups, zero losses
+)
+print("ROWS", len(rows), sum(rows), diag["dispatcher_restarts"])
+"""
+
+CLI = [sys.executable, "-m", "petastorm_tpu.service.cli"]
+
+def stats(addr):
+    conn = connect_frames(parse_address(addr), timeout=5.0)
+    try:
+        conn.send({"t": "stats?"})
+        return conn.recv(timeout=5.0)["stats"]
+    finally:
+        conn.close()
+
+if __name__ == "__main__":
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_ha_smoke_")
+    schema = Schema("HASmoke", [Field("x", np.int64)])
+    write_dataset(tmp, schema, [{"x": i} for i in range(400)],
+                  row_group_size_rows=10)
+    journal = tmp + ".journal"  # SIBLING of the dataset dir, not inside it
+    procs = []
+    try:
+        primary = subprocess.Popen(
+            CLI + ["dispatcher", "--host", "127.0.0.1", "--port", "0",
+                   "--heartbeat-timeout", "5", "--journal", journal,
+                   "--journal-fsync"],
+            stdout=subprocess.PIPE, text=True)
+        procs.append(primary)
+        p_addr = re.search(r"listening on (\S+)",
+                           primary.stdout.readline()).group(1)
+        standby = subprocess.Popen(
+            CLI + ["dispatcher", "--host", "127.0.0.1", "--port", "0",
+                   "--heartbeat-timeout", "5", "--standby-of", p_addr],
+            stdout=subprocess.PIPE, text=True)
+        procs.append(standby)
+        s_addr = re.search(r"listening on (\S+)",
+                           standby.stdout.readline()).group(1)
+        peers = f"{p_addr},{s_addr}"  # the failover address list
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                CLI + ["worker", "--address", peers, "--capacity", "2",
+                       "--name", f"haw{i}", "--reconnect-attempts", "240"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.monotonic() + 30
+        while len(stats(p_addr)["workers"]) < 2:
+            assert time.monotonic() < deadline, "workers never registered"
+            time.sleep(0.1)
+        clients = [subprocess.Popen(
+            [sys.executable, "-c", CLIENT, tmp, peers],
+            stdout=subprocess.PIPE, text=True) for _ in range(2)]
+        procs.extend(clients)
+        deadline = time.monotonic() + 30
+        while True:
+            cs = stats(p_addr)["clients"]
+            if len(cs) == 2 and all(c["inflight"] > 0 for c in cs.values()):
+                break  # BOTH clients hold in-flight work at the primary
+            assert time.monotonic() < deadline, f"clients never inflight: {cs}"
+            time.sleep(0.05)
+        # the standby must be WARM before the kill: synced, zero lag
+        deadline = time.monotonic() + 30
+        while True:
+            sb = stats(s_addr)["standby"]
+            if sb["synced_records"] > 0 and sb["lag_items"] == 0:
+                break
+            assert time.monotonic() < deadline, f"standby never warm: {sb}"
+            time.sleep(0.05)
+        assert not sb["promoted"], sb
+        primary.send_signal(signal.SIGKILL)  # every session dies with it
+        killed_at = time.monotonic()
+        primary.wait(timeout=10)
+        # heartbeat-time failover: the promoted standby serves its first
+        # assignment (a client holds in-flight work on IT) within 5s
+        while True:
+            s = stats(s_addr)
+            if s["standby"]["promoted"] and any(
+                    c["inflight"] > 0 for c in s["clients"].values()):
+                break
+            assert time.monotonic() - killed_at < 5.0, (
+                f"standby did not serve within 5s of the kill: {s['standby']}")
+            time.sleep(0.05)
+        first_serve_s = time.monotonic() - killed_at
+        for client in clients:
+            out, _ = client.communicate(timeout=150)
+            assert client.returncode == 0, f"client exited {client.returncode}"
+            n, total, restarts = map(int, out.strip().split()[1:])
+            assert (n, total) == (400, sum(range(400))), (n, total)
+            assert restarts >= 1, f"client never rolled over: {restarts}"
+        s = stats(s_addr)
+        c = s["counters"]
+        assert c.get("service.failovers", 0) == 1, c
+        assert s["epoch"] >= 2, s["epoch"]
+        assert c.get("service.worker_rejoins", 0) >= 2, c
+        print("failover smoke OK (2 clients exact through a primary"
+              f" SIGKILL; standby served {first_serve_s:.2f}s after the"
+              f" kill at epoch {int(s['epoch'])},"
+              f" {int(c.get('service.journal_items_restored', 0))} warm"
+              " item(s) restored,"
+              f" {int(c['service.worker_rejoins'])} worker rejoins)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+PY
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 200 python "$HA_SMOKE"
+rm -f "$HA_SMOKE"
+
 echo "== service colocated shm ratio (REQUIRE_ARENA runtimes: 0.9x floor armed) =="
 # the owed ISSUE 12 capture: on the py3.12 REQUIRE_ARENA job the shm arena
 # plane MUST be live, so the co-located descriptor-only fast path is

@@ -87,7 +87,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.service.dispatcher import compute_recommendation
-from petastorm_tpu.service.protocol import (connect_frames, parse_address,
+from petastorm_tpu.service.protocol import (connect_frames,
+                                            parse_address_list,
                                             resolve_auth_token)
 from petastorm_tpu.telemetry import Telemetry
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
@@ -341,6 +342,13 @@ class AutoscaleSupervisor:
         self.policy = policy or AutoscalePolicy()
         self._dispatcher = dispatcher
         self._address = address
+        #: the probe rotates through a comma-separated failover list
+        #: ('primary:p,standby:p') and remembers the last answering
+        #: address, so a dispatcher failover reads as one slow poll, not
+        #: a dead fleet (docs/operations.md "Dispatcher HA")
+        self._probe_addresses = (parse_address_list(address)
+                                 if address is not None else [])
+        self._probe_index = 0
         self._auth_token = resolve_auth_token(auth_token)
         if spawner is None:
             if address is None:
@@ -388,17 +396,7 @@ class AutoscaleSupervisor:
                 sig = self._dispatcher.scaling_signal(
                     threshold=self.policy.starved_threshold)
             else:
-                conn = connect_frames(parse_address(self._address),
-                                      timeout=5.0)
-                try:
-                    conn.send({"t": "stats?", "token": self._auth_token})
-                    reply = conn.recv(timeout=5.0)
-                finally:
-                    conn.close()
-                if not reply or reply.get("t") != "stats":
-                    raise PetastormTpuError(
-                        f"unexpected stats reply: {reply!r}")
-                sig = reply["stats"]["scaling"]
+                sig = self._probe_scaling()
                 if self.policy.starved_threshold is not None:
                     threshold = self.policy.starved_threshold
                     sig = dict(sig)
@@ -421,6 +419,42 @@ class AutoscaleSupervisor:
         self.last_signal = sig
         self._g_pressure.set(sig["pressure"])
         return sig
+
+    def _probe_scaling(self) -> Dict[str, Any]:
+        """One remote ``stats?`` probe, rotating through the failover
+        address list: the first dispatcher that answers with a live
+        (non-standby) signal wins, and later probes start there.  An
+        unpromoted standby answers stats but is not the fleet - its reply
+        is skipped like a dead address.  Raises only when EVERY address
+        failed."""
+        last_exc: Exception = PetastormTpuError(
+            f"no dispatcher address to probe: {self._address!r}")
+        for offset in range(len(self._probe_addresses)):
+            idx = (self._probe_index + offset) % len(self._probe_addresses)
+            addr = self._probe_addresses[idx]
+            try:
+                conn = connect_frames(addr, timeout=5.0)
+                try:
+                    conn.send({"t": "stats?", "token": self._auth_token})
+                    reply = conn.recv(timeout=5.0)
+                finally:
+                    conn.close()
+                if not reply or reply.get("t") != "stats":
+                    raise PetastormTpuError(
+                        f"unexpected stats reply: {reply!r}")
+                stats = reply["stats"]
+                standby = stats.get("standby") or {}
+                if standby.get("standby") and not standby.get("promoted"):
+                    raise PetastormTpuError(
+                        f"dispatcher at {addr[0]}:{addr[1]} is an"
+                        " unpromoted standby")
+                sig = stats["scaling"]
+            except (OSError, PetastormTpuError, KeyError) as exc:
+                last_exc = exc
+                continue
+            self._probe_index = idx
+            return sig
+        raise last_exc
 
     # -- fleet accounting -----------------------------------------------------
 

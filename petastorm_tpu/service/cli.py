@@ -95,6 +95,20 @@ def build_parser() -> argparse.ArgumentParser:
                    " it restored.  Crash recovery works WITHOUT it (peers"
                    " reconstruct the state); the journal just makes a"
                    " restart cheaper (docs/operations.md 'Fault domains')")
+    d.add_argument("--journal-fsync", action="store_true",
+                   help="fsync every journal record (power-loss-proof tail"
+                   " at a device round-trip per append, metered as"
+                   " service.journal_fsyncs; default off - flush-only, a"
+                   " host power loss can truncate the tail and peers/"
+                   "standby re-fetch the difference)")
+    d.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                   help="run as the HOT STANDBY of the primary dispatcher"
+                   " at HOST:PORT: tail its session journal over the wire"
+                   " (journal_sync frames), refuse client/worker hellos"
+                   " while it lives, and promote with warm state when it"
+                   " dies.  Point peers at a failover list"
+                   " 'primary:port,standby:port' so they rotate here on"
+                   " promotion (docs/operations.md 'Dispatcher HA')")
     d.add_argument("--replay-buffer-mb", type=int, default=256, metavar="MB",
                    help="cap on unacked result BODIES retained for"
                    " reconnect replay, across all clients (default 256);"
@@ -127,7 +141,10 @@ def build_parser() -> argparse.ArgumentParser:
     w = sub.add_parser("worker", help="run one fleet worker",
                        epilog=_TRUST_WARNING)
     w.add_argument("--address", required=True, metavar="HOST:PORT",
-                   help="dispatcher address")
+                   help="dispatcher address; a comma-separated failover"
+                   " list 'primary:port,standby:port' makes registration"
+                   " rotate onto the promoted standby when the primary"
+                   " dies (pair with --reconnect-attempts)")
     w.add_argument("--capacity", type=int, default=2,
                    help="concurrent work items this worker accepts"
                    " (default 2)")
@@ -153,7 +170,10 @@ def build_parser() -> argparse.ArgumentParser:
                " deterministic streams ride scale events untouched.  See"
                " docs/operations.md 'Fleet autoscaling & QoS'.")
     a.add_argument("--address", required=True, metavar="HOST:PORT",
-                   help="dispatcher address to supervise")
+                   help="dispatcher address to supervise; a comma-"
+                   "separated failover list 'primary:port,standby:port'"
+                   " keeps the supervisor probing through a dispatcher"
+                   " failover instead of reporting a dead fleet")
     a.add_argument("--min-workers", type=int, default=1,
                    help="fleet floor, held self-healingly (default 1)")
     a.add_argument("--max-workers", type=int, default=8,
@@ -229,6 +249,8 @@ def _run_dispatcher(args) -> int:
         auth_token=_auth_token(args),
         wire_codec=args.compression,
         journal_path=args.journal,
+        journal_fsync=args.journal_fsync,
+        standby_of=args.standby_of,
         replay_buffer_bytes=args.replay_buffer_mb * 2 ** 20,
         starved_threshold=args.starved_threshold,
         max_clients=args.max_clients,
@@ -236,6 +258,8 @@ def _run_dispatcher(args) -> int:
     dispatcher.start()
     print(f"dispatcher listening on {args.host}:{dispatcher.port}",
           flush=True)
+    if args.standby_of:
+        print(f"standby of {args.standby_of}", flush=True)
     if dispatcher.metrics_server is not None:
         print(f"metrics: http://127.0.0.1:{dispatcher.metrics_server.port}"
               "/metrics", flush=True)

@@ -38,7 +38,7 @@ from petastorm_tpu.retry import RetryPolicy
 from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
                                             FrameClosedError, FrameSocket,
                                             PayloadDecoder, WireItem,
-                                            connect_frames, parse_address,
+                                            connect_frames, parse_address_list,
                                             resolve_allow_pickle,
                                             resolve_auth_token,
                                             shm_transport_available)
@@ -146,7 +146,12 @@ class ServiceExecutor(ExecutorBase):
                 f"service client weight must be > 0; got {weight}")
         self.weight = float(weight)
         self.priority = int(priority)
-        self._address = parse_address(address)
+        #: failover list ('a:p' or 'a:p,b:p' - primary then hot standby);
+        #: every (re)connect rotates through it starting at the last
+        #: address that worked (docs/operations.md "Dispatcher HA")
+        self._addresses = parse_address_list(address)
+        self._addr_index = 0
+        self._address = self._addresses[0]
         #: handshake secret (default $PETASTORM_TPU_SERVICE_TOKEN); must
         #: match the dispatcher's when it enforces one
         self._auth_token = resolve_auth_token(auth_token)
@@ -174,6 +179,10 @@ class ServiceExecutor(ExecutorBase):
         #: reconstructed from our ledger (service.dispatcher_restarts)
         self._dispatcher_boot: Optional[str] = None
         self._dispatcher_restarts = 0
+        #: highest fencing epoch any hello_ok advertised: a dispatcher
+        #: below it is a DEPOSED primary and is refused (split-brain
+        #: fencing - the reconnect rotation moves on to its successor)
+        self._dispatcher_epoch: Optional[int] = None
         self._warned_pickle_fallback = False
         self._last_connect_error: Optional[str] = None
         self._bytes_in_folded = 0
@@ -210,6 +219,8 @@ class ServiceExecutor(ExecutorBase):
             "service.frames_compressed")
         self._m_disp_restarts = self._telemetry.counter(
             "service.dispatcher_restarts")
+        self._m_epoch_refused = self._telemetry.counter(
+            "service.stale_epoch_refusals")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -227,11 +238,33 @@ class ServiceExecutor(ExecutorBase):
             raise PetastormTpuError(
                 "service_address readers ship the worker factory to remote"
                 f" workers, so it must be picklable: {exc}") from exc
-        self._connect(resume=False)
+        self._connect_any(resume=False)
         self._recv_thread = threading.Thread(
             target=self._receiver_loop, daemon=True,
             name="petastorm-tpu-service-recv")
         self._recv_thread.start()
+
+    def _connect_any(self, resume: bool) -> None:
+        """Hello against the failover address list: each address is tried
+        once per call, starting at the last one that worked (the deposed
+        primary's refusals - connection errors, standby refusals, stale
+        epochs - rotate on to its successor).  Raises the last per-address
+        error when the whole list fails."""
+        last_exc: Optional[BaseException] = None
+        n = len(self._addresses)
+        for i in range(n):
+            idx = (self._addr_index + i) % n
+            self._address = self._addresses[idx]
+            try:
+                self._connect(resume)
+            except (OSError, PetastormTpuError) as exc:
+                last_exc = exc
+                self._last_connect_error = str(exc)
+                continue
+            self._addr_index = idx
+            return
+        assert last_exc is not None
+        raise last_exc
 
     def _connect(self, resume: bool) -> None:
         from petastorm_tpu.native import transport_availability
@@ -250,6 +283,20 @@ class ServiceExecutor(ExecutorBase):
         if not hello or hello.get("t") != "hello_ok":
             conn.close()
             raise OSError(f"dispatcher refused client hello: {hello!r}")
+        epoch = hello.get("epoch")
+        if isinstance(epoch, int):
+            if self._dispatcher_epoch is not None \
+                    and epoch < self._dispatcher_epoch:
+                # split-brain fencing: a lower epoch is a deposed primary
+                # that came back after its standby took over - refusing it
+                # (and rotating on) keeps the fleet on the successor
+                conn.close()
+                self._m_epoch_refused.add(1)
+                raise OSError(
+                    f"dispatcher at {self._address[0]}:{self._address[1]}"
+                    f" advertises stale epoch {epoch} <"
+                    f" {self._dispatcher_epoch}: refusing a deposed primary")
+            self._dispatcher_epoch = epoch
         boot = hello.get("boot")
         if boot is not None:
             if self._dispatcher_boot is not None \
@@ -434,9 +481,9 @@ class ServiceExecutor(ExecutorBase):
                     # the network instead of the token
                     detail = (f" (last attempt: {self._last_connect_error})"
                               if self._last_connect_error else "")
+                    addrs = ",".join(f"{h}:{p}" for h, p in self._addresses)
                     self._results.put(_ConnLost(
-                        f"dispatcher connection to"
-                        f" {self._address[0]}:{self._address[1]} lost and"
+                        f"dispatcher connection to {addrs} lost and"
                         f" {self._reconnect_policy.max_attempts} reconnect"
                         f" attempt(s) failed{detail}"))
                     return
@@ -541,7 +588,7 @@ class ServiceExecutor(ExecutorBase):
                     return False
                 time.sleep(_POLL_S)
             try:
-                self._connect(resume=True)
+                self._connect_any(resume=True)
             except (OSError, PetastormTpuError) as exc:
                 # OSError = refused/unreachable; PetastormTpuError covers a
                 # half-dead accept (FrameClosedError mid-hello: the listener
@@ -665,9 +712,12 @@ class ServiceExecutor(ExecutorBase):
         in-flight window usage)."""
         return {**super().diagnostics,
                 "service_address": f"{self._address[0]}:{self._address[1]}",
+                "service_addresses": ",".join(f"{h}:{p}"
+                                              for h, p in self._addresses),
                 "client_id": self.client_id,
                 "connected": self._connected.is_set() and not self._stopped,
                 "reconnects": self._reconnects,
                 "dispatcher_restarts": self._dispatcher_restarts,
+                "dispatcher_epoch": self._dispatcher_epoch,
                 "window": self._window,
                 "window_in_use": len(self._inflight)}

@@ -87,6 +87,30 @@ the listener opens, and reconnecting clients are told which ordinals are
 already held (``hello_ok``'s ``known`` list) so their resync skips
 re-sends.
 
+High availability (docs/operations.md "Dispatcher HA"): a second
+dispatcher started with ``standby_of='host:port'`` (CLI ``--standby-of``)
+is a **hot standby** - it tails the primary's session journal over the
+wire (``standby_hello`` -> ``journal_sync`` frames, fed from
+:meth:`ServiceJournal.attach_tail`; the journal mirror is live even
+without a ``--journal`` file) and keeps every client session warm.  While
+standing by it refuses client/worker hellos (serving only ``stats?``,
+which reports its sync lag); when the primary dies - connection lost AND
+re-sync probes refused, after at least one successful sync - it
+**promotes**: adopts the mirrored sessions, bumps the fencing *epoch*
+past the primary's, and serves.  Clients and workers reach it through a
+failover address list (``service_address='primary:p,standby:p'``), so a
+failover costs one re-hello against already-warm state instead of a full
+peer reconstruction (``service.failovers`` counts promotions;
+``service.standby_lag_items`` meters how far a standby trails).
+
+Split-brain fencing: every ``hello_ok`` and heartbeat reply (``hb_ok``)
+carries the dispatcher's monotonic **epoch**.  A plain restart keeps its
+journal-stored epoch (peers accept an equal epoch); a promotion bumps to
+``primary_epoch + 1``; peers remember the highest epoch they have seen
+and refuse anything lower (``service.stale_epoch_refusals``) - so a
+deposed primary that comes back after its standby took over is refused
+by its own fleet, no matter how often it restarts from its own journal.
+
 Redelivery-buffer bound: unacked result *bodies* are capped at
 ``replay_buffer_bytes`` (gauge ``service.replay_buffer_bytes``).  On
 overflow the oldest already-sent (or disconnected-client) bodies degrade
@@ -101,6 +125,7 @@ from __future__ import annotations
 import collections
 import logging
 import os
+import queue
 import socket
 import threading
 import time
@@ -113,8 +138,10 @@ from petastorm_tpu.pool import VentilatedItem
 from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
                                             FrameClosedError, FrameSocket,
                                             LegacyPickleFrameError, WireItem,
+                                            connect_frames, parse_address_list,
                                             resolve_auth_token, token_matches)
-from petastorm_tpu.service.wire import SUPPORTED_CODECS, negotiate_codec
+from petastorm_tpu.service.wire import (SUPPORTED_CODECS, WireFormatError,
+                                        negotiate_codec)
 from petastorm_tpu.telemetry import resolve as _resolve_telemetry
 
 logger = logging.getLogger(__name__)
@@ -276,6 +303,17 @@ class Dispatcher:
     ``journal_path``: arm the warm-restart session journal (CLI
     ``--journal``; see :mod:`petastorm_tpu.service.journal`) - cold
     recovery from peers works without it.
+    ``journal_fsync``: fsync the journal file per appended record (CLI
+    ``--journal-fsync``; metered as ``service.journal_fsyncs``).  Default
+    off: the flush-per-record journal already survives a process death,
+    and the fsync only buys back the OS-buffered tail a host power-loss
+    would eat - at a device round-trip per control-plane record.  Turn it
+    on when a standby will warm-restart from this file and the host (not
+    just the process) is in the fault model.
+    ``standby_of``: run as a HOT STANDBY of the primary at this
+    ``'host:port'`` (or failover list): tail its journal over the wire,
+    refuse client/worker hellos until the primary dies, then promote with
+    a bumped fencing epoch (module docstring; CLI ``--standby-of``).
     ``replay_buffer_bytes``: cap on retained unacked result *bodies*
     across all clients; overflow degrades the oldest to header-only
     tombstones whose clients re-fetch on reconnect (module docstring).
@@ -308,6 +346,8 @@ class Dispatcher:
                  auth_token: Optional[str] = None,
                  wire_codec: Optional[str] = None,
                  journal_path: Optional[str] = None,
+                 journal_fsync: bool = False,
+                 standby_of: Optional[str] = None,
                  replay_buffer_bytes: int = 256 << 20,
                  starved_threshold: Optional[float] = None,
                  max_clients: Optional[int] = None,
@@ -377,6 +417,25 @@ class Dispatcher:
         self._replay_cap = int(replay_buffer_bytes)
         self._journal = None
         self._journal_path = journal_path
+        self._journal_fsync = bool(journal_fsync)
+        # -- hot-standby HA state (module docstring "High availability") --
+        self._standby_of = standby_of
+        if standby_of is not None:
+            parse_address_list(standby_of)  # fail fast on a bad address
+        #: True while this dispatcher is a warm follower (refusing client/
+        #: worker hellos); flips False exactly once, at promotion
+        self._standby = standby_of is not None
+        #: split-brain fencing epoch: rides every hello_ok / hb_ok; a
+        #: restart keeps its journal-stored value, a promotion bumps past
+        #: the primary's, and peers refuse anything below their max seen
+        self.epoch = 1
+        #: set when a standby promotes itself to primary (tests/operators)
+        self.standby_promoted = threading.Event()
+        self._primary_epoch = 0
+        self._primary_boot: Optional[str] = None
+        self._standby_synced = 0
+        self._standby_lag = 0
+        self._sync_warned = False
         # -- service.* telemetry (rides the registry -> Prometheus/--watch) --
         tele = self.telemetry
         self._g_workers = tele.gauge("service.registered_workers")
@@ -415,6 +474,12 @@ class Dispatcher:
         self._m_capped_deferrals = tele.counter("service.qos.capped_deferrals")
         self._m_drains = tele.counter("service.qos.workers_draining")
         self._g_priority_tiers = tele.gauge("service.qos.priority_tiers")
+        # -- hot-standby HA observability (module docstring) --
+        self._m_failovers = tele.counter("service.failovers")
+        self._m_journal_fsyncs = tele.counter("service.journal_fsyncs")
+        self._m_standby_refused = tele.counter("service.standby_hello_refused")
+        self._g_standby_lag = tele.gauge("service.standby_lag_items")
+        self._g_epoch = tele.gauge("service.epoch")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -423,8 +488,18 @@ class Dispatcher:
         accept + monitor threads; returns self for chaining.  With a
         ``journal_path``, sessions replay from disk BEFORE the listener
         opens - a reconnecting client never races its own restoration."""
-        if self._journal_path is not None:
+        if self._standby:
+            # a standby's state arrives over journal_sync, never from its
+            # own file: the journal stays an unloaded in-memory mirror
+            # until promotion opens (and compacts warm state into) the file
+            from petastorm_tpu.service.journal import ServiceJournal
+
+            self._journal = ServiceJournal(
+                self._journal_path, fsync=self._journal_fsync,
+                fsync_counter=self._m_journal_fsyncs)
+        else:
             self._restore_journal()
+        self._g_epoch.set(self.epoch)
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind((self._host, self._requested_port))
@@ -445,6 +520,13 @@ class Dispatcher:
                 self.telemetry, port=self._metrics_port)
             self.metrics_server.start()
         logger.info("Dispatcher listening on %s:%d", self._host, self.port)
+        if self._standby:
+            t = threading.Thread(target=self._standby_loop, daemon=True,
+                                 name="petastorm-tpu-dispatcher-standby")
+            t.start()
+            self._threads.append(t)
+            logger.info("Dispatcher is a hot STANDBY of %s (refusing client/"
+                        "worker hellos until promotion)", self._standby_of)
         if self._auth_token is None and self._host not in (
                 "127.0.0.1", "localhost", "::1"):
             logger.warning(
@@ -461,36 +543,286 @@ class Dispatcher:
         """Warm restart: rebuild client sessions from the journal file (see
         :mod:`petastorm_tpu.service.journal`).  Restored clients start
         disconnected with the grace timer running - one that never
-        reconnects purges like any dropped client."""
+        reconnects purges like any dropped client.  With ``journal_path``
+        None the journal is still created as a pure in-memory mirror - the
+        live record stream a hot standby tails needs no file."""
         from petastorm_tpu.service.journal import ServiceJournal
 
-        self._journal = ServiceJournal(self._journal_path)
+        self._journal = ServiceJournal(
+            self._journal_path, fsync=self._journal_fsync,
+            fsync_counter=self._m_journal_fsyncs)
         sessions = self._journal.load()
-        now = time.monotonic()
-        restored_items = 0
         with self._lock:
-            for cid, session in sessions.items():
-                hello = session.hello
-                client = _ClientState(
-                    cid, None, hello.get("factory"),
-                    hello.get("hostname", ""), bool(hello.get("shm_ok")),
-                    int(hello.get("max_requeue", self._max_requeue)),
-                    codecs=hello.get("codecs") or (),
-                    weight=hello.get("weight", 1.0),
-                    priority=hello.get("priority", 0))
-                client.connected = False
-                client.disconnected_at = now
-                for item in session.items.values():
-                    client.pending.append(WireItem.from_wire(item))
-                    restored_items += 1
-                self._clients[cid] = client
-                self._client_order.append(cid)
+            restored_items = self._adopt_sessions_locked(sessions)
+        # a plain restart KEEPS its stored epoch (peers accept an equal
+        # epoch): only promotions bump, so a deposed primary can restart
+        # from its own journal forever and still sit below its successor
+        self.epoch = self._journal.epoch or 1
+        self._journal.set_epoch(self.epoch)
         self._journal.open()
         if sessions:
-            self._m_journal_items.add(restored_items)
             logger.info("journal restored %d session(s) with %d unresolved"
                         " item(s); clients have %.0fs to reconnect",
                         len(sessions), restored_items, self._client_grace_s)
+
+    def _adopt_sessions_locked(self, sessions) -> int:
+        """Turn journal-mirror sessions into disconnected client states
+        awaiting their re-hello (warm restart AND standby promotion; caller
+        holds the lock).  Sessions already registered - a client whose
+        hello raced a promotion - are left alone."""
+        now = time.monotonic()
+        restored_items = 0
+        for cid, session in sessions.items():
+            if cid in self._clients:
+                continue
+            hello = session.hello
+            client = _ClientState(
+                cid, None, hello.get("factory"),
+                hello.get("hostname", ""), bool(hello.get("shm_ok")),
+                int(hello.get("max_requeue", self._max_requeue)),
+                codecs=hello.get("codecs") or (),
+                weight=hello.get("weight", 1.0),
+                priority=hello.get("priority", 0))
+            client.connected = False
+            client.disconnected_at = now
+            for item in session.items.values():
+                try:
+                    client.pending.append(WireItem.from_wire(item))
+                except WireFormatError:
+                    continue  # fuzzed/foreign record: skip, don't crash
+                restored_items += 1
+            self._clients[cid] = client
+            self._client_order.append(cid)
+        if restored_items:
+            self._m_journal_items.add(restored_items)
+        return restored_items
+
+    # -- hot-standby HA (module docstring "High availability") -----------------
+
+    #: live-tail records a slow standby may queue before the primary drops
+    #: it (the standby then reconnects and re-snapshots - bounded memory
+    #: beats an unbounded backlog for a follower that cannot keep up)
+    _STANDBY_QUEUE_MAX = 10000
+    #: snapshot records per journal_sync frame (a frame stays control-sized)
+    _SYNC_CHUNK = 256
+    #: consecutive failed re-sync attempts (connect + standby_ok) before a
+    #: once-synced standby declares the primary dead and promotes
+    _PROMOTE_AFTER_FAILS = 3
+
+    def _standby_feed_loop(self, conn: FrameSocket, hello: Dict) -> None:
+        """Primary side: stream the journal (snapshot, then the live tail)
+        to one subscribed standby as ``journal_sync`` frames.  Runs on the
+        standby's connection thread until either end dies or the standby
+        falls irrecoverably behind (queue overflow -> disconnect; it
+        reconnects and re-snapshots)."""
+        if hello.get("protocol") != PROTOCOL_VERSION:
+            conn.send({"t": "error", "error": "protocol version mismatch"})
+            conn.close()
+            return
+        peer = hello.get("standby") or "?"
+        q: "queue.Queue" = queue.Queue(maxsize=self._STANDBY_QUEUE_MAX)
+        overflow = threading.Event()
+
+        def tail(seq: int, rec: Dict) -> None:
+            try:
+                q.put_nowait((seq, rec))
+            except queue.Full:
+                overflow.set()
+
+        snapshot, seq = self._journal.attach_tail(tail)
+        logger.info("Standby %s subscribed to the journal tail (%d snapshot"
+                    " record(s), seq %d)", peer, len(snapshot), seq)
+        try:
+            conn.send({"t": "standby_ok", "epoch": self.epoch,
+                       "boot": self.boot_id})
+            for i in range(0, len(snapshot), self._SYNC_CHUNK):
+                chunk = snapshot[i:i + self._SYNC_CHUNK]
+                try:
+                    conn.send({"t": "journal_sync", "k": "snap",
+                               "recs": chunk, "seq": seq})
+                except WireFormatError:
+                    # a record outside the wire domain poisons its whole
+                    # chunk: retry singly so one bad hello costs one
+                    # session's warmth, not the sync
+                    for rec in chunk:
+                        try:
+                            conn.send({"t": "journal_sync", "k": "snap",
+                                       "recs": [rec], "seq": seq})
+                        except WireFormatError:
+                            logger.warning("journal_sync: unencodable"
+                                           " snapshot record skipped (%r)",
+                                           rec.get("r"))
+            conn.send({"t": "journal_sync", "k": "snap_end", "seq": seq})
+            while not self._stop_event.is_set():
+                if overflow.is_set():
+                    logger.warning(
+                        "Standby %s fell > %d record(s) behind the journal"
+                        " tail; disconnecting it to force a re-snapshot",
+                        peer, self._STANDBY_QUEUE_MAX)
+                    break
+                try:
+                    rec_seq, rec = q.get(timeout=0.5)
+                except queue.Empty:
+                    # idle keepalive: carries the LIVE journal seq, so the
+                    # standby can meter any backlog as lag
+                    conn.send({"t": "journal_sync", "k": "ping",
+                               "seq": self._journal.seq})
+                    continue
+                try:
+                    conn.send({"t": "journal_sync", "k": "rec", "rec": rec,
+                               "seq": rec_seq})
+                except WireFormatError:
+                    logger.warning("journal_sync: unencodable tail record"
+                                   " skipped (%r)", rec.get("r"))
+        except (OSError, FrameClosedError):
+            pass  # standby went away; it reconnects (or promoted)
+        finally:
+            self._journal.detach_tail(tail)
+            conn.close()
+
+    def _standby_loop(self) -> None:
+        """Standby side: keep a sync session against the primary; when the
+        primary is gone (connection lost AND :data:`_PROMOTE_AFTER_FAILS`
+        consecutive re-sync attempts fail) promote.  Never promotes before
+        the FIRST successful sync: a standby that cannot reach a healthy
+        primary at boot must wait, not seize an empty fleet."""
+        targets = parse_address_list(self._standby_of)
+        synced_ever = False
+        fails = 0
+        while not self._stop_event.is_set() and self._standby:
+            contact = False
+            for addr in targets:
+                if self._standby_sync(addr):
+                    synced_ever = True
+                    fails = 0
+                    contact = True
+                    break
+            if self._stop_event.is_set() or not self._standby:
+                return
+            if not contact:
+                fails += 1
+                if synced_ever and fails >= self._PROMOTE_AFTER_FAILS:
+                    self._promote(f"primary {self._standby_of} unreachable"
+                                  f" after {fails} re-sync attempt(s)")
+                    return
+            self._stop_event.wait(0.3)
+
+    def _standby_sync(self, addr: Tuple[str, int]) -> bool:
+        """One sync session: subscribe, ingest the snapshot, follow the
+        tail until the stream dies.  Returns True when the primary
+        answered ``standby_ok`` (contact - even if the stream later broke:
+        only answer-less attempts count toward promotion)."""
+        try:
+            conn = connect_frames(addr, timeout=2.0)
+        except OSError:
+            return False
+        contact = False
+        try:
+            conn.send({"t": "standby_hello", "protocol": PROTOCOL_VERSION,
+                       "token": self._auth_token,
+                       "standby": f"{self._host}:{self.port}"})
+            ok = conn.recv(timeout=5.0)
+            if not isinstance(ok, dict) or ok.get("t") != "standby_ok":
+                if isinstance(ok, dict) and ok.get("t") == "error":
+                    self._m_standby_refused.add(1)
+                    logger.warning("Primary refused the standby"
+                                   " subscription: %s", ok.get("error"))
+                return False
+            contact = True
+            self._primary_epoch = max(self._primary_epoch,
+                                      int(ok.get("epoch") or 1))
+            self._primary_boot = ok.get("boot")
+            # fresh snapshot incoming: drop whatever the last session left
+            self._journal.reset()
+            self._standby_synced = 0
+            stream_pos = 0
+            last_rx = time.monotonic()
+            silence_limit = max(2.0, self._heartbeat_timeout_s)
+            while not self._stop_event.is_set() and self._standby:
+                msg = conn.recv(timeout=0.5)
+                now = time.monotonic()
+                if msg is None:
+                    if now - last_rx > silence_limit:
+                        logger.warning("journal_sync stream from %s:%d went"
+                                       " silent for %.1fs; dropping it",
+                                       addr[0], addr[1], now - last_rx)
+                        return True
+                    continue
+                last_rx = now
+                if not isinstance(msg, dict) or msg.get("t") != "journal_sync":
+                    continue
+                k, seq = msg.get("k"), msg.get("seq")
+                if k == "snap":
+                    for rec in msg.get("recs") or ():
+                        self._journal.ingest(rec)
+                        self._standby_synced += 1
+                elif k == "rec":
+                    self._journal.ingest(msg.get("rec"))
+                    self._standby_synced += 1
+                    if isinstance(seq, int):
+                        stream_pos = seq
+                elif k == "snap_end":
+                    if isinstance(seq, int):
+                        stream_pos = seq
+                    self._standby_lag = 0
+                    self._g_standby_lag.set(0)
+                    logger.info("Standby warm: %d record(s) synced from"
+                                " %s:%d (primary epoch %d)",
+                                self._standby_synced, addr[0], addr[1],
+                                self._primary_epoch)
+                elif k == "ping" and isinstance(seq, int):
+                    # ping carries the primary's LIVE seq; anything above
+                    # our stream position is backlog we have not received
+                    self._standby_lag = max(0, seq - stream_pos)
+                    self._g_standby_lag.set(self._standby_lag)
+        except (OSError, FrameClosedError):
+            pass  # stream died: the outer loop probes, then promotes
+        except (WireFormatError, PetastormTpuError) as exc:
+            # mid-stream garbage (a cut frame, an undecodable record): the
+            # warm mirror can no longer be trusted.  Degrade to a cold
+            # re-snapshot - warned ONCE, never a crash or a desynced mirror
+            if not self._sync_warned:
+                self._sync_warned = True
+                logger.warning(
+                    "journal_sync stream from %s:%d was undecodable (%s);"
+                    " dropping the warm mirror and re-snapshotting (a"
+                    " promotion before the re-sync completes falls back to"
+                    " cold peer reconstruction)", addr[0], addr[1], exc)
+            self._journal.reset()
+            self._standby_synced = 0
+        finally:
+            conn.close()
+        return contact
+
+    def _promote(self, reason: str) -> None:
+        """Standby -> primary: adopt the mirrored sessions, fence the old
+        primary out by bumping the epoch past anything it ever advertised,
+        and start serving hellos."""
+        with self._lock:
+            if not self._standby:
+                return
+            self._standby = False
+            self.epoch = max(self.epoch, self._primary_epoch + 1,
+                             self._journal.epoch + 1)
+            sessions = self._journal.sessions()
+            restored = self._adopt_sessions_locked(sessions)
+        self._journal.set_epoch(self.epoch)
+        try:
+            # persist the adopted state (and the new epoch) to this
+            # dispatcher's OWN journal file, when it has one
+            self._journal.open()
+        except OSError:
+            logger.warning("could not open the journal file after"
+                           " promotion; serving without one", exc_info=True)
+        self._m_failovers.add(1)
+        self._g_epoch.set(self.epoch)
+        self._g_standby_lag.set(0)
+        self.standby_promoted.set()
+        logger.warning(
+            "STANDBY PROMOTED to primary (%s): epoch %d, %d warm session(s)"
+            " with %d pending item(s); serving at %s:%d", reason, self.epoch,
+            len(sessions), restored, self._host, self.port)
+        self._stamp_gauges()
 
     def stop(self) -> None:
         """Close the listener and every live connection; workers and
@@ -587,10 +919,24 @@ class Dispatcher:
             conn.close()
             return
         try:
-            if kind == "worker_hello":
+            if self._standby and kind in ("worker_hello", "client_hello"):
+                # a standby serves stats? and journal subscriptions only;
+                # peers treat this refusal as a failed attempt and rotate
+                # to the next address in their failover list
+                try:
+                    conn.send({"t": "error", "error":
+                               "dispatcher is a hot standby (of"
+                               f" {self._standby_of}); not serving until"
+                               " promoted"})
+                except OSError:
+                    pass
+                conn.close()
+            elif kind == "worker_hello":
                 self._worker_loop(conn, hello)
             elif kind == "client_hello":
                 self._client_loop(conn, hello)
+            elif kind == "standby_hello":
+                self._standby_feed_loop(conn, hello)
             elif kind == "stats?":
                 conn.send({"t": "stats", "stats": self.stats()})
                 conn.close()
@@ -622,7 +968,7 @@ class Dispatcher:
             self._workers[name] = state
             self._g_workers.set(len(self._workers))
             recovered = self._absorb_worker_rejoin_locked(state, hello)
-        conn.send({"t": "hello_ok", "worker": name})
+        conn.send({"t": "hello_ok", "worker": name, "epoch": self.epoch})
         if hello.get("resume"):
             self._m_worker_rejoins.add(1)
             logger.info("Worker %s REJOINED still executing %d item(s)"
@@ -651,6 +997,8 @@ class Dispatcher:
                     self._on_worker_failure(state, msg)
                 elif kind == "retiring":
                     self._on_retiring(state)
+                elif kind == "drained?":
+                    self._on_drain_probe(state)
                 elif kind == "bye":
                     break
         except FrameClosedError:
@@ -737,6 +1085,25 @@ class Dispatcher:
         except OSError:
             pass  # dying connection: _worker_gone's requeue path covers it
 
+    def _on_drain_probe(self, state: _WorkerState) -> None:
+        """Graceful retirement, phase 2: the worker's held/outbox sets are
+        empty and it asks whether the DISPATCHER still has anything
+        assigned to it.  The dispatcher's in-flight set is the source of
+        truth (an assignment is recorded there before its ``work`` frame is
+        even sent), so ``drain_ok`` structurally proves nothing is - or
+        ever will be - outstanding: the worker may say ``bye`` with no
+        timing window (the pre-PR 0.3s quiet-period heuristic raced
+        results still crossing the wire)."""
+        with self._lock:
+            remaining = len(state.inflight)
+        try:
+            if remaining == 0:
+                state.conn.send({"t": "drain_ok"})
+            else:
+                state.conn.send({"t": "drain_wait", "inflight": remaining})
+        except OSError:
+            pass  # dying connection: _worker_gone's requeue path covers it
+
     def _on_heartbeat(self, state: _WorkerState, msg: Dict) -> None:
         state.last_heartbeat = time.monotonic()
         state.busy = int(msg.get("busy", 0))
@@ -745,6 +1112,12 @@ class Dispatcher:
             for cname, delta in deltas.items():
                 if delta and cname.startswith(FLEET_COUNTER_PREFIXES):
                     self.telemetry.counter(f"service.fleet.{cname}").add(delta)
+        try:
+            # the heartbeat reply carries the fencing epoch, so a fleet
+            # learns about a failover even between reconnects
+            state.conn.send({"t": "hb_ok", "epoch": self.epoch})
+        except OSError:
+            pass  # dying connection: the read loop handles it
 
     # -- bounded redelivery buffer (satellite: replay_buffer_bytes) ------------
 
@@ -1125,9 +1498,10 @@ class Dispatcher:
                 "codecs": list(hello.get("codecs") or ()),
                 "weight": client.weight, "priority": client.priority})
         # `boot` lets the client count dispatcher restarts; `known` lets a
-        # warm-restarted (journaled) session skip resync re-sends
+        # warm-restarted (journaled) session skip resync re-sends; `epoch`
+        # is the fencing token (a deposed primary's lower value is refused)
         conn.send({"t": "hello_ok", "client": cid, "boot": self.boot_id,
-                   "known": known})
+                   "epoch": self.epoch, "known": known})
         for out in replay:
             self._send_to_client(cid, conn, out)
         self._pump()
@@ -1595,8 +1969,18 @@ class Dispatcher:
                         "orphan_results": len(self._orphan_results),
                         "replay_buffer_bytes": self._replay_bytes,
                         "journal": self._journal_path}
-        return {"uptime_s": round(time.monotonic() - self._started_at, 1),
-                "port": self.port, "boot": self.boot_id,
-                "workers": workers, "clients": clients, "qos": qos,
-                "recovery": recovery,
-                "counters": counters, "scaling": self.scaling_signal()}
+        out = {"uptime_s": round(time.monotonic() - self._started_at, 1),
+               "port": self.port, "boot": self.boot_id, "epoch": self.epoch,
+               "workers": workers, "clients": clients, "qos": qos,
+               "recovery": recovery,
+               "counters": counters, "scaling": self.scaling_signal()}
+        if self._standby_of is not None:
+            out["standby"] = {
+                "standby": self._standby,
+                "of": self._standby_of,
+                "promoted": self.standby_promoted.is_set(),
+                "primary_epoch": self._primary_epoch,
+                "primary_boot": self._primary_boot,
+                "synced_records": self._standby_synced,
+                "lag_items": self._standby_lag}
+        return out

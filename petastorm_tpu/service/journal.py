@@ -24,11 +24,27 @@ tail.  Requeue ``attempt`` counters restore from the *enqueued* value, so
 a restart is slightly generous to items that were mid-requeue (documented,
 deliberate: the budget is a safety valve, not an exactness invariant).
 
-Durability is flush-per-record, not fsync: a host power-loss can truncate
+Durability is flush-per-record by default: a host power-loss can truncate
 the tail, and :meth:`ServiceJournal.load` stops cleanly at the first
 short/undecodable record (peer reconstruction covers whatever the tail
-lost).  The file auto-compacts - acked items are dropped and the journal
-rewritten - once the append log outgrows its live state 4x.
+lost).  ``fsync=True`` (CLI ``--journal-fsync``) additionally fsyncs every
+record - each append then pays a device round-trip (metered as
+``service.journal_fsyncs``), in exchange for a tail no OS buffer can eat;
+size that tradeoff against how much a hot-standby's re-fetch of a lost
+tail costs (docs/operations.md "Dispatcher HA").  The file auto-compacts -
+acked items are dropped and the journal rewritten - once the append log
+outgrows its live state 4x.
+
+Beyond the file, the journal doubles as the dispatcher's **live session
+mirror** (``path=None`` keeps the mirror with no file at all), and
+:meth:`attach_tail` exposes it as a stream: a subscriber receives a
+state-reconstructing snapshot plus every subsequent logical record, in
+order, regardless of file compaction (compaction rewrites bytes, never
+live state - which is exactly why the tail is logical records, not file
+offsets).  The hot-standby dispatcher tails this stream over the wire as
+``journal_sync`` frames to keep warm (:mod:`petastorm_tpu.service.
+dispatcher`).  A monotonic ``epoch`` record persists the split-brain
+fencing epoch across restarts.
 """
 
 from __future__ import annotations
@@ -76,19 +92,32 @@ class ServiceJournal:
     ordinary process death (the recovery scenario) loses nothing.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: Optional[str], *, fsync: bool = False,
+                 fsync_counter=None):
+        #: ``path=None`` is a pure in-memory mirror: ``load``/``open`` are
+        #: no-ops and appends only update live state (what a journal-less
+        #: dispatcher feeds its hot standby from, and what a standby
+        #: accumulates before promotion).
         self._path = path
+        self._fsync = bool(fsync)
+        self._fsync_counter = fsync_counter
+        self.fsyncs = 0
         self._lock = threading.Lock()
         self._fh = None
         self._bytes = 0
         self._sessions: Dict[str, _Session] = {}
+        #: count of logical records applied (monotonic; tail stream position)
+        self.seq = 0
+        #: split-brain fencing epoch, 0 until a dispatcher stamps one
+        self.epoch = 0
+        self._tails = []
 
     # -- restart side ----------------------------------------------------------
 
     def load(self) -> Dict[str, _Session]:
         """Parse the journal (tolerating a truncated tail) into sessions;
         returns ``{client_id: _Session}``.  Call before :meth:`open`."""
-        if not os.path.exists(self._path):
+        if self._path is None or not os.path.exists(self._path):
             return {}
         records = 0
         with open(self._path, "rb") as fh:
@@ -122,6 +151,11 @@ class ServiceJournal:
 
     def _apply(self, rec: Dict[str, Any]) -> None:
         kind, cid = rec.get("r"), rec.get("client")
+        if kind == "epoch":
+            value = rec.get("epoch")
+            if isinstance(value, int) and value > self.epoch:
+                self.epoch = value
+            return
         if not isinstance(cid, str):
             return
         if kind == "hello":
@@ -147,9 +181,11 @@ class ServiceJournal:
     # -- append side -----------------------------------------------------------
 
     def open(self) -> "ServiceJournal":
-        """Compact-rewrite the loaded state and start appending."""
+        """Compact-rewrite the loaded state and start appending (no-op for
+        an in-memory mirror)."""
         with self._lock:
-            self._rewrite_locked()
+            if self._path is not None:
+                self._rewrite_locked()
         return self
 
     def append_hello(self, cid: str, hello: Dict[str, Any]) -> None:
@@ -164,25 +200,94 @@ class ServiceJournal:
     def append_purge(self, cid: str) -> None:
         self._append({"r": "purge", "client": cid})
 
+    def set_epoch(self, epoch: int) -> None:
+        """Stamp (and persist, if file-backed) the fencing epoch."""
+        self._append({"r": "epoch", "epoch": int(epoch)})
+
+    def ingest(self, rec) -> None:
+        """Apply one record received over the wire (standby sync path)."""
+        if isinstance(rec, dict):
+            self._append(rec)
+
     def _append(self, rec: Dict[str, Any]) -> None:
-        try:
-            encoded = wire.dumps(rec)
-        except WireFormatError:
-            # a hello with out-of-domain extras must not kill the control
-            # plane; the session just won't warm-restart
-            logger.warning("journal: unencodable record dropped (%r)",
-                           rec.get("r"))
-            return
+        encoded = None
+        if self._fh is not None:
+            try:
+                encoded = wire.dumps(rec)
+            except WireFormatError:
+                # a hello with out-of-domain extras must not kill the
+                # control plane; the session just won't warm-restart
+                logger.warning("journal: unencodable record dropped (%r)",
+                               rec.get("r"))
+                return
         with self._lock:
             self._apply(rec)
-            if self._fh is None:
-                return  # load-only phase (applied to the mirror regardless)
-            self._fh.write(_LEN.pack(len(encoded)) + encoded)
-            self._fh.flush()
-            self._bytes += _LEN.size + len(encoded)
-            if self._bytes > _COMPACT_MIN_BYTES \
-                    and self._bytes > 4 * self._live_bytes_locked():
-                self._rewrite_locked()
+            self.seq += 1
+            if self._fh is not None:
+                if encoded is None:
+                    try:
+                        encoded = wire.dumps(rec)
+                    except WireFormatError:
+                        encoded = None
+                if encoded is not None:
+                    self._fh.write(_LEN.pack(len(encoded)) + encoded)
+                    self._fh.flush()
+                    if self._fsync:
+                        os.fsync(self._fh.fileno())
+                        self.fsyncs += 1
+                        if self._fsync_counter is not None:
+                            self._fsync_counter.add(1)
+                    self._bytes += _LEN.size + len(encoded)
+                    if self._bytes > _COMPACT_MIN_BYTES \
+                            and self._bytes > 4 * self._live_bytes_locked():
+                        self._rewrite_locked()
+            for fn in list(self._tails):
+                try:
+                    fn(self.seq, rec)
+                except Exception:  # noqa: BLE001 - a broken tail must not
+                    self._tails.remove(fn)  # stall the control plane
+
+    # -- streaming tail (hot-standby sync) -------------------------------------
+
+    def attach_tail(self, fn):
+        """Subscribe ``fn(seq, rec)`` to every subsequent logical record.
+
+        Returns ``(snapshot_records, seq)``: replaying the snapshot then the
+        streamed records reconstructs this journal's live state exactly.
+        ``fn`` runs under the journal lock and must never block (push to a
+        bounded queue; a raising tail is detached).
+        """
+        with self._lock:
+            records = self._snapshot_records_locked()
+            self._tails.append(fn)
+            return records, self.seq
+
+    def detach_tail(self, fn) -> None:
+        with self._lock:
+            try:
+                self._tails.remove(fn)
+            except ValueError:
+                pass
+
+    def _snapshot_records_locked(self):
+        records = []
+        if self.epoch:
+            records.append({"r": "epoch", "epoch": self.epoch})
+        for cid, session in self._sessions.items():
+            records.append(session.hello)
+            records.extend({"r": "enq", "client": cid, "item": item}
+                           for item in session.items.values())
+        return records
+
+    def sessions(self) -> Dict[str, _Session]:
+        with self._lock:
+            return dict(self._sessions)
+
+    def reset(self) -> None:
+        """Drop all mirrored state (a standby starting a fresh re-sync)."""
+        with self._lock:
+            self._sessions.clear()
+            self.epoch = 0
 
     def _live_bytes_locked(self) -> int:
         total = 0
@@ -199,13 +304,18 @@ class ServiceJournal:
         tmp = self._path + ".tmp"
         with open(tmp, "wb") as fh:
             size = 0
-            for cid, session in self._sessions.items():
-                for rec in ([session.hello]
-                            + [{"r": "enq", "client": cid, "item": item}
-                               for item in session.items.values()]):
+            for rec in self._snapshot_records_locked():
+                try:
                     encoded = wire.dumps(rec)
-                    fh.write(_LEN.pack(len(encoded)) + encoded)
-                    size += _LEN.size + len(encoded)
+                except WireFormatError:
+                    logger.warning("journal: unencodable record dropped in"
+                                   " rewrite (%r)", rec.get("r"))
+                    continue
+                fh.write(_LEN.pack(len(encoded)) + encoded)
+                size += _LEN.size + len(encoded)
+            if self._fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
         os.replace(tmp, self._path)
         self._fh = open(self._path, "ab")
         self._bytes = size

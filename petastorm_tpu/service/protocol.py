@@ -56,6 +56,26 @@ then the body:
   ``requeued``            dispatcher -> client: an in-flight item was requeued
                           off a dead worker (accounting notice)
   ``stats?``/``stats``    any -> dispatcher: state snapshot (CLI, tests)
+  ``hb_ok``               dispatcher -> worker: heartbeat reply carrying the
+                          dispatcher ``epoch`` (split-brain fencing: a
+                          deposed primary's lower epoch is refused -
+                          ``hello_ok`` carries the same field)
+  ``drained?``            retiring worker -> dispatcher: "is anything still
+                          assigned to me?"; answered ``drain_ok`` (send the
+                          goodbye) or ``drain_wait`` (results still in
+                          flight) - the drain handshake is structural, not
+                          a timing window
+  ``standby_hello``       standby dispatcher -> primary: subscribe to the
+                          journal tail.  The ``standby_ok`` reply carries
+                          the primary's ``epoch`` + ``boot``; then the
+                          primary streams ``journal_sync`` frames
+  ``journal_sync``        primary -> standby: journal records over the wire.
+                          ``k``: ``snap`` (snapshot chunk, ``recs`` list) /
+                          ``snap_end`` (snapshot complete) / ``rec`` (one
+                          live tail record) / ``ping`` (idle keepalive);
+                          every frame carries the primary's journal ``seq``
+                          so the standby can meter its lag
+                          (``service.standby_lag_items``)
   ======================  =====================================================
 
 * ``KIND_BATCH``: one ``result`` outcome - a CTRL-encoded header (``t``,
@@ -605,6 +625,20 @@ def parse_address(address) -> Tuple[str, int]:
         return host or "127.0.0.1", int(port)
     raise PetastormTpuError(
         f"service address must be 'host:port' or (host, port); got {address!r}")
+
+
+def parse_address_list(address) -> List[Tuple[str, int]]:
+    """Failover address syntax: ``'a:p'``/``(host, port)`` (one address) or
+    ``'a:p,b:p'`` - a primary-then-standby list clients, workers and the
+    autoscale prober rotate through on connection loss (docs/operations.md
+    "Dispatcher HA")."""
+    if isinstance(address, str) and "," in address:
+        parts = [p.strip() for p in address.split(",") if p.strip()]
+        if not parts:
+            raise PetastormTpuError(
+                f"service address list is empty: {address!r}")
+        return [parse_address(p) for p in parts]
+    return [parse_address(address)]
 
 
 # -- result payload encoding --------------------------------------------------

@@ -55,7 +55,8 @@ from petastorm_tpu.pool import VentilatedItem, _Failure
 from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
                                             FrameClosedError, FrameSocket,
                                             connect_frames, encode_result,
-                                            parse_address, resolve_auth_token,
+                                            parse_address_list,
+                                            resolve_auth_token,
                                             shm_transport_available)
 from petastorm_tpu.service.wire import SUPPORTED_CODECS, WireFormatError
 from petastorm_tpu.telemetry import Telemetry
@@ -100,6 +101,17 @@ class ServiceWorker:
     work keeps executing, registration retries every
     ``reconnect_backoff_s``, and the rejoin reports held assignments/jobs
     (module docstring).
+
+    ``address`` may be a comma-separated failover list
+    (``'primary:port,standby:port'``): registration rotates through it,
+    so when a hot-standby dispatcher promotes, the same retry loop lands
+    on the survivor with the worker's held state intact.  Epoch fencing
+    rides the same handshake: every ``hello_ok``/``hb_ok`` carries the
+    dispatcher's fencing epoch, and a dispatcher advertising an epoch
+    *below* the highest this worker has seen is a deposed primary - its
+    registration is refused (``service.stale_epoch_refusals``) and the
+    rotation moves on, so a partitioned ex-primary can never hand this
+    worker work its successor also assigned.
     """
 
     def __init__(self, address, capacity: int = 2, name: Optional[str] = None,
@@ -109,7 +121,12 @@ class ServiceWorker:
                  reconnect_backoff_s: float = 1.0):
         if capacity < 1:
             raise PetastormTpuError("ServiceWorker capacity must be >= 1")
-        self._address = parse_address(address)
+        self._addresses = parse_address_list(address)
+        self._addr_index = 0
+        self._address = self._addresses[0]
+        #: highest fencing epoch any dispatcher has advertised to us; a
+        #: hello_ok below this is a deposed primary and is refused
+        self._dispatcher_epoch = 0
         #: handshake secret (default $PETASTORM_TPU_SERVICE_TOKEN); must
         #: match the dispatcher's when it enforces one
         self._auth_token = resolve_auth_token(auth_token)
@@ -153,7 +170,9 @@ class ServiceWorker:
         self._retiring = threading.Event()
         self._retire_acked = threading.Event()
         self._retire_sent = False
-        self._drain_ok_since: Optional[float] = None
+        #: dispatcher confirmed (drain_ok) that nothing is in flight
+        #: toward us - the structural half of the drain handshake
+        self._drain_confirmed = threading.Event()
         self.retired_gracefully = False
 
     # -- lifecycle ------------------------------------------------------------
@@ -197,15 +216,22 @@ class ServiceWorker:
         try:
             while not self._stop_event.is_set():
                 conn = None
+                addr = self._addresses[self._addr_index
+                                       % len(self._addresses)]
+                self._address = addr
                 try:
-                    conn = connect_frames(self._address)
+                    conn = connect_frames(addr)
                     self._register(conn)
                 except (OSError, PetastormTpuError) as exc:
                     # covers unreachable/refused dispatchers AND a
                     # dispatcher mid-restart that accepts then resets
-                    # inside the hello
+                    # inside the hello; a standby refuses worker hellos
+                    # until promoted, which lands here too - the rotation
+                    # below walks the failover list until the live
+                    # (highest-epoch) dispatcher answers
                     if conn is not None:
                         conn.close()
+                    self._addr_index += 1
                     if attempts_left <= 0:
                         if registered_once:
                             logger.warning(
@@ -213,15 +239,20 @@ class ServiceWorker:
                                 " is spent; worker exiting (%s)", exc)
                             return 0
                         logger.error("Cannot register with dispatcher at"
-                                     " %s:%d: %s", self._address[0],
-                                     self._address[1], exc)
+                                     " %s:%d: %s", addr[0], addr[1], exc)
                         return 1
                     attempts_left -= 1
-                    logger.info("Dispatcher unavailable (%s); retrying"
-                                " registration in %.1fs (%d attempt(s)"
-                                " left)", exc, self._reconnect_backoff_s,
-                                attempts_left + 1)
-                    self._stop_event.wait(self._reconnect_backoff_s)
+                    # a multi-address fleet retries the next address
+                    # immediately (the whole point of a hot standby is
+                    # failing over in heartbeat time, not backoff time);
+                    # only a full rotation with no winner backs off
+                    if len(self._addresses) == 1 \
+                            or self._addr_index % len(self._addresses) == 0:
+                        logger.info("Dispatcher unavailable (%s); retrying"
+                                    " registration in %.1fs (%d attempt(s)"
+                                    " left)", exc, self._reconnect_backoff_s,
+                                    attempts_left + 1)
+                        self._stop_event.wait(self._reconnect_backoff_s)
                     continue
                 if registered_once:
                     self.dispatcher_reconnects += 1
@@ -263,6 +294,20 @@ class ServiceWorker:
         if not hello or hello.get("t") != "hello_ok":
             raise PetastormTpuError(
                 f"dispatcher refused registration: {hello!r}")
+        epoch = hello.get("epoch")
+        if isinstance(epoch, int):
+            if epoch < self._dispatcher_epoch:
+                # split-brain fencing: this is a deposed primary (its
+                # epoch predates one we already worked under) - refuse
+                # it and let the rotation find the promoted standby
+                self.telemetry.counter(
+                    "service.stale_epoch_refusals").add(1)
+                raise PetastormTpuError(
+                    f"dispatcher at {self._address[0]}:{self._address[1]}"
+                    f" advertises stale epoch {epoch} <"
+                    f" {self._dispatcher_epoch}: refusing a deposed"
+                    " primary")
+            self._dispatcher_epoch = epoch
         self.worker_name = hello.get("worker")
         if resume:
             logger.info("Rejoined dispatcher as %s (still holding %d"
@@ -296,6 +341,7 @@ class ServiceWorker:
         # the (possibly restarted) dispatcher before the drain can finish
         self._retire_sent = False
         self._retire_acked.clear()
+        self._drain_confirmed.clear()
         self._flush_outbox()
 
     def _serve(self, conn: FrameSocket) -> None:
@@ -336,6 +382,18 @@ class ServiceWorker:
                     # be assigned); the heartbeat thread completes the
                     # drain once everything held has been delivered
                     self._retire_acked.set()
+                elif kind == "hb_ok":
+                    epoch = msg.get("epoch")
+                    if isinstance(epoch, int) \
+                            and epoch > self._dispatcher_epoch:
+                        self._dispatcher_epoch = epoch
+                elif kind == "drain_ok":
+                    # dispatcher-confirmed: nothing is in flight toward
+                    # us (recorded-before-send on its side makes this
+                    # structural, not a timing window)
+                    self._drain_confirmed.set()
+                elif kind == "drain_wait":
+                    self._drain_confirmed.clear()
                 elif kind == "stop":
                     self._stop_event.set()
                     break
@@ -617,28 +675,31 @@ class ServiceWorker:
 
     def _check_drained(self, now: float) -> bool:
         """Drain-completion check (heartbeat thread): everything this
-        worker held has reached the dispatcher, stably across two checks
-        >= 0.3s apart (the stability window absorbs work frames that were
-        already in flight toward us when the dispatcher marked us
-        draining).  On completion: ``bye``, stop, done."""
+        worker held has reached the dispatcher, AND the dispatcher has
+        confirmed - via the ``drained?``/``drain_ok`` probe - that it has
+        nothing recorded in flight toward us.  Because the dispatcher
+        records an assignment *before* sending its work frame and stops
+        assigning once it acks ``retiring``, a ``drain_ok`` structurally
+        rules out a work frame racing our goodbye - no timing window to
+        tune.  On completion: ``bye``, stop, done."""
         if not self._retire_acked.is_set():
             return False
         with self._held_lock:
             empty = not self._held and not self._outbox
         if not empty:
-            self._drain_ok_since = None
+            # a straggler work frame landed since the last probe; any
+            # earlier confirmation is stale
+            self._drain_confirmed.clear()
             return False
-        if self._drain_ok_since is None:
-            self._drain_ok_since = now
-            return False
-        if now - self._drain_ok_since < 0.3:
-            return False
-        logger.info("Worker %s drained; retiring gracefully",
-                    self.worker_name or "?")
-        self._send({"t": "bye"})
-        self.retired_gracefully = True
-        self.stop()
-        return True
+        if self._drain_confirmed.is_set():
+            logger.info("Worker %s drained; retiring gracefully",
+                        self.worker_name or "?")
+            self._send({"t": "bye"})
+            self.retired_gracefully = True
+            self.stop()
+            return True
+        self._send({"t": "drained?"})
+        return False
 
 
 def run_worker(address, capacity: int = 2, name: Optional[str] = None,

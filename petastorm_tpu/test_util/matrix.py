@@ -43,9 +43,12 @@ CHAOS_KINDS = ("none", "kill", "hang", "hedge")
 #: ``disruptor`` callable, normally one of the FleetHandle methods);
 #: ``elastic-fleet`` is the ISSUE 14 cell: a new worker joins AND an
 #: original gracefully drains mid-epoch (the autoscale supervisor's
-#: grow + retire moves)
+#: grow + retire moves); ``failover`` is the ISSUE 17 cell: the primary
+#: dispatcher dies mid-epoch (SIGKILL-equivalent or partition) and the
+#: hot standby promotes, with peers rotating through their failover
+#: address lists (:func:`ha_fleet`)
 DISRUPTION_KINDS = ("none", "dispatcher-restart", "netsplit", "netchaos",
-                    "elastic-fleet")
+                    "elastic-fleet", "failover")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -493,6 +496,142 @@ class FleetHandle:
         drives, compressed into one mid-read event."""
         self.scale_up(1)
         self.retire_worker(0)
+
+
+# -- hot-standby HA fleets (failover / split-brain cells) ----------------------
+
+class HAFleetHandle(FleetHandle):
+    """A :class:`FleetHandle` with a hot-standby dispatcher pair (ISSUE
+    17): ``primary`` feeds ``standby`` over ``journal_sync``, workers and
+    clients dial the failover address list, and the harness can kill the
+    primary outright (:meth:`failover`) or partition it away
+    (:meth:`partition_primary`, ``ha_fleet(partitionable=True)``) to
+    exercise promotion and split-brain fencing.  ``self.dispatcher``
+    tracks the LIVE side: the primary until a promotion, the standby
+    after."""
+
+    def __init__(self, primary, standby, workers, client_address,
+                 sync_proxy=None, peer_proxy=None):
+        super().__init__(primary, workers, proxy=None)
+        self.primary = primary
+        self.standby = standby
+        self.sync_proxy = sync_proxy
+        self.peer_proxy = peer_proxy
+        self._client_address = client_address
+        self.primary_direct = f"127.0.0.1:{primary.port}"
+        self.standby_direct = f"127.0.0.1:{standby.port}"
+
+    @property
+    def address(self) -> str:
+        """The failover address list clients should dial
+        (``'primary:p,standby:p'`` - the proxied primary when armed)."""
+        return self._client_address
+
+    def wait_promoted(self, timeout_s: float = 20.0) -> None:
+        """Block until the standby promoted; ``self.dispatcher`` then
+        points at it."""
+        if not self.standby.standby_promoted.wait(timeout_s):
+            raise PetastormTpuError(
+                f"standby did not promote within {timeout_s:.0f}s")
+        self.dispatcher = self.standby
+
+    def failover(self, timeout_s: float = 20.0) -> None:
+        """SIGKILL-equivalent primary death (listener + every connection
+        drops, memory gone from the fleet's point of view), then wait for
+        the standby to notice and promote."""
+        self.primary.stop()
+        self.primary.join()
+        self.wait_promoted(timeout_s)
+
+    def partition_primary(self) -> None:
+        """Partition the primary away from standby AND peers (both proxy
+        links): the standby promotes while the deposed primary stays alive
+        on the far side of the split."""
+        if self.sync_proxy is None or self.peer_proxy is None:
+            raise PetastormTpuError(
+                "partition_primary needs ha_fleet(partitionable=True)")
+        self.sync_proxy.partition()
+        self.peer_proxy.partition()
+
+    def heal_primary(self) -> None:
+        """Heal the partition: the deposed primary is reachable again -
+        and must now be REFUSED by its own fleet (epoch fencing)."""
+        self.sync_proxy.heal()
+        self.peer_proxy.heal()
+
+
+@contextlib.contextmanager
+def ha_fleet(n_workers: int = 2, capacity: int = 2,
+             partitionable: bool = False,
+             dispatcher_kwargs: Optional[dict] = None,
+             worker_reconnect_attempts: int = 240,
+             worker_reconnect_backoff_s: float = 0.25):
+    """A primary + hot-standby dispatcher pair with rejoining workers for
+    ``disruption='failover'`` cells; yields an :class:`HAFleetHandle`.
+
+    Workers (and the yielded client ``address``) dial the failover list
+    ``'primary,standby'``; the standby refuses their hellos until it
+    promotes, so the rotation naturally parks everyone on the primary and
+    rolls them over at failover.  ``partitionable=True`` interposes
+    :class:`~petastorm_tpu.test_util.netchaos.ChaosProxy` pairs on both
+    the standby's sync link and the peers' primary link, so
+    :meth:`HAFleetHandle.partition_primary` can split the brain without
+    killing the primary.  The manager waits for the standby's first
+    successful sync before yielding - promotion is armed from the start.
+    """
+    import threading
+
+    from petastorm_tpu.service.dispatcher import Dispatcher
+    from petastorm_tpu.service.worker import ServiceWorker
+    from petastorm_tpu.telemetry import Telemetry
+
+    kwargs = dict(dispatcher_kwargs or {})
+    kwargs.setdefault("telemetry", Telemetry())
+    kwargs.setdefault("heartbeat_timeout_s", 5.0)
+    primary = Dispatcher(**kwargs).start()
+    primary_direct = f"127.0.0.1:{primary.port}"
+    sync_proxy = peer_proxy = None
+    primary_for_standby = primary_for_peers = primary_direct
+    if partitionable:
+        from petastorm_tpu.test_util.netchaos import ChaosProxy
+
+        sync_proxy = ChaosProxy(primary_direct).start()
+        peer_proxy = ChaosProxy(primary_direct).start()
+        primary_for_standby = sync_proxy.address
+        primary_for_peers = peer_proxy.address
+    standby = Dispatcher(telemetry=Telemetry(),
+                         heartbeat_timeout_s=kwargs["heartbeat_timeout_s"],
+                         standby_of=primary_for_standby).start()
+    peer_list = f"{primary_for_peers},127.0.0.1:{standby.port}"
+    workers = [ServiceWorker(
+        peer_list, capacity=capacity, name=f"haw{i}",
+        heartbeat_interval_s=0.5,
+        reconnect_attempts=worker_reconnect_attempts,
+        reconnect_backoff_s=worker_reconnect_backoff_s)
+        for i in range(n_workers)]
+    for w in workers:
+        threading.Thread(target=w.run, daemon=True).start()
+    handle = HAFleetHandle(primary, standby, workers, peer_list,
+                           sync_proxy=sync_proxy, peer_proxy=peer_proxy)
+    try:
+        deadline = time.monotonic() + 20.0
+        while (len(primary.stats()["workers"]) < n_workers
+               or standby.stats()["standby"]["primary_epoch"] < 1):
+            if time.monotonic() >= deadline:
+                raise PetastormTpuError(
+                    f"ha fleet: {n_workers} worker(s) + a synced standby"
+                    " did not come up")
+            time.sleep(0.05)
+        yield handle
+    finally:
+        for w in workers:
+            w.stop()
+        for proxy in (sync_proxy, peer_proxy):
+            if proxy is not None:
+                proxy.stop()
+        for disp in (standby, primary):
+            disp.stop()
+            disp.join()
 
 
 @contextlib.contextmanager

@@ -222,6 +222,85 @@ def test_netsplit_heal_cell_bit_identical(matrix_dataset, baseline):
     assert stats["connections"] >= 2, stats
 
 
+# -- failover cells (ISSUE 17: hot-standby HA must not move a byte) -----------
+
+def test_failover_cell_bit_identical(matrix_dataset, baseline):
+    """Hot-standby failover as a matrix cell: the primary dispatcher is
+    killed mid-epoch with in-flight work everywhere, the warm standby
+    promotes off its replicated journal mirror, peers roll over through
+    the failover address list - and the delivered stream is bit-identical
+    to the uninterrupted baseline."""
+    from petastorm_tpu.test_util.matrix import ha_fleet
+
+    cell = MatrixCell(transport="service", disruption="failover")
+    with ha_fleet(n_workers=2) as fleet:
+        result = run_cell(matrix_dataset, SEED, cell, num_epochs=EPOCHS,
+                          service_address=fleet.address,
+                          disruptor=fleet.failover)
+        _assert_matches(result, baseline, cell.label())
+        # the promoted standby is now the live dispatcher: a real
+        # failover (counted once), a bumped epoch, and the warm session
+        stats = fleet.dispatcher.stats()
+        assert stats["counters"].get("service.failovers", 0) == 1, stats
+        assert stats["epoch"] >= 2, stats
+        assert stats["standby"]["promoted"], stats
+
+
+def test_failover_partition_cell_fences_split_brain(matrix_dataset,
+                                                    baseline):
+    """Split-brain fencing as a matrix cell: the primary is PARTITIONED
+    away (still alive!) mid-epoch, the standby promotes with a higher
+    epoch, and the read completes bit-identically - no item is delivered
+    twice even though two dispatchers believe they own the fleet.  After
+    the heal, the deposed primary is refused by its own workers (stale
+    epoch), so it can never double-assign."""
+    from petastorm_tpu.errors import PetastormTpuError
+    from petastorm_tpu.service.protocol import (connect_frames,
+                                                parse_address)
+    from petastorm_tpu.test_util.matrix import ha_fleet
+
+    cell = MatrixCell(transport="service", disruption="failover")
+    with ha_fleet(n_workers=2, partitionable=True) as fleet:
+
+        def split_brain():
+            fleet.partition_primary()
+            fleet.wait_promoted()
+
+        result = run_cell(matrix_dataset, SEED, cell, num_epochs=EPOCHS,
+                          service_address=fleet.address,
+                          disruptor=split_brain)
+        # bit-identical == exactly-once: equal row multisets + crc leave
+        # no room for a double delivery from the deposed side
+        _assert_matches(result, baseline, cell.label())
+        assert fleet.peer_proxy.stats["partition_refusals"] >= 1, \
+            dict(fleet.peer_proxy.stats)
+        assert fleet.dispatcher.stats()["epoch"] >= 2
+
+        # fencing: a worker that served the promoted standby refuses the
+        # healed (still alive, still epoch-1) primary outright
+        fleet.heal_primary()
+        worker = fleet.workers[0]
+        deadline = 20.0
+        import time as _time
+        end = _time.monotonic() + deadline
+        while worker._dispatcher_epoch < 2 and _time.monotonic() < end:
+            _time.sleep(0.05)
+        assert worker._dispatcher_epoch >= 2, worker._dispatcher_epoch
+        conn = connect_frames(parse_address(fleet.primary_direct))
+        try:
+            with pytest.raises(PetastormTpuError, match="stale epoch"):
+                worker._register(conn)
+        finally:
+            conn.close()
+        refusals = worker.telemetry.snapshot()["counters"].get(
+            "service.stale_epoch_refusals", 0)
+        assert refusals >= 1
+        # the deposed primary never promoted anything and never counted
+        # a failover: one side of the split stayed fenced out
+        assert fleet.primary.stats()["counters"].get(
+            "service.failovers", 0) == 0
+
+
 # -- elastic-fleet cells (ISSUE 14: autoscaling must not move a byte) ---------
 
 def test_elastic_fleet_cell_bit_identical(matrix_dataset, baseline):

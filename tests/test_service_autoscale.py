@@ -759,3 +759,40 @@ def test_worker_graceful_retire_finishes_inflight(dispatcher):
     ex.join()
     thread.join(timeout=5.0)
     assert not thread.is_alive()
+
+
+# -- supervision through a dispatcher failover (ISSUE 17 satellite) -----------
+
+def test_supervisor_probes_through_failover_address_list():
+    """An address-mode supervisor given the failover list keeps judging
+    the LIVE fleet across a primary kill: pre-failover it probes the
+    primary and SKIPS the unpromoted standby; post-failover it rotates to
+    the promoted standby instead of reporting a dead fleet."""
+    from petastorm_tpu.test_util.matrix import ha_fleet
+
+    with ha_fleet(n_workers=1, capacity=1) as fleet:
+        sup = AutoscaleSupervisor(
+            fleet.address, spawner=FakeSpawner(),
+            policy=AutoscalePolicy(min_workers=0))
+        # a healthy primary answers; the probe stays parked on it
+        assert sup.signal() is not None
+        assert sup._probe_index == 0
+        # an unpromoted standby is NOT a probe target: with only the
+        # standby to ask, the probe fails rather than supervising a
+        # mirror that assigns nothing
+        lone = AutoscaleSupervisor(
+            fleet.standby_direct, spawner=FakeSpawner(),
+            policy=AutoscalePolicy(min_workers=0))
+        assert lone.signal() is None
+        assert lone.summary()["counters"]["probe_failures"] == 1
+        # kill the primary mid-supervision: the next probe rotates to the
+        # promoted standby and supervision continues uninterrupted
+        fleet.failover()
+        sig = sup.signal()
+        assert sig is not None, "supervisor lost the fleet at failover"
+        assert sup._probe_index == 1
+        # the worker rejoins the promoted standby; supervision sees it
+        _wait_for(lambda: (sup.signal() or {}).get("worker_capacity",
+                                                   0) >= 1,
+                  what="rejoined capacity visible through the probe")
+        assert sup.summary()["counters"].get("probe_failures", 0) == 0

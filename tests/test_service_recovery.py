@@ -596,3 +596,356 @@ def test_worker_rejoin_hello_reports_held_state():
     fresh._register(_FakeConn())
     assert sent["resume"] is False
     assert sent["assignments"] == []
+
+
+# -- hot-standby dispatcher HA (ISSUE 17) --------------------------------------
+
+def test_journal_load_survives_foreign_and_corrupt_records(tmp_path):
+    """Journal fuzz (ISSUE 17 satellite): decodable-but-foreign records
+    (a future journal version's kinds, wrong field types, a bogus epoch
+    stamp) apply as no-ops, and an UNDECODABLE record stops replay at the
+    good prefix - never a crash, never a poisoned session."""
+    path = str(tmp_path / "fuzz.journal")
+    j = ServiceJournal(path)
+    j.open()
+    j.append_hello("c1", {"factory": b"fac", "hostname": "h",
+                          "shm_ok": False, "max_requeue": 2, "codecs": []})
+    j.append_enqueue("c1", {"o": 0, "a": 0, "blob": b"item0"})
+    # interleaved foreign-version records: unknown kind, enq with a
+    # non-int ordinal, a hello for a non-string client, a non-int epoch
+    j.ingest({"r": "v99-frobnicate", "client": "c1", "payload": b"x"})
+    j.ingest({"r": "enq", "client": "c1", "item": {"o": "NaN"}})
+    j.ingest({"r": "hello", "client": 7})
+    j.ingest({"r": "epoch", "epoch": "seven"})
+    j.append_enqueue("c1", {"o": 1, "a": 0, "blob": b"item1"})
+    j.close()
+    j2 = ServiceJournal(path)
+    sessions = j2.load()
+    assert sorted(sessions["c1"].items) == [0, 1]
+    assert j2.epoch == 0  # the bogus stamp never applied
+    # corrupt length prefix: an absurd length stops replay cleanly
+    with open(path, "ab") as fh:
+        fh.write(struct.pack("!I", 1 << 30) + b"junk")
+    assert sorted(ServiceJournal(path).load()["c1"].items) == [0, 1]
+    # undecodable body under a VALID length prefix: same degrade
+    with open(path, "ab") as fh:
+        fh.write(struct.pack("!I", 5) + b"\xff\xfe\xfd\xfc\xfb")
+    j3 = ServiceJournal(path)
+    sessions3 = j3.load()
+    assert sorted(sessions3["c1"].items) == [0, 1]
+    # and the journal stays appendable after the fuzzed load
+    j3.open()
+    j3.append_ack("c1", [0])
+    j3.close()
+    assert sorted(ServiceJournal(path).load()["c1"].items) == [1]
+
+
+def test_journal_fsync_knob_meters(tmp_path):
+    """--journal-fsync (ISSUE 17 satellite): off by default (no fsyncs),
+    on it fsyncs per append and meters service.journal_fsyncs."""
+    hello = {"factory": b"f", "hostname": "h", "shm_ok": False,
+             "max_requeue": 2, "codecs": []}
+    j_off = ServiceJournal(str(tmp_path / "off.journal"))
+    j_off.open()
+    j_off.append_hello("c1", hello)
+    j_off.close()
+    assert j_off.fsyncs == 0
+    tele = Telemetry()
+    j_on = ServiceJournal(str(tmp_path / "on.journal"), fsync=True,
+                          fsync_counter=tele.counter(
+                              "service.journal_fsyncs"))
+    j_on.open()
+    j_on.append_hello("c1", hello)
+    j_on.append_enqueue("c1", {"o": 0, "a": 0, "blob": b"i"})
+    j_on.close()
+    assert j_on.fsyncs == 2
+    assert tele.snapshot()["counters"]["service.journal_fsyncs"] == 2
+    # end-to-end: a dispatcher with the knob meters its own appends
+    dtele = Telemetry()
+    disp = Dispatcher(telemetry=dtele, heartbeat_timeout_s=5.0,
+                      journal_path=str(tmp_path / "svc.journal"),
+                      journal_fsync=True).start()
+    ex = ServiceExecutor(f"127.0.0.1:{disp.port}", telemetry=Telemetry(),
+                         window=4, reconnect_policy=FAST_RECONNECT)
+    try:
+        ex.start(EchoFactory())
+        ex.put(VentilatedItem(0, "x"))
+        _wait_for(lambda: dtele.snapshot()["counters"].get(
+            "service.journal_fsyncs", 0) >= 2,
+            what="dispatcher journal fsyncs")
+    finally:
+        ex.stop()
+        ex.join()
+        disp.stop()
+        disp.join()
+
+
+def test_standby_degrades_once_on_undecodable_sync_stream(caplog):
+    """ISSUE 17 satellite: a journal_sync stream that turns to garbage
+    mid-flight (valid frame envelope, undecodable body) degrades the
+    standby to a cold re-snapshot with ONE warning - never a crash,
+    never a silently-desynced warm mirror."""
+    subs = []
+    stop = threading.Event()
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    lsock.settimeout(0.5)
+    port = lsock.getsockname()[1]
+
+    def serve():  # a fake primary speaking just enough of the sync wire
+        while not stop.is_set():
+            try:
+                sock, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            fs = FrameSocket(sock)
+            try:
+                hello = fs.recv(timeout=5.0)
+                if not isinstance(hello, dict) \
+                        or hello.get("t") != "standby_hello":
+                    fs.close()
+                    continue
+                subs.append(time.monotonic())
+                fs.send({"t": "standby_ok", "epoch": 3, "boot": "fake"})
+                fs.send({"t": "journal_sync", "k": "snap", "seq": 1,
+                         "recs": [{"r": "hello", "client": "c1",
+                                   "factory": b"f", "hostname": "h",
+                                   "shm_ok": False, "max_requeue": 2,
+                                   "codecs": []}]})
+                if len(subs) == 1:
+                    # the stream turns to garbage: a well-framed but
+                    # undecodable body
+                    payload = bytes([wire.KIND_CTRL]) + b"\xff\xfe\xfd\xfb"
+                    sock.sendall(struct.pack("!I", len(payload)) + payload)
+                    time.sleep(0.3)
+                    fs.close()
+                else:
+                    fs.send({"t": "journal_sync", "k": "snap_end",
+                             "seq": 1})
+                    while not stop.is_set():
+                        fs.send({"t": "journal_sync", "k": "ping",
+                                 "seq": 1})
+                        time.sleep(0.2)
+            except (OSError, FrameClosedError):
+                pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    standby = None
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="petastorm_tpu.service.dispatcher"):
+            standby = Dispatcher(telemetry=Telemetry(),
+                                 standby_of=f"127.0.0.1:{port}").start()
+            _wait_for(lambda: len(subs) >= 2,
+                      what="standby re-subscription after garbage")
+            _wait_for(lambda: standby.stats()["standby"]
+                      ["synced_records"] >= 1,
+                      what="clean re-snapshot")
+        st = standby.stats()["standby"]
+        assert st["primary_epoch"] == 3, st
+        assert not st["promoted"], st
+        assert not standby.standby_promoted.is_set()
+        degrades = [r for r in caplog.records
+                    if "re-snapshotting" in r.getMessage()]
+        assert len(degrades) == 1, [r.getMessage() for r in degrades]
+    finally:
+        stop.set()
+        lsock.close()
+        if standby is not None:
+            standby.stop()
+            standby.join()
+
+
+def test_standby_survives_mid_stream_sync_cut_then_promotes():
+    """ISSUE 17 satellite: a mid-frame cut on the journal_sync link kills
+    the session cleanly (FrameClosedError, not garbage), the standby
+    re-snapshots through the healed link, and a later primary death still
+    promotes it warm."""
+    tele = Telemetry()
+    primary = Dispatcher(telemetry=Telemetry(),
+                         heartbeat_timeout_s=5.0).start()
+    proxy = ChaosProxy(f"127.0.0.1:{primary.port}",
+                       NetChaosSpec(cut_frames=(2,),
+                                    direction="s2c")).start()
+    standby = Dispatcher(telemetry=tele, heartbeat_timeout_s=5.0,
+                         standby_of=proxy.address).start()
+    try:
+        _wait_for(lambda: proxy.stats["cuts"] >= 1,
+                  what="mid-frame sync cut")
+        _wait_for(lambda: standby.stats()["standby"]["synced_records"] >= 1
+                  and standby.stats()["standby"]["lag_items"] == 0,
+                  what="re-snapshot after the cut")
+        assert not standby.standby_promoted.is_set()
+        primary.stop()
+        primary.join()
+        _wait_for(lambda: standby.standby_promoted.is_set(), timeout=20.0,
+                  what="promotion after primary death")
+        assert standby.stats()["epoch"] >= 2
+        assert tele.snapshot()["counters"].get("service.failovers", 0) == 1
+    finally:
+        proxy.stop()
+        standby.stop()
+        standby.join()
+        primary.stop()
+        primary.join()
+
+
+def test_epoch_fencing_refuses_deposed_dispatcher(tmp_path):
+    """Split-brain fencing units: a worker and a client that have seen
+    epoch N refuse a dispatcher advertising epoch < N (the deposed
+    primary that came back), metering service.stale_epoch_refusals."""
+    from petastorm_tpu.service.protocol import connect_frames
+
+    # d_new restores epoch 5 from a pre-stamped journal; d_old is a plain
+    # epoch-1 dispatcher playing the deposed primary
+    stamped = str(tmp_path / "stamped.journal")
+    j = ServiceJournal(stamped)
+    j.open()
+    j.set_epoch(5)
+    j.close()
+    d_new = Dispatcher(telemetry=Telemetry(), heartbeat_timeout_s=5.0,
+                       journal_path=stamped).start()
+    d_old = Dispatcher(telemetry=Telemetry(),
+                       heartbeat_timeout_s=5.0).start()
+    ex = None
+    try:
+        assert d_new.stats()["epoch"] == 5
+        assert d_old.stats()["epoch"] == 1
+        # worker side
+        worker = ServiceWorker(f"127.0.0.1:{d_new.port}", capacity=1)
+        conn = connect_frames(("127.0.0.1", d_new.port))
+        worker._register(conn)
+        conn.close()
+        assert worker._dispatcher_epoch == 5
+        conn = connect_frames(("127.0.0.1", d_old.port))
+        try:
+            with pytest.raises(PetastormTpuError, match="stale epoch"):
+                worker._register(conn)
+        finally:
+            conn.close()
+        assert worker.telemetry.snapshot()["counters"][
+            "service.stale_epoch_refusals"] == 1
+        # client side: learns epoch 5, then its only failover target is
+        # the deposed epoch-1 dispatcher - every rotation refuses it and
+        # the reconnect budget expires rather than resyncing into it
+        ctele = Telemetry()
+        ex = ServiceExecutor(
+            f"127.0.0.1:{d_new.port},127.0.0.1:{d_old.port}",
+            telemetry=ctele, window=4, reconnect_policy=FAST_RECONNECT)
+        ex.start(EchoFactory())
+        assert ex.diagnostics["dispatcher_epoch"] == 5
+        d_new.stop()
+        d_new.join()
+        ex.put(VentilatedItem(0, "x"))
+        import queue as _queue
+        with pytest.raises(PetastormTpuError, match="epoch cannot"
+                           "|session lost|dispatcher"):
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                try:  # surfaced once the fenced reconnect budget dies
+                    ex.get(timeout=1.0)
+                except _queue.Empty:
+                    continue
+        assert ctele.snapshot()["counters"].get(
+            "service.stale_epoch_refusals", 0) >= 1
+    finally:
+        if ex is not None:
+            ex.stop()
+            ex.join()
+        d_old.stop()
+        d_old.join()
+        d_new.stop()
+        d_new.join()
+
+
+def test_hot_standby_warm_failover_exactly_once():
+    """End-to-end failover off the replicated journal: the standby has
+    lag 0 before the kill, promotes warm (journal-restored items), the
+    client's resync skips known items, and nothing executes twice."""
+    from petastorm_tpu.test_util.matrix import ha_fleet
+
+    tag = "ha-warm"
+    _EXECUTIONS.pop(tag, None)
+    with ha_fleet(n_workers=1, capacity=2) as fleet:
+        # a standby refuses work hellos until promoted (peers rotate)
+        from petastorm_tpu.service.protocol import (PROTOCOL_VERSION,
+                                                    connect_frames)
+        probe = connect_frames(("127.0.0.1", fleet.standby.port))
+        probe.send({"t": "worker_hello", "protocol": PROTOCOL_VERSION,
+                    "token": None, "capacity": 1, "resume": False,
+                    "assignments": [], "jobs": []})
+        refusal = probe.recv(timeout=5.0)
+        probe.close()
+        assert refusal["t"] == "error" and "standby" in refusal["error"]
+        # rides out the ~1.5s promotion window (3 missed sync probes)
+        patient = RetryPolicy(max_attempts=20, initial_backoff_s=0.1,
+                              backoff_multiplier=1.5, max_backoff_s=0.5)
+        ex = ServiceExecutor(fleet.address, telemetry=Telemetry(),
+                             window=8, reconnect_policy=patient)
+        ex.start(CountingSlowFactory(sleep_s=0.3, tag=tag))
+        try:
+            for i in range(6):
+                ex.put(VentilatedItem(i, f"p{i}"))
+            # every enqueue must be MIRRORED before the kill: epoch +
+            # hello + 6 enqueues and zero lag
+            _wait_for(lambda: fleet.standby.stats()["standby"]
+                      ["synced_records"] >= 8
+                      and fleet.standby.stats()["standby"]
+                      ["lag_items"] == 0,
+                      what="standby caught up pre-kill")
+            fleet.failover()
+            got = sorted(ex.get(timeout=30.0) for _ in range(6))
+            assert got == [("done", i) for i in range(6)]
+            stats = fleet.dispatcher.stats()
+            assert stats["counters"].get("service.failovers", 0) == 1
+            assert stats["counters"].get(
+                "service.journal_items_restored", 0) >= 1, stats["counters"]
+            assert stats["epoch"] >= 2
+            # exactly-once through the promotion: worker rejoin claims
+            # cover the mirrored pending items
+            assert sorted(_EXECUTIONS.get(tag, [])) == list(range(6))
+        finally:
+            ex.stop()
+            ex.join()
+
+
+def test_drain_handshake_is_structural():
+    """ISSUE 17 satellite: graceful retirement ends with the drained?/
+    drain_ok handshake - the worker says bye only after the dispatcher
+    structurally confirms zero recorded in-flight, and any straggler
+    assignment voids a stale confirmation."""
+    worker = ServiceWorker("127.0.0.1:1", capacity=1)
+    sent = []
+
+    class _FakeConn:
+        def send(self, msg):
+            sent.append(msg)
+
+        def close(self):
+            pass
+
+    worker._conn = _FakeConn()
+    worker._connected.set()
+    now = time.monotonic()
+    # retire not acked yet: no probe, no bye
+    assert worker._check_drained(now) is False
+    assert sent == []
+    worker._retire_acked.set()
+    # locally empty -> probe the dispatcher, do NOT bye yet
+    assert worker._check_drained(now) is False
+    assert sent[-1] == {"t": "drained?"}
+    # a straggler assignment lands: even a granted confirmation is void
+    worker._drain_confirmed.set()
+    worker._held[("cid", 1)] = 0
+    assert worker._check_drained(now) is False
+    assert not worker._drain_confirmed.is_set()
+    worker._held.clear()
+    # probe again; the dispatcher's structural drain_ok closes the loop
+    assert worker._check_drained(now) is False
+    worker._drain_confirmed.set()
+    assert worker._check_drained(now) is True
+    assert sent[-1] == {"t": "bye"}
+    assert worker.retired_gracefully
