@@ -539,6 +539,139 @@ PY
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 200 python "$SVC_SMOKE"
 rm -f "$SVC_SMOKE"
 
+echo "== trace smoke (distributed tracing: merged cross-process trace + fleet Prometheus) =="
+# the ISSUE 19 observability contract, end to end with REAL subprocesses: a
+# CLI dispatcher (metrics port armed) + two CLI workers serve one traced
+# client (trace_items=1); one worker is SIGKILLed while holding in-flight
+# work.  The client's MERGED Chrome trace must contain spans from >= 3
+# distinct processes (client + dispatcher + worker tracks), the forced
+# requeue must be visible as its own annotated span under the same trace
+# id, the hop decomposition must sum (within tolerance) to the observed
+# end-to-end latency, and the dispatcher's Prometheus scrape must carry
+# per-worker-labeled fleet families (docs/operations.md "Distributed
+# tracing & fleet view").
+TRACE_SMOKE="$(mktemp /tmp/petastorm_tpu_trace_smoke_XXXXXX.py)"
+cat > "$TRACE_SMOKE" <<'PY'
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service.protocol import connect_frames, parse_address
+from petastorm_tpu.telemetry import Telemetry
+
+def stats(addr):
+    conn = connect_frames(parse_address(addr), timeout=5.0)
+    try:
+        conn.send({"t": "stats?"})
+        return conn.recv(timeout=5.0)["stats"]
+    finally:
+        conn.close()
+
+if __name__ == "__main__":
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_trace_smoke_")
+    schema = Schema("TraceSmoke", [Field("x", np.int64)])
+    write_dataset(tmp, schema, [{"x": i} for i in range(400)],
+                  row_group_size_rows=10)
+    procs = []
+    try:
+        disp = subprocess.Popen(
+            [sys.executable, "-m", "petastorm_tpu.service.cli", "dispatcher",
+             "--host", "127.0.0.1", "--port", "0", "--metrics-port", "0",
+             "--heartbeat-timeout", "5"],
+            stdout=subprocess.PIPE, text=True)
+        procs.append(disp)
+        addr = re.search(r"listening on (\S+)",
+                         disp.stdout.readline()).group(1)
+        metrics_url = re.search(r"metrics: (\S+)",
+                                disp.stdout.readline()).group(1)
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "petastorm_tpu.service.cli", "worker",
+                 "--address", addr, "--capacity", "1", "--name", f"tw{i}"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.monotonic() + 30
+        while len(stats(addr)["workers"]) < 2:
+            assert time.monotonic() < deadline, "workers never registered"
+            time.sleep(0.1)
+        # per-worker-labeled fleet families, live before the kill
+        scrape = urllib.request.urlopen(metrics_url, timeout=10).read() \
+            .decode()
+        for w in ("tw0", "tw1"):
+            assert f'petastorm_tpu_fleet_worker_up{{worker="{w}"}} 1' \
+                in scrape, scrape[:2000]
+        tele = Telemetry()
+        rows, killed = [], threading.Event()
+
+        def kill_one_mid_item():
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if any(w.get("inflight", 0) > 0
+                       for w in stats(addr)["workers"].values()):
+                    procs[1].send_signal(signal.SIGKILL)  # tw0 mid-item
+                    killed.set()
+                    return
+                time.sleep(0.05)
+
+        killer = threading.Thread(target=kill_one_mid_item, daemon=True)
+        killer.start()
+        with make_batch_reader(tmp, service_address=addr,
+                               shuffle_row_groups=False, telemetry=tele,
+                               trace_items=1) as reader:
+            for b in reader.iter_batches():
+                rows.extend(b.columns["x"])
+        killer.join(timeout=60)
+        assert killed.is_set(), "no worker ever held in-flight work"
+        assert sorted(rows) == list(range(400)), len(rows)
+        trace = tele.trace.chrome_trace()
+        spans = [e for e in trace["traceEvents"]
+                 if e.get("cat") == "service.trace" and e.get("ph") == "X"]
+        pids = {e["pid"] for e in spans}
+        assert len(pids) >= 3, f"want client+dispatcher+worker: {pids}"
+        requeues = [e for e in spans if e["name"] == "dispatch.requeue"]
+        assert requeues, "forced requeue must surface in the merged trace"
+        rq_tid = requeues[0]["args"]["trace_id"]
+        attempts = {e["args"].get("attempt") for e in spans
+                    if e["args"].get("trace_id") == rq_tid
+                    and "attempt" in e["args"]}
+        assert len(attempts) >= 2, attempts  # both attempts, one trace id
+        # hop decomposition telescopes to the end-to-end latency
+        hists = tele.snapshot()["histograms"]
+        hop = {n[len("service.hop."):]: h["sum"] for n, h in hists.items()
+               if n.startswith("service.hop.")}
+        parts = ("client_serialize", "dispatcher_queue", "relay",
+                 "worker_queue", "worker_exec", "return_relay",
+                 "client_deserialize")
+        assert set(parts) <= set(hop), sorted(hop)
+        decomposed = sum(hop[p] for p in parts)
+        assert abs(decomposed - hop["total"]) <= 0.05 * hop["total"], \
+            (decomposed, hop["total"])
+        requeued = stats(addr)["counters"].get("service.requeued_items", 0)
+        assert requeued >= 1
+        print("trace smoke OK (merged trace spans"
+              f" {len(pids)} processes, requeue visible under one trace"
+              f" id, hop decomposition {decomposed:.3f}s ~="
+              f" {hop['total']:.3f}s end-to-end,"
+              f" {int(requeued)} item(s) requeued,"
+              " per-worker Prometheus families labeled)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+PY
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" timeout -k 10 200 python "$TRACE_SMOKE"
+rm -f "$TRACE_SMOKE"
+
 echo "== dispatcher-kill smoke (SIGKILL the dispatcher mid-epoch, restart, both clients exact) =="
 # the ISSUE 13 crash-recovery contract, end to end with REAL subprocesses:
 # a CLI dispatcher serving two trainer clients and two rejoin-armed CLI

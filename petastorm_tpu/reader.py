@@ -111,6 +111,7 @@ def make_reader(dataset_url: str,
                 service_address=None,
                 service_weight: Optional[float] = None,
                 service_priority: Optional[int] = None,
+                trace_items=None,
                 chaos=None) -> "Reader":
     """Row-oriented reader for petastorm_tpu-created datasets (codec-decoded rows).
 
@@ -303,6 +304,16 @@ def make_reader(dataset_url: str,
     QoS").  Defaults 1.0 / 0 (or ``$PETASTORM_TPU_SERVICE_WEIGHT`` /
     ``$PETASTORM_TPU_SERVICE_PRIORITY``); require ``service_address``.
 
+    ``trace_items``: per-item distributed tracing on the service plane
+    (default off; ``True`` = 1-in-16 sampling, int N = 1-in-N, env
+    ``$PETASTORM_TPU_TRACE_ITEMS``).  Sampled items carry a trace context
+    through every hop; the merged cross-process timeline lands in this
+    reader's trace buffer (``Reader.telemetry.export_chrome_trace()`` ->
+    one Perfetto file spanning client/dispatcher/workers) and feeds the
+    ``service.hop.*`` latency-decomposition histograms.  Requires
+    ``service_address`` (docs/operations.md "Distributed tracing & fleet
+    view").
+
     ``chaos``: deterministic fault injection for tests/benchmarks
     (``petastorm_tpu.test_util.chaos.ChaosSpec``); never set in production.
     """
@@ -331,7 +342,8 @@ def make_reader(dataset_url: str,
                              autotune=autotune,
                              service_address=service_address,
                              service_weight=service_weight,
-                             service_priority=service_priority)
+                             service_priority=service_priority,
+                             trace_items=trace_items)
 
 
 def elastic_resume(states: Sequence[dict]) -> dict:
@@ -400,6 +412,7 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                       service_address=None,
                       service_weight: Optional[float] = None,
                       service_priority: Optional[int] = None,
+                      trace_items=None,
                       chaos=None) -> "Reader":
     """Columnar batch reader for arbitrary parquet stores (schema inferred when no
     petastorm_tpu metadata exists).
@@ -409,7 +422,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
     ``on_error``/``item_deadline_s``/``hedge_after_s``/``stall_warn_s``/
     ``stall_abort_s``/``metrics_port``/``flight_record_path``/
     ``sample_interval_s``/``autotune``/``service_address``/
-    ``service_weight``/``service_priority``/``chaos``: see ``make_reader``.
+    ``service_weight``/``service_priority``/``trace_items``/``chaos``: see
+    ``make_reader``.
     """
     return _make_reader_impl(dataset_url_or_urls, schema_fields, reader_pool_type,
                              workers_count, results_queue_size, shuffle_row_groups,
@@ -436,7 +450,8 @@ def make_batch_reader(dataset_url_or_urls: Union[str, Sequence[str]],
                              autotune=autotune,
                              service_address=service_address,
                              service_weight=service_weight,
-                             service_priority=service_priority)
+                             service_priority=service_priority,
+                             trace_items=trace_items)
 
 
 def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_count,
@@ -464,7 +479,8 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                       autotune=None,
                       service_address=None,
                       service_weight: Optional[float] = None,
-                      service_priority: Optional[int] = None) -> "Reader":
+                      service_priority: Optional[int] = None,
+                      trace_items=None) -> "Reader":
     from petastorm_tpu.autotune import resolve_autotune
     from petastorm_tpu.seeding import resolve_deterministic
 
@@ -523,6 +539,12 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             "service_weight/service_priority are multi-tenant QoS knobs of"
             " the ingest service and need service_address (a local pool"
             " serves exactly one consumer - there is nothing to share)")
+    elif trace_items:
+        raise PetastormTpuError(
+            "trace_items arms DISTRIBUTED per-item tracing across the"
+            " ingest service's processes and needs service_address; local"
+            " pools already trace every stage span into the telemetry"
+            " trace buffer")
     if not flight_record_path:
         flight_record_path = (
             os.environ.get("PETASTORM_TPU_FLIGHT_RECORD", "").strip() or None)
@@ -535,7 +557,7 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
                 logger.warning("Ignoring non-integer"
                                " PETASTORM_TPU_METRICS_PORT=%r", raw_port)
     if (flight_record_path or metrics_port is not None
-            or autotune_policy is not None
+            or autotune_policy is not None or trace_items
             or (sample_interval_s is not None and sample_interval_s > 0)) \
             and not telemetry.enabled:
         # the continuous-observability knobs (and the autotune loop, which
@@ -816,7 +838,9 @@ def _make_reader_impl(dataset_url, schema_fields, reader_pool_type, workers_coun
             window=max(4, int(results_queue_size)),
             # multi-tenant QoS identity (weighted fair assignment + strict
             # priority tiers dispatcher-side); None = env/default
-            weight=service_weight, priority=service_priority)
+            weight=service_weight, priority=service_priority,
+            # per-item distributed tracing (default off; None = env)
+            trace_items=trace_items)
     else:
         executor = make_executor(
             reader_pool_type, workers_count, results_queue_size,
@@ -1751,7 +1775,14 @@ class Reader:
             from petastorm_tpu.telemetry.sampler import (dump_flight_record,
                                                          flight_record)
 
-            self._flight_record = flight_record(self.sampler, reason=reason)
+            # service readers enrich the artifact with the dispatcher's
+            # structured fleet-event tail (promotions, requeues, autoscale
+            # decisions) so one JSONL captures the fleet's last ~60s, not
+            # just this client's curves; best-effort side connection
+            fetch = getattr(self._executor, "fetch_fleet_events", None)
+            fleet_events = fetch() if callable(fetch) else None
+            self._flight_record = flight_record(self.sampler, reason=reason,
+                                                fleet_events=fleet_events)
             # the certificate up to the failure: two runs' incident records
             # can be diffed for where their streams diverged
             self._flight_record["stream_digest"] = self._digest.summary()

@@ -489,6 +489,37 @@ class AutoscaleSupervisor:
             except Exception:  # noqa: BLE001 - observer must not kill the loop
                 logger.warning("on_event observer failed", exc_info=True)
 
+    def _notify_dispatcher(self, kind: str, **fields) -> None:
+        """Fold one structured autoscale decision into the dispatcher's
+        fleet event log (one-shot ``event`` frame): the supervisor usually
+        runs on a different host than any failing client, and the event
+        log is how its scale decisions end up in that client's crash
+        artifact.  Best-effort - a dead dispatcher is already the loop's
+        problem, not this notification's."""
+        if self._dispatcher is not None:
+            # direct in-process polling: no wire hop, fold straight in
+            try:
+                self._dispatcher._on_peer_event(
+                    {"kind": f"autoscale.{kind}", **fields}, src="autoscale")
+            except Exception:  # noqa: BLE001 - best-effort notification
+                logger.debug("autoscale event notification failed",
+                             exc_info=True)
+            return
+        addr = self._probe_addresses[self._probe_index
+                                     % len(self._probe_addresses)]
+        try:
+            conn = connect_frames(addr, timeout=5.0)
+            try:
+                conn.send({"t": "event", "kind": f"autoscale.{kind}",
+                           "src": "autoscale", "token": self._auth_token,
+                           **fields})
+                conn.recv(timeout=5.0)
+            finally:
+                conn.close()
+        except (OSError, PetastormTpuError):
+            logger.debug("autoscale event notification failed",
+                         exc_info=True)
+
     def _scale_up(self, sig: Dict[str, Any], reason: str,
                   target: Optional[int] = None) -> None:
         fleet = self.fleet_size(sig)
@@ -527,6 +558,9 @@ class AutoscaleSupervisor:
         self._emit({"event": "scale-up", "spawned": spawned,
                     "fleet": self.fleet_size(None), "reason": reason,
                     "pressure": sig.get("pressure")})
+        self._notify_dispatcher("scale_up", spawned=spawned,
+                                fleet=self.fleet_size(None), reason=reason,
+                                pressure=float(sig.get("pressure") or 0.0))
         self._after_scale_event()
 
     def _scale_down(self, sig: Dict[str, Any], reason: str) -> None:
@@ -565,6 +599,10 @@ class AutoscaleSupervisor:
         self._emit({"event": "scale-down", "worker": name,
                     "graceful": graceful, "fleet": self.fleet_size(None),
                     "reason": reason, "pressure": sig.get("pressure")})
+        self._notify_dispatcher("scale_down", worker=name or "?",
+                                graceful=graceful,
+                                fleet=self.fleet_size(None), reason=reason,
+                                pressure=float(sig.get("pressure") or 0.0))
         self._after_scale_event()
 
     def _hook_payload(self, action: str, sig: Dict[str, Any], fleet: int,

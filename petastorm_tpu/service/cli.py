@@ -5,7 +5,7 @@ Usage::
     petastorm-tpu-service dispatcher --port 7737 [--metrics-port 9100]
     petastorm-tpu-service worker --address HOST:7737 [--capacity 4]
     petastorm-tpu-service autoscale --address HOST:7737 --max-workers 8
-    petastorm-tpu-service stats --address HOST:7737
+    petastorm-tpu-service stats --address HOST:7737 [--watch]
 
 ``autoscale`` runs the closed-loop fleet supervisor
 (:mod:`petastorm_tpu.service.autoscale`): it polls the dispatcher's
@@ -212,8 +212,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="file holding the dispatcher's shared handshake"
                    " secret (overrides $PETASTORM_TPU_SERVICE_TOKEN)")
 
-    s = sub.add_parser("stats", help="print one dispatcher stats snapshot")
+    s = sub.add_parser(
+        "stats", help="print one dispatcher stats snapshot (or a live"
+        " top-style fleet view with --watch)")
     s.add_argument("--address", required=True, metavar="HOST:PORT")
+    s.add_argument("--watch", action="store_true",
+                   help="refresh a top-style fleet view (per-worker load,"
+                   " fleet-merged stage/hop latencies, counter rates, the"
+                   " structured event tail) every --interval seconds"
+                   " instead of printing one JSON snapshot")
+    s.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="--watch refresh cadence (default 2s)")
     s.add_argument("--auth-token-file", default=None, metavar="PATH",
                    help="file holding the dispatcher's shared handshake"
                    " secret (overrides $PETASTORM_TPU_SERVICE_TOKEN)")
@@ -341,23 +350,144 @@ def _run_autoscale(args) -> int:
     return 0
 
 
-def _run_stats(args) -> int:
-    from petastorm_tpu.service.protocol import (connect_frames,
-                                                parse_address,
-                                                resolve_auth_token)
+def _probe(address: str, token, kind: str, timeout: float = 10.0):
+    """One-shot dispatcher probe (``stats?`` / ``fleet?`` / ``events?``):
+    short-lived connection, one reply frame, payload or None."""
+    from petastorm_tpu.service.protocol import connect_frames, parse_address
 
-    conn = connect_frames(parse_address(args.address))
+    conn = connect_frames(parse_address(address))
     try:
-        conn.send({"t": "stats?",
-                   "token": resolve_auth_token(_auth_token(args))})
-        reply = conn.recv(timeout=10.0)
+        conn.send({"t": kind, "token": token})
+        reply = conn.recv(timeout=timeout)
     finally:
         conn.close()
-    if not reply or reply.get("t") != "stats":
-        print(f"unexpected reply: {reply!r}", file=sys.stderr)
-        return 1
-    print(json.dumps(reply["stats"], indent=2, sort_keys=True))
-    return 0
+    if not isinstance(reply, dict):
+        return None
+    return reply.get(kind.rstrip("?"))
+
+
+def render_fleet_frame(stats: Optional[dict], fleet: Optional[dict],
+                       prev_fleet: Optional[dict] = None,
+                       dt_s: float = 0.0, elapsed_s: float = 0.0) -> str:
+    """One ``stats --watch`` frame: the fleet aggregation plane rendered
+    top-style.  Pure function of two probe payloads (plus the previous
+    fleet snapshot for counter rates) so tests render from canned dicts."""
+    lines = []
+    fleet = fleet or {}
+    stats = stats or {}
+    workers = fleet.get("workers", {}) or {}
+    lines.append(
+        f"== petastorm-tpu fleet  t={elapsed_s:6.1f}s"
+        f"  epoch={fleet.get('epoch', '?')}"
+        f"  uptime={fleet.get('uptime_s', 0.0):.0f}s"
+        f"  workers={len(workers)} ==")
+    ha = stats.get("ha") or {}
+    if ha:
+        parts = [f"role={ha.get('role', '?')}",
+                 f"journal_seq={ha.get('journal_seq', 0)}"]
+        for peer, st in sorted((ha.get("standbys") or {}).items()):
+            parts.append(f"standby {peer}:"
+                         f" lag={st.get('standby_lag_items', '?')} item(s)")
+        if ha.get("role") == "standby":
+            parts.append(f"lag={ha.get('standby_lag_items', '?')} item(s)")
+        lines.append("ha: " + "  ".join(parts))
+    if workers:
+        lines.append(f"{'worker':<14} {'busy/cap':>9} {'infl':>5}"
+                     f" {'hb_age':>7} {'exec_p50ms':>11} {'exec_p99ms':>11}")
+        for name in sorted(workers):
+            w = workers[name]
+            hists = w.get("hists", {}) or {}
+            ex = (hists.get("service.hop.worker_exec")
+                  or hists.get("stage.service.encode.latency_s") or {})
+            p50 = (f"{ex['p50_s'] * 1e3:>11.1f}"
+                   if ex.get("p50_s") is not None and ex.get("count")
+                   else f"{'-':>11}")
+            p99 = (f"{ex['p99_s'] * 1e3:>11.1f}"
+                   if ex.get("p99_s") is not None and ex.get("count")
+                   else f"{'-':>11}")
+            drain = "  (draining)" if w.get("draining") else ""
+            lines.append(
+                f"{name:<14} {w.get('busy', 0):>4}/{w.get('capacity', 0):<4}"
+                f" {w.get('inflight', 0):>5}"
+                f" {w.get('heartbeat_age_s', 0.0):>6.1f}s {p50} {p99}"
+                f"{drain}")
+    else:
+        lines.append("workers: (none registered)")
+    merged = fleet.get("merged_hists", {}) or {}
+    hops = {n: h for n, h in merged.items() if n.startswith("service.hop.")}
+    if hops:
+        hop_parts = []
+        for n in sorted(hops):
+            h = hops[n]
+            if not h.get("count"):
+                continue
+            hop_parts.append(f"{n[len('service.hop.'):]}"
+                             f"={h.get('p50_s', 0.0) * 1e3:.1f}"
+                             f"/{h.get('p99_s', 0.0) * 1e3:.1f}ms")
+        if hop_parts:
+            lines.append("fleet hop p50/p99: " + "  ".join(hop_parts))
+    counters = fleet.get("fleet_counters", {}) or {}
+    if prev_fleet and dt_s > 0:
+        prev_counters = prev_fleet.get("fleet_counters", {}) or {}
+        rates = sorted(
+            ((n, (v - prev_counters.get(n, 0.0)) / dt_s)
+             for n, v in counters.items()),
+            key=lambda kv: -kv[1])
+        top = [f"{n}={r:.1f}/s" for n, r in rates[:6] if r > 0]
+        if top:
+            lines.append("fleet rates: " + "  ".join(top))
+    scaling = fleet.get("scaling") or stats.get("scaling") or {}
+    if scaling:
+        lines.append(
+            f"scaling: {scaling.get('recommendation', '?')}"
+            f"  pressure={scaling.get('pressure', 0.0):.2f}"
+            f"  workers={scaling.get('workers', len(workers))}")
+    events = fleet.get("events") or ()
+    if events:
+        lines.append("events (newest last):")
+        for ev in list(events)[-8:]:
+            extra = "  ".join(
+                f"{k}={v}" for k, v in sorted(ev.items())
+                if k not in ("ts", "src", "kind"))
+            lines.append(f"  [{ev.get('src', '?'):>10}]"
+                         f" {ev.get('kind', '?')}"
+                         + (f"  {extra}" if extra else ""))
+    return "\n".join(lines)
+
+
+def _run_stats(args) -> int:
+    from petastorm_tpu.service.protocol import resolve_auth_token
+
+    token = resolve_auth_token(_auth_token(args))
+    if not args.watch:
+        payload = _probe(args.address, token, "stats?")
+        if payload is None:
+            print("unexpected reply from dispatcher", file=sys.stderr)
+            return 1
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    clear = "\x1b[H\x1b[2J" if sys.stdout.isatty() else ""
+    prev_fleet, prev_t = None, None
+    t0 = time.monotonic()
+    try:
+        while True:
+            try:
+                stats = _probe(args.address, token, "stats?")
+                fleet = _probe(args.address, token, "fleet?")
+            except OSError as exc:
+                print(f"{clear}dispatcher unreachable: {exc}", flush=True)
+                time.sleep(args.interval)
+                continue
+            now = time.monotonic()
+            frame = render_fleet_frame(
+                stats, fleet, prev_fleet,
+                dt_s=(now - prev_t) if prev_t is not None else 0.0,
+                elapsed_s=now - t0)
+            print(f"{clear}{frame}" + ("" if clear else "\n"), flush=True)
+            prev_fleet, prev_t = fleet, now
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -107,6 +107,16 @@ class ServiceExecutor(ExecutorBase):
     tier is served only while no higher tier has pending work
     (docs/operations.md "Fleet autoscaling & QoS").
 
+    Tracing: ``trace_items`` (default off; ``True`` = 1-in-16, int N =
+    1-in-N, env ``$PETASTORM_TPU_TRACE_ITEMS``) arms per-item distributed
+    tracing - sampled ordinals carry a trace context through the wire,
+    every hop stamps it, and the returned timeline merges into this
+    process's trace buffer as cross-process spans (one Perfetto file shows
+    the item's whole client -> dispatcher -> worker -> client life,
+    requeues and failover rollovers annotated) plus ``service.hop.*``
+    latency-decomposition histograms
+    (docs/operations.md "Distributed tracing & fleet view").
+
     Determinism note: results arrive in fleet completion order, but every
     outcome carries its ventilation ordinal (work items travel as
     :class:`~petastorm_tpu.service.protocol.WireItem` frames whose ordinal/
@@ -125,7 +135,8 @@ class ServiceExecutor(ExecutorBase):
                  auth_token: Optional[str] = None,
                  allow_pickle_results: Optional[bool] = None,
                  weight: Optional[float] = None,
-                 priority: Optional[int] = None):
+                 priority: Optional[int] = None,
+                 trace_items=None):
         super().__init__(telemetry=telemetry, stop_on_failure=stop_on_failure,
                          max_requeue_attempts=max_requeue_attempts)
         if window < 1:
@@ -146,6 +157,34 @@ class ServiceExecutor(ExecutorBase):
                 f"service client weight must be > 0; got {weight}")
         self.weight = float(weight)
         self.priority = int(priority)
+        # per-item distributed tracing (default OFF): every Nth ventilated
+        # ordinal carries a trace context through the wire; dispatcher and
+        # workers stamp per-hop monotonic timestamps into it and the result
+        # returns the merged timeline, which we map into this process's
+        # clock (handshake offset estimate + per-hop monotonic deltas) and
+        # record as cross-process spans + service.hop.* histograms.
+        # `trace_items=True` samples 1-in-16; an int N samples 1-in-N;
+        # env fallback $PETASTORM_TPU_TRACE_ITEMS.
+        if trace_items is None:
+            env = os.environ.get("PETASTORM_TPU_TRACE_ITEMS", "").strip()
+            trace_items = int(env) if env else 0
+        if isinstance(trace_items, bool):
+            trace_items = 16 if trace_items else 0
+        self._trace_every = max(int(trace_items), 0)
+        self._tracing = (self._trace_every > 0
+                         and getattr(self._telemetry, "enabled", False)
+                         and getattr(self._telemetry, "trace", None)
+                         is not None)
+        self._trace_lock = threading.Lock()
+        #: ordinal -> {"id", "put_ns", "sent_ns"} for armed in-flight items
+        self._traces: Dict[Any, Dict] = {}
+        #: synthetic pid per remote process name (merged-trace tracks)
+        self._trace_pids: Dict[str, int] = {}
+        #: handshake clock-offset estimate: dispatcher perf_counter_ns
+        #: minus ours (error ~ hello RTT/2); remote stamps map through it
+        self._disp_clock_offset_ns = 0
+        #: perf_counter_ns when the connection was last lost (rollover span)
+        self._lost_at_ns: Optional[int] = None
         #: failover list ('a:p' or 'a:p,b:p' - primary then hot standby);
         #: every (re)connect rotates through it starting at the last
         #: address that worked (docs/operations.md "Dispatcher HA")
@@ -271,6 +310,7 @@ class ServiceExecutor(ExecutorBase):
 
         shm = transport_availability()
         conn = connect_frames(self._address)
+        hs_t0 = time.perf_counter_ns()
         conn.send({"t": "client_hello", "protocol": PROTOCOL_VERSION,
                    "client": self.client_id, "factory": self._factory_blob,
                    "hostname": socket.gethostname(),
@@ -280,9 +320,17 @@ class ServiceExecutor(ExecutorBase):
                    "weight": self.weight, "priority": self.priority,
                    "resume": resume, "token": self._auth_token})
         hello = conn.recv(timeout=10.0)
+        hs_t1 = time.perf_counter_ns()
         if not hello or hello.get("t") != "hello_ok":
             conn.close()
             raise OSError(f"dispatcher refused client hello: {hello!r}")
+        clock_ns = hello.get("clock_ns")
+        if isinstance(clock_ns, int):
+            # offset_cd = dispatcher clock - our clock, sampled at the
+            # handshake midpoint; remote trace stamps map into our
+            # monotonic domain as t - offset_cd (dispatcher) or
+            # t + worker_offset - offset_cd (worker)
+            self._disp_clock_offset_ns = clock_ns - (hs_t0 + hs_t1) // 2
         epoch = hello.get("epoch")
         if isinstance(epoch, int):
             if self._dispatcher_epoch is not None \
@@ -347,7 +395,7 @@ class ServiceExecutor(ExecutorBase):
                             " already holds (warm restart)", skipped)
             if items:
                 self._send({"t": "resync",
-                            "items": [WireItem.encode(i) for i in items]})
+                            "items": [self._encode_item(i) for i in items]})
 
     def stop(self) -> None:
         """Stop consuming: best-effort goodbye, close the connection."""
@@ -380,6 +428,182 @@ class ServiceExecutor(ExecutorBase):
                 raise OSError("not connected")
             self._m_bytes_out.add(conn.send(msg))
 
+    def _encode_item(self, item) -> Dict:
+        """Wire-encode one ledger item, re-arming its trace context when the
+        ordinal is registered as traced (a resync after a reconnect must not
+        silently drop tracing mid-item)."""
+        ordinal = getattr(item, "ordinal", None)
+        if self._tracing and ordinal is not None:
+            with self._trace_lock:
+                entry = self._traces.get(ordinal)
+            if entry is not None:
+                return WireItem.encode(item, trace_id=entry["id"])
+        return WireItem.encode(item)
+
+    # -- distributed tracing --------------------------------------------------
+
+    def _trace_pid(self, proc: str) -> int:
+        """Stable synthetic pid for a remote process name (dispatcher or a
+        worker) - the merged Chrome trace renders each as its own named
+        process track."""
+        pid = self._trace_pids.get(proc)
+        if pid is None:
+            pid = 900001 + len(self._trace_pids)
+            self._trace_pids[proc] = pid
+        return pid
+
+    def _finish_trace(self, msg: Dict, tc: Dict, recv_ns: int,
+                      done_ns: int) -> None:
+        """Merge one returned hop timeline into the local trace buffer and
+        record the ``service.hop.*`` latency decomposition.
+
+        Remote stamps are ``[who, name, attempt, t_ns, off_ns]`` where
+        ``t_ns`` is the stamper's own ``perf_counter_ns`` and ``off_ns`` its
+        handshake offset to the DISPATCHER clock (0 for the dispatcher
+        itself).  Mapping into our clock: dispatcher ``t - offset_cd``,
+        worker ``t + off_ns - offset_cd``.  Same-process hop pairs are
+        monotonic deltas (skew-free); only the cross-process segments absorb
+        the ~RTT/2 handshake error - and the seven hops still telescope
+        exactly to the observed end-to-end (c.done - c.put) because every
+        boundary is used once as an end and once as a start.
+        """
+        ordinal = msg.get("ordinal")
+        with self._trace_lock:
+            entry = self._traces.pop(ordinal, None)
+        if entry is None:
+            return
+        trace = self._telemetry.trace
+        off_cd = self._disp_clock_offset_ns
+        put_ns = entry["put_ns"]
+        sent_ns = entry.get("sent_ns", put_ns)
+        args = {"trace_id": entry["id"], "ordinal": ordinal}
+        disp_proc = f"dispatcher@{self._address[0]}:{self._address[1]}"
+        mapped = []
+        for hop in tc.get("hops") or ():
+            if not isinstance(hop, (list, tuple)) or len(hop) != 5:
+                continue
+            who, name, attempt, t_ns, off_ns = hop
+            if not isinstance(t_ns, int):
+                continue
+            ct = (t_ns - off_cd if who == "d"
+                  else t_ns + int(off_ns or 0) - off_cd)
+            mapped.append((str(who), str(name), int(attempt or 0), ct))
+        # whole-item span + local hops on the client's own track
+        trace.add("service.item", "service.trace", put_ns,
+                  max(done_ns - put_ns, 0),
+                  {**args, "attempt": msg.get("attempt", 0),
+                   "hops": len(mapped)})
+        trace.add("client.serialize", "service.trace", put_ns,
+                  max(sent_ns - put_ns, 0), args)
+        trace.add("client.deserialize", "service.trace", recv_ns,
+                  max(done_ns - recv_ns, 0), args)
+        # remote spans: pair up the stamp sequence; a requeued attempt
+        # opens a SECOND dispatch/worker span tree under the same trace id,
+        # annotated as a requeue
+        last: Dict[str, tuple] = {}
+        lasts = {}      # last mapped time per stamp kind (hop histograms)
+        for who, name, attempt, ct in mapped:
+            if who == "d":
+                pid = self._trace_pid(disp_proc)
+                if name in ("recv", "requeue"):
+                    last["open"] = (name, attempt, ct)
+                elif name == "assign":
+                    opened = last.pop("open", None)
+                    if opened is not None:
+                        span = ("dispatch.requeue"
+                                if opened[0] == "requeue"
+                                else "dispatch.queue")
+                        trace.add(span, "service.trace", opened[2],
+                                  max(ct - opened[2], 0),
+                                  {**args, "attempt": attempt,
+                                   "requeued": opened[0] == "requeue"},
+                                  pid=pid, proc=disp_proc, tid=1)
+                    last["assign"] = (attempt, ct)
+                    lasts["assign"] = ct
+                elif name == "relay":
+                    done = last.pop("wdone", None)
+                    start = done[1] if done is not None else ct
+                    trace.add("return.relay", "service.trace", start,
+                              max(recv_ns - start, 0),
+                              {**args, "attempt": attempt},
+                              pid=pid, proc=disp_proc, tid=1)
+            else:
+                proc = f"worker:{who}"
+                pid = self._trace_pid(proc)
+                if name == "recv":
+                    assigned = last.pop("assign", None)
+                    if assigned is not None:
+                        trace.add("relay", "service.trace", assigned[1],
+                                  max(ct - assigned[1], 0),
+                                  {**args, "attempt": attempt},
+                                  pid=self._trace_pid(disp_proc),
+                                  proc=disp_proc, tid=1)
+                    last["wrecv"] = (attempt, ct)
+                    lasts["wrecv"] = ct
+                elif name == "start":
+                    received = last.pop("wrecv", None)
+                    if received is not None:
+                        trace.add("worker.queue", "service.trace",
+                                  received[1], max(ct - received[1], 0),
+                                  {**args, "attempt": attempt},
+                                  pid=pid, proc=proc, tid=1)
+                    last["wstart"] = (attempt, ct)
+                    lasts["wstart"] = ct
+                elif name == "done":
+                    started = last.pop("wstart", None)
+                    if started is not None:
+                        trace.add("worker.exec", "service.trace",
+                                  started[1], max(ct - started[1], 0),
+                                  {**args, "attempt": attempt},
+                                  pid=pid, proc=proc, tid=1)
+                    last["wdone"] = (attempt, ct)
+                    lasts["wdone"] = ct
+        # hop latency decomposition: boundaries of the item's FINAL attempt
+        # chain (earlier requeued attempts fold into dispatcher_queue, where
+        # the item was waiting from this client's point of view); recorded
+        # only when the full chain stamped, so partial timelines cannot
+        # skew the histograms
+        hist = self._telemetry.histogram
+        hop_ns = {"client_serialize": sent_ns - put_ns,
+                  "client_deserialize": done_ns - recv_ns}
+        if all(k in lasts for k in ("assign", "wrecv", "wstart", "wdone")):
+            hop_ns.update({
+                "dispatcher_queue": lasts["assign"] - sent_ns,
+                "relay": lasts["wrecv"] - lasts["assign"],
+                "worker_queue": lasts["wstart"] - lasts["wrecv"],
+                "worker_exec": lasts["wdone"] - lasts["wstart"],
+                "return_relay": recv_ns - lasts["wdone"],
+            })
+        hop_ns["total"] = done_ns - put_ns
+        for name, ns in hop_ns.items():
+            hist(f"service.hop.{name}").record(max(ns, 0) / 1e9)
+
+    def fetch_fleet_events(self, n: int = 256,
+                           timeout: float = 5.0) -> list:
+        """Best-effort fetch of the dispatcher's structured fleet-event tail
+        (``events?`` frame) over a short-lived side connection - the crash-
+        artifact path: a terminal failure folds the fleet's last ~60s of
+        promotions / requeues / autoscale decisions into this client's
+        flight record.  Returns ``[]`` on any error; post-mortem enrichment
+        must never mask the original failure."""
+        try:
+            conn = connect_frames(self._address)
+        except OSError:
+            return []
+        try:
+            conn.send({"t": "events?", "n": int(n),
+                       "token": self._auth_token})
+            msg = conn.recv(timeout=timeout)
+            if isinstance(msg, dict) and msg.get("t") == "events":
+                events = msg.get("events")
+                if isinstance(events, list):
+                    return events
+            return []
+        except (OSError, PetastormTpuError):
+            return []
+        finally:
+            conn.close()
+
     def put(self, item: Any, cancel_event=None) -> None:
         if self._stopped:
             raise ReaderClosedError("Executor is stopped")
@@ -392,8 +616,19 @@ class ServiceExecutor(ExecutorBase):
         # a fast result must find its ordinal registered) - and the ledger
         # doubles as the resync source after a reconnect
         self._track_put(item)
+        ordinal = getattr(item, "ordinal", None)
+        traced = (self._tracing and isinstance(ordinal, int)
+                  and ordinal % self._trace_every == 0)
+        if traced:
+            # the ordinal doubles as the trace id: unique per run, and a
+            # requeued attempt keeps the SAME id (one item, one trace)
+            with self._trace_lock:
+                self._traces[ordinal] = {"id": ordinal,
+                                         "put_ns": time.perf_counter_ns()}
         try:
-            self._send({"t": "enqueue", "item": WireItem.encode(item)})
+            self._send({"t": "enqueue", "item": self._encode_item(item)})
+            if traced:
+                self._traces[ordinal]["sent_ns"] = time.perf_counter_ns()
             self._ventilated += 1
         except OSError:
             # connection mid-drop: the item is in the ledger, so the
@@ -409,7 +644,11 @@ class ServiceExecutor(ExecutorBase):
                 # a resync (ordinal-deduped dispatcher-side, unlike enqueue)
                 # covers the race where the receiver's reconnect resync ran
                 # before this item reached the ledger
-                self._send({"t": "resync", "items": [WireItem.encode(item)]})
+                self._send({"t": "resync",
+                            "items": [self._encode_item(item)]})
+                if traced:
+                    self._traces[ordinal]["sent_ns"] = \
+                        time.perf_counter_ns()
             except OSError:
                 pass  # next drop repeats the recovery
             self._ventilated += 1
@@ -525,6 +764,13 @@ class ServiceExecutor(ExecutorBase):
                     "service.decode", t0, dur,
                     {"ordinal": msg.get("ordinal"), "pk": msg.get("pk")})
                 self._m_results.add(1)
+                tc = msg.get("tc")
+                if self._tracing and isinstance(tc, dict):
+                    try:
+                        self._finish_trace(msg, tc, t0, t0 + dur)
+                    except Exception:  # noqa: BLE001 - tracing never fatal
+                        logger.debug("trace merge failed for ordinal %s",
+                                     msg.get("ordinal"), exc_info=True)
             pk = msg.get("pk")
             if pk == "bin":
                 self._m_frames_bin.add(1)
@@ -570,12 +816,22 @@ class ServiceExecutor(ExecutorBase):
             self._requeued_items += 1
             self._m_requeued.add(1)
             self._m_srv_requeued.add(1)
+            if self._tracing:
+                # instant annotation in the local timeline; the full
+                # requeued attempt arrives later inside the item's merged
+                # hop timeline (same trace id, second span tree)
+                self._telemetry.trace.add(
+                    "service.requeued", "service.trace",
+                    time.perf_counter_ns(), 0,
+                    {"ordinal": msg.get("ordinal"),
+                     "attempt": msg.get("attempt")})
 
     def _reconnect(self) -> bool:
         """Reconnect-with-backoff window (retry.py policy shape); True when
         a connection was re-established and the ledger resynced."""
         p = self._reconnect_policy
         backoff = p.initial_backoff_s
+        self._lost_at_ns = time.perf_counter_ns()
         for attempt in range(1, p.max_attempts + 1):
             if self._stopped:
                 return False
@@ -598,6 +854,20 @@ class ServiceExecutor(ExecutorBase):
                 continue
             self._reconnects += 1
             self._m_reconnects.add(1)
+            if self._tracing and self._lost_at_ns is not None:
+                # annotated gap: a dispatcher failover / restart shows up
+                # in the merged trace as a distinct rollover span covering
+                # the whole dark window, not an unexplained hole
+                now = time.perf_counter_ns()
+                self._telemetry.trace.add(
+                    "service.rollover", "service.trace", self._lost_at_ns,
+                    max(now - self._lost_at_ns, 0),
+                    {"attempts": attempt,
+                     "address":
+                         f"{self._address[0]}:{self._address[1]}",
+                     "dispatcher_restarts": self._dispatcher_restarts,
+                     "epoch": self._dispatcher_epoch})
+            self._lost_at_ns = None
             logger.info("Reconnected to dispatcher (attempt %d)", attempt)
             return True
         return False
@@ -683,6 +953,9 @@ class ServiceExecutor(ExecutorBase):
         back; it is recovered from this executor's own in-flight ledger
         (the same object we ventilated) for the quarantine record."""
         ordinal = msg.get("ordinal")
+        if self._tracing:
+            with self._trace_lock:
+                self._traces.pop(ordinal, None)
         with self._inflight_lock:
             item = self._inflight.get(ordinal)
         if not self._settle(ordinal):
@@ -720,4 +993,5 @@ class ServiceExecutor(ExecutorBase):
                 "dispatcher_restarts": self._dispatcher_restarts,
                 "dispatcher_epoch": self._dispatcher_epoch,
                 "window": self._window,
-                "window_in_use": len(self._inflight)}
+                "window_in_use": len(self._inflight),
+                "trace_items": self._trace_every}

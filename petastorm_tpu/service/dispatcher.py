@@ -184,7 +184,7 @@ def compute_recommendation(pressure: float, threshold: float, pending: int,
 class _WorkerState:
     __slots__ = ("name", "conn", "capacity", "hostname", "inflight",
                  "last_heartbeat", "busy", "jobs_sent", "gone", "codecs",
-                 "draining")
+                 "draining", "counters", "hists")
 
     def __init__(self, name: str, conn: FrameSocket, capacity: int,
                  hostname: str, codecs=()):
@@ -203,6 +203,12 @@ class _WorkerState:
         #: graceful retirement: a draining worker finishes its in-flight
         #: items but is never assigned new ones (the ``retiring`` frame)
         self.draining = False
+        #: fleet aggregation: cumulative per-worker counter totals (folded
+        #: from heartbeat deltas) and the latest cumulative histogram
+        #: snapshots the worker shipped - the raw material for the
+        #: ``fleet?`` frame and the per-worker-labeled Prometheus families
+        self.counters: Dict[str, float] = {}
+        self.hists: Dict[str, Dict] = {}
 
 
 class _Assignment:
@@ -436,6 +442,16 @@ class Dispatcher:
         self._standby_synced = 0
         self._standby_lag = 0
         self._sync_warned = False
+        #: primary-side standby health: peer address -> last journal seq
+        #: fed to it (stats()['ha'] derives standby_lag_items from the gap
+        #: to the live journal seq, so an operator sees standby sync state
+        #: from the PRIMARY's one-shot stats probe)
+        self._standby_feeds: Dict[str, int] = {}
+        #: bounded fleet event log (tentpole d): structured control-plane
+        #: events (promotions, fencing refusals, requeues, drains, worker
+        #: lifecycle, autoscale decisions) - served by the ``events?``
+        #: frame so a failing client can capture the fleet's last ~60s
+        self._events: Deque[Dict] = collections.deque(maxlen=512)
         # -- service.* telemetry (rides the registry -> Prometheus/--watch) --
         tele = self.telemetry
         self._g_workers = tele.gauge("service.registered_workers")
@@ -517,7 +533,8 @@ class Dispatcher:
             from petastorm_tpu.telemetry.export import MetricsExportServer
 
             self.metrics_server = MetricsExportServer(
-                self.telemetry, port=self._metrics_port)
+                self.telemetry, port=self._metrics_port,
+                extra=self._fleet_prometheus)
             self.metrics_server.start()
         logger.info("Dispatcher listening on %s:%d", self._host, self.port)
         if self._standby:
@@ -597,6 +614,44 @@ class Dispatcher:
             self._m_journal_items.add(restored_items)
         return restored_items
 
+    # -- fleet event log (tentpole d) ------------------------------------------
+
+    def _event(self, kind: str, src: str = "dispatcher", **fields) -> None:
+        """Append one structured event to the bounded fleet log.  Wall-clock
+        stamped (events are read by humans correlating across machines);
+        the deque's maxlen drops the oldest on overflow - the log is a
+        flight-data tail, not an audit trail."""
+        ev = {"ts": round(time.time(), 3), "src": src, "kind": kind}
+        ev.update(fields)
+        self._events.append(ev)
+
+    def events_tail(self, n: int = 256) -> List[Dict]:
+        """The last ``n`` fleet events, oldest first (the ``events?``
+        frame's payload; also folded into client flight records on a
+        terminal failure)."""
+        with self._lock:
+            evs = list(self._events)
+        return evs[-max(0, int(n)):]
+
+    def _on_peer_event(self, msg: Dict, src: Optional[str] = None) -> None:
+        """Fold one event reported by a peer (autoscale supervisor ``event``
+        frames, worker heartbeat piggybacks) into the fleet log.  Only
+        plain scalar fields are kept and the field count is capped - a
+        peer cannot bloat the bounded log's entries."""
+        if not isinstance(msg, dict):
+            return
+        kind = msg.get("kind")
+        if not isinstance(kind, str) or not kind:
+            return
+        fields = {}
+        for k, v in msg.items():
+            if k in ("t", "kind", "token", "ts", "src"):
+                continue
+            if isinstance(v, (str, int, float, bool)) and len(fields) < 8:
+                fields[str(k)[:32]] = v[:200] if isinstance(v, str) else v
+        self._event(kind[:64], src=src or str(msg.get("src", "peer"))[:64],
+                    **fields)
+
     # -- hot-standby HA (module docstring "High availability") -----------------
 
     #: live-tail records a slow standby may queue before the primary drops
@@ -630,6 +685,10 @@ class Dispatcher:
                 overflow.set()
 
         snapshot, seq = self._journal.attach_tail(tail)
+        with self._lock:
+            self._standby_feeds[peer] = 0
+        self._event("standby_subscribed", standby=peer,
+                    snapshot_records=len(snapshot))
         logger.info("Standby %s subscribed to the journal tail (%d snapshot"
                     " record(s), seq %d)", peer, len(snapshot), seq)
         try:
@@ -653,6 +712,8 @@ class Dispatcher:
                                            " snapshot record skipped (%r)",
                                            rec.get("r"))
             conn.send({"t": "journal_sync", "k": "snap_end", "seq": seq})
+            with self._lock:
+                self._standby_feeds[peer] = seq
             while not self._stop_event.is_set():
                 if overflow.is_set():
                     logger.warning(
@@ -664,13 +725,20 @@ class Dispatcher:
                     rec_seq, rec = q.get(timeout=0.5)
                 except queue.Empty:
                     # idle keepalive: carries the LIVE journal seq, so the
-                    # standby can meter any backlog as lag
+                    # standby can meter any backlog as lag.  An empty feed
+                    # queue means the standby has everything we appended -
+                    # record it as fully fed
+                    live_seq = self._journal.seq
                     conn.send({"t": "journal_sync", "k": "ping",
-                               "seq": self._journal.seq})
+                               "seq": live_seq})
+                    with self._lock:
+                        self._standby_feeds[peer] = live_seq
                     continue
                 try:
                     conn.send({"t": "journal_sync", "k": "rec", "rec": rec,
                                "seq": rec_seq})
+                    with self._lock:
+                        self._standby_feeds[peer] = rec_seq
                 except WireFormatError:
                     logger.warning("journal_sync: unencodable tail record"
                                    " skipped (%r)", rec.get("r"))
@@ -678,6 +746,9 @@ class Dispatcher:
             pass  # standby went away; it reconnects (or promoted)
         finally:
             self._journal.detach_tail(tail)
+            with self._lock:
+                self._standby_feeds.pop(peer, None)
+            self._event("standby_unsubscribed", standby=peer)
             conn.close()
 
     def _standby_loop(self) -> None:
@@ -817,6 +888,8 @@ class Dispatcher:
         self._m_failovers.add(1)
         self._g_epoch.set(self.epoch)
         self._g_standby_lag.set(0)
+        self._event("promotion", reason=reason, epoch=self.epoch,
+                    sessions=len(sessions), restored_items=restored)
         self.standby_promoted.set()
         logger.warning(
             "STANDBY PROMOTED to primary (%s): epoch %d, %d warm session(s)"
@@ -923,6 +996,8 @@ class Dispatcher:
                 # a standby serves stats? and journal subscriptions only;
                 # peers treat this refusal as a failed attempt and rotate
                 # to the next address in their failover list
+                self._event("fencing_refusal", peer=kind,
+                            why="standing by", epoch=self.epoch)
                 try:
                     conn.send({"t": "error", "error":
                                "dispatcher is a hot standby (of"
@@ -939,6 +1014,20 @@ class Dispatcher:
                 self._standby_feed_loop(conn, hello)
             elif kind == "stats?":
                 conn.send({"t": "stats", "stats": self.stats()})
+                conn.close()
+            elif kind == "fleet?":
+                conn.send({"t": "fleet", "fleet": self.fleet_stats()})
+                conn.close()
+            elif kind == "events?":
+                n = hello.get("n")
+                conn.send({"t": "events", "events": self.events_tail(
+                    n if isinstance(n, int) else 256)})
+                conn.close()
+            elif kind == "event":
+                # control-plane peers (the autoscale supervisor) report
+                # decisions into the fleet event log over one-shot conns
+                self._on_peer_event(hello)
+                conn.send({"t": "event_ok"})
                 conn.close()
             else:
                 logger.warning("Dropping connection with bad hello %r", kind)
@@ -968,7 +1057,14 @@ class Dispatcher:
             self._workers[name] = state
             self._g_workers.set(len(self._workers))
             recovered = self._absorb_worker_rejoin_locked(state, hello)
-        conn.send({"t": "hello_ok", "worker": name, "epoch": self.epoch})
+        # clock_ns: the dispatcher's monotonic clock at reply time - peers
+        # estimate their offset to it from the handshake round-trip, the
+        # skew anchor for merging cross-process trace stamps
+        conn.send({"t": "hello_ok", "worker": name, "epoch": self.epoch,
+                   "clock_ns": time.perf_counter_ns()})
+        self._event("worker_join", worker=name,
+                    rejoin=bool(hello.get("resume")),
+                    capacity=state.capacity)
         if hello.get("resume"):
             self._m_worker_rejoins.add(1)
             logger.info("Worker %s REJOINED still executing %d item(s)"
@@ -1078,6 +1174,7 @@ class Dispatcher:
             inflight = len(state.inflight)
         if not already:
             self._m_drains.add(1)
+            self._event("worker_drain", worker=state.name, inflight=inflight)
             logger.info("Worker %s is retiring (draining %d in-flight"
                         " item(s); no new assignments)", state.name, inflight)
         try:
@@ -1112,6 +1209,18 @@ class Dispatcher:
             for cname, delta in deltas.items():
                 if delta and cname.startswith(FLEET_COUNTER_PREFIXES):
                     self.telemetry.counter(f"service.fleet.{cname}").add(delta)
+        # fleet aggregation: fold the deltas into this worker's cumulative
+        # totals and keep its latest cumulative histogram snapshots - the
+        # per-worker truth behind fleet_stats() and the labeled Prometheus
+        # families (the delta fold above only keeps fleet-wide sums)
+        for cname, delta in deltas.items():
+            if isinstance(delta, (int, float)) and delta:
+                state.counters[cname] = state.counters.get(cname, 0) + delta
+        hists = msg.get("hists")
+        if isinstance(hists, dict):
+            state.hists = hists
+        for ev in msg.get("events") or ():
+            self._on_peer_event(ev, src=state.name)
         try:
             # the heartbeat reply carries the fencing epoch, so a fleet
             # learns about a failover even between reconnects
@@ -1169,6 +1278,14 @@ class Dispatcher:
     def _on_result(self, state: _WorkerState, msg: Dict) -> None:
         cid, ordinal = msg["client"], msg["ordinal"]
         state.last_heartbeat = time.monotonic()
+        tc = msg.get("tc")
+        if isinstance(tc, dict):
+            # traced item: stamp the dispatcher's result-receive time into
+            # the returning hop timeline (the client closes the
+            # return-relay hop against its own receive stamp)
+            tc.setdefault("hops", []).append(
+                ["d", "relay", int(msg.get("attempt", 0)),
+                 time.perf_counter_ns(), 0])
         duplicate = False
         orphaned = False
         # ONE critical section from duplicate check to outcome recording:
@@ -1326,6 +1443,7 @@ class Dispatcher:
             lost = list(state.inflight)
             self._g_workers.set(len(self._workers))
         state.conn.close()
+        self._event("worker_gone", worker=name, lost_inflight=len(lost))
         if lost:
             logger.warning("Worker %s lost with %d in-flight item(s);"
                            " requeueing", name, len(lost))
@@ -1348,8 +1466,16 @@ class Dispatcher:
                 return
             attempt = getattr(assign.item, "attempt", 0)
             if attempt < client.max_requeue:
+                # a traced item's context survives the requeue: the same
+                # trace id accumulates the retry's hop stamps, so the
+                # merged trace shows both attempts as sibling span trees
+                tc = getattr(assign.item, "tc", None)
+                if isinstance(tc, dict):
+                    tc.setdefault("hops", []).append(
+                        ["d", "requeue", attempt + 1,
+                         time.perf_counter_ns(), 0])
                 retry = WireItem(ordinal, attempt + 1, assign.item.blob,
-                                 assign.item.rg)
+                                 assign.item.rg, tc)
                 client.pending.appendleft(retry)
                 client.requeued += 1
                 conn = client.conn if client.connected else None
@@ -1360,12 +1486,16 @@ class Dispatcher:
                 notice = None
         if notice is not None:
             self._m_requeued.add(1)
+            self._event("requeue", client=cid, ordinal=ordinal,
+                        attempt=attempt + 1, why=why)
             logger.warning("Requeueing work item %s for client %s after %s"
                            " (attempt %d/%d)", ordinal, cid, why, attempt + 1,
                            client.max_requeue)
             if conn is not None:
                 self._send_to_client(cid, conn, notice)
             return
+        self._event("item_failed", client=cid, ordinal=ordinal, why=why,
+                    attempts=attempt)
         self._forward_failure(
             cid, ordinal, message=(
                 f"Work item {ordinal} lost to {why}; requeue budget exhausted"
@@ -1500,8 +1630,12 @@ class Dispatcher:
         # `boot` lets the client count dispatcher restarts; `known` lets a
         # warm-restarted (journaled) session skip resync re-sends; `epoch`
         # is the fencing token (a deposed primary's lower value is refused)
+        # `clock_ns` anchors the client's handshake clock-offset estimate
+        # (distributed tracing maps dispatcher/worker stamps into the
+        # client's monotonic domain through it)
         conn.send({"t": "hello_ok", "client": cid, "boot": self.boot_id,
-                   "epoch": self.epoch, "known": known})
+                   "epoch": self.epoch, "known": known,
+                   "clock_ns": time.perf_counter_ns()})
         for out in replay:
             self._send_to_client(cid, conn, out)
         self._pump()
@@ -1517,6 +1651,12 @@ class Dispatcher:
                 kind = msg.get("t")
                 if kind == "enqueue":
                     item = WireItem.from_wire(msg["item"])
+                    if item.tc is not None:
+                        # traced item: stamp its arrival at the control
+                        # plane (the dispatcher-queue hop opens here)
+                        item.tc.setdefault("hops", []).append(
+                            ["d", "recv", item.attempt,
+                             time.perf_counter_ns(), 0])
                     with self._lock:
                         client.pending.append(item)
                     if self._journal is not None:
@@ -1652,6 +1792,8 @@ class Dispatcher:
                 pass
         if client.conn is not None:
             client.conn.close()
+        self._event("client_purged", client=cid, reason=reason,
+                    dropped_items=dropped)
         logger.info("Client %s purged (%s; %d undelivered item(s) dropped)",
                     cid, reason, dropped)
         self._stamp_gauges()
@@ -1778,6 +1920,23 @@ class Dispatcher:
                     # DRR: an emptied queue forfeits its residual credit
                     # (idle time must not bank into a later burst)
                     client.deficit = 0.0
+                tc = getattr(item, "tc", None)
+                if isinstance(tc, dict):
+                    # traced item: close the dispatcher-queue hop (receive/
+                    # requeue -> assignment, same-process monotonic delta -
+                    # skew-free) and stamp the assignment for the merged
+                    # trace's relay hop
+                    now_ns = time.perf_counter_ns()
+                    hops = tc.setdefault("hops", [])
+                    if self.telemetry.enabled:
+                        for who, hname, _a, t_ns, _off in reversed(hops):
+                            if who == "d" and hname in ("recv", "requeue"):
+                                self.telemetry.histogram(
+                                    "service.hop.dispatcher_queue").record(
+                                        max(0, now_ns - t_ns) / 1e9)
+                                break
+                    hops.append(["d", "assign",
+                                 getattr(item, "attempt", 0), now_ns, 0])
                 worker = self._pick_worker(item, free, stable)
                 client.inflight[item.ordinal] = _Assignment(item, worker.name)
                 worker.inflight.add((cid, item.ordinal))
@@ -1931,6 +2090,66 @@ class Dispatcher:
                 "workers": workers, "connected_clients": clients,
                 "recommendation": recommendation}
 
+    def fleet_stats(self) -> Dict[str, Any]:
+        """Fleet aggregation snapshot (the ``fleet?`` frame; also the raw
+        material of the per-worker-labeled Prometheus families and the
+        ``stats --watch`` fleet view): per-worker cumulative counters and
+        stage-histogram quantiles, fleet-merged histograms (fixed buckets
+        merge element-wise - :func:`merge_hist_snapshots`), the fleet
+        event tail, and the scaling signal."""
+        from petastorm_tpu.telemetry.report import (hist_quantile,
+                                                    merge_hist_snapshots)
+
+        now = time.monotonic()
+        with self._lock:
+            workers = {}
+            hist_groups: Dict[str, List[Dict]] = {}
+            for name, w in self._workers.items():
+                stages = {}
+                for hname, snap in (w.hists or {}).items():
+                    if not isinstance(snap, dict):
+                        continue
+                    hist_groups.setdefault(hname, []).append(snap)
+                    if snap.get("count"):
+                        stages[hname] = {
+                            "count": int(snap.get("count", 0)),
+                            "p50_s": hist_quantile(snap, 0.5),
+                            "p99_s": hist_quantile(snap, 0.99)}
+                workers[name] = {
+                    "busy": w.busy, "capacity": w.capacity,
+                    "inflight": len(w.inflight), "draining": w.draining,
+                    "hostname": w.hostname,
+                    "heartbeat_age_s": round(now - w.last_heartbeat, 2),
+                    "counters": dict(w.counters), "hists": stages}
+            events = list(self._events)[-64:]
+        merged = {}
+        for hname, snaps in hist_groups.items():
+            m = merge_hist_snapshots(snaps)
+            if m.get("count"):
+                merged[hname] = {"count": m["count"],
+                                 "p50_s": hist_quantile(m, 0.5),
+                                 "p99_s": hist_quantile(m, 0.99),
+                                 "snapshot": m}
+        fleet_counters = {}
+        if self.telemetry.enabled:
+            prefix = "service.fleet."
+            fleet_counters = {
+                k[len(prefix):]: v for k, v in
+                self.telemetry.snapshot()["counters"].items()
+                if k.startswith(prefix)}
+        return {"boot": self.boot_id, "epoch": self.epoch,
+                "uptime_s": round(now - self._started_at, 1),
+                "workers": workers, "merged_hists": merged,
+                "fleet_counters": fleet_counters, "events": events,
+                "scaling": self.scaling_signal()}
+
+    def _fleet_prometheus(self) -> str:
+        """Extra text block for the ``--metrics-port`` scrape: the
+        per-worker-labeled and fleet-merged families."""
+        from petastorm_tpu.telemetry.export import render_fleet_prometheus
+
+        return render_fleet_prometheus(self.fleet_stats())
+
     def stats(self) -> Dict[str, Any]:
         """Point-in-time service snapshot (CLI ``stats`` / tests /
         operators): fleet membership, per-client progress, counters, and
@@ -1974,6 +2193,23 @@ class Dispatcher:
                "workers": workers, "clients": clients, "qos": qos,
                "recovery": recovery,
                "counters": counters, "scaling": self.scaling_signal()}
+        # HA health from EITHER role's one-shot stats probe: a primary
+        # reports the sync position of every subscribed standby (journal
+        # seq fed vs live - standby_lag_items without scraping the standby
+        # process), a standby reports its own view of the stream
+        jseq = self._journal.seq if self._journal is not None else 0
+        with self._lock:
+            feeds = dict(self._standby_feeds)
+        ha: Dict[str, Any] = {
+            "role": "standby" if self._standby else "primary",
+            "epoch": self.epoch, "journal_seq": jseq,
+            "standbys": {peer: {"synced_seq": pos,
+                                "standby_lag_items": max(0, jseq - pos)}
+                         for peer, pos in feeds.items()}}
+        if self._standby_of is not None:
+            ha["standby_lag_items"] = self._standby_lag
+            ha["synced_records"] = self._standby_synced
+        out["ha"] = ha
         if self._standby_of is not None:
             out["standby"] = {
                 "standby": self._standby,

@@ -220,15 +220,28 @@ class WireItem:
     ``blob`` the dispatcher **never unpickles** (only the assigned worker
     does, to run the client's job - the same trust plane as the factory
     bootstrap).
+
+    ``tc`` is the optional distributed-trace context: ``{"id": <trace id>,
+    "hops": [[who, name, attempt, t_ns, off_ns], ...]}``.  Untraced items
+    (the default) carry no ``tc`` key at all, so tracing is free on the
+    wire when disarmed.  Every hop stamp records the stamping process
+    (``who``: ``"d"`` for the dispatcher, else the worker name), the hop
+    name, the item attempt it belongs to, a ``perf_counter_ns`` timestamp
+    in the stamper's own clock, and that process's estimated offset to the
+    dispatcher clock (``off_ns``; 0 for dispatcher stamps) - enough for
+    the client to map every stamp into its own monotonic domain and merge
+    the whole cross-process timeline into one Chrome trace.
     """
 
-    __slots__ = ("ordinal", "attempt", "blob", "rg")
+    __slots__ = ("ordinal", "attempt", "blob", "rg", "tc")
 
-    def __init__(self, ordinal: int, attempt: int, blob: bytes, rg=None):
+    def __init__(self, ordinal: int, attempt: int, blob: bytes, rg=None,
+                 tc=None):
         self.ordinal = ordinal
         self.attempt = attempt
         self.blob = blob
         self.rg = rg
+        self.tc = tc
 
     @classmethod
     def from_wire(cls, msg: Dict[str, Any]) -> "WireItem":
@@ -237,7 +250,10 @@ class WireItem:
         if not isinstance(ordinal, int) or not isinstance(attempt, int) \
                 or not isinstance(blob, (bytes, bytearray)):
             raise WireFormatError(f"malformed work item frame: {msg!r}")
-        return cls(ordinal, attempt, bytes(blob), msg.get("rg"))
+        tc = msg.get("tc")
+        if tc is not None and not isinstance(tc, dict):
+            tc = None
+        return cls(ordinal, attempt, bytes(blob), msg.get("rg"), tc)
 
     def to_wire(self) -> Dict[str, Any]:
         """Wire fields for a ``work`` frame (the inverse of
@@ -245,13 +261,18 @@ class WireItem:
         out = {"o": self.ordinal, "a": self.attempt, "blob": self.blob}
         if self.rg is not None:
             out["rg"] = self.rg
+        if self.tc is not None:
+            out["tc"] = self.tc
         return out
 
     @staticmethod
-    def encode(item: Any) -> Dict[str, Any]:
+    def encode(item: Any, trace_id: Optional[int] = None) -> Dict[str, Any]:
         """Client-side: one pool ``VentilatedItem`` -> wire fields (the
         work payload is pickled into the opaque blob; rowgroup affinity
-        metadata is lifted out structurally for the dispatcher)."""
+        metadata is lifted out structurally for the dispatcher).  Passing
+        ``trace_id`` arms distributed tracing for this item: downstream
+        hops append timing stamps to ``tc["hops"]`` and return them with
+        the result."""
         work = getattr(item, "item", None)
         out = {"o": int(item.ordinal),
                "a": int(getattr(item, "attempt", 0)),
@@ -260,6 +281,8 @@ class WireItem:
         if rg is not None:
             out["rg"] = [str(getattr(rg, "path", "")),
                          int(getattr(rg, "row_group", 0))]
+        if trace_id is not None:
+            out["tc"] = {"id": int(trace_id), "hops": []}
         return out
 
 

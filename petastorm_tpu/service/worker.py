@@ -160,6 +160,15 @@ class ServiceWorker:
         self._outbox: "collections.deque" = collections.deque()
         self._outbox_bytes = 0
         self._hb_snapshot: Dict[str, float] = {}
+        #: estimated offset of the dispatcher's perf_counter_ns clock from
+        #: ours (handshake round-trip midpoint; error ~ RTT/2).  Rides every
+        #: trace hop stamp we emit so the client can map our stamps into
+        #: its own clock domain through the dispatcher's.
+        self._clock_offset_ns = 0
+        #: structured events to piggyback on the next heartbeat (folded
+        #: into the dispatcher's bounded fleet event log under our name)
+        self._pending_events: "collections.deque" = collections.deque(
+            maxlen=32)
         self._threads = []
         self._threads_started = False
         self.worker_name: Optional[str] = None
@@ -282,6 +291,7 @@ class ServiceWorker:
         with self._fn_lock:
             jobs = list(self._jobs)
         resume = self.worker_name is not None
+        t0 = time.perf_counter_ns()
         conn.send({"t": "worker_hello", "protocol": PROTOCOL_VERSION,
                    "worker": self._name or self.worker_name,
                    "capacity": self._capacity,
@@ -291,6 +301,7 @@ class ServiceWorker:
                    "resume": resume,
                    "assignments": assignments, "jobs": jobs})
         hello = conn.recv(timeout=10.0)
+        t1 = time.perf_counter_ns()
         if not hello or hello.get("t") != "hello_ok":
             raise PetastormTpuError(
                 f"dispatcher refused registration: {hello!r}")
@@ -308,8 +319,19 @@ class ServiceWorker:
                     f" {self._dispatcher_epoch}: refusing a deposed"
                     " primary")
             self._dispatcher_epoch = epoch
+        clock_ns = hello.get("clock_ns")
+        if isinstance(clock_ns, int):
+            # offset estimate: the dispatcher stamped clock_ns somewhere
+            # inside our [t0, t1] round-trip; the midpoint bounds the
+            # error at RTT/2 (per-hop histogram deltas never use this -
+            # only the merged cross-process trace timeline does)
+            self._clock_offset_ns = clock_ns - (t0 + t1) // 2
         self.worker_name = hello.get("worker")
         if resume:
+            self._pending_events.append(
+                {"kind": "worker_rejoin",
+                 "held_items": len(assignments),
+                 "buffered_outcomes": len(self._outbox)})
             logger.info("Rejoined dispatcher as %s (still holding %d"
                         " item(s), %d buffered outcome(s))",
                         self.worker_name, len(assignments),
@@ -370,9 +392,20 @@ class ServiceWorker:
                     item = VentilatedItem(wi["o"], pickle.loads(wi["blob"]),
                                           wi.get("a", 0))
                     cid = msg["client"]
+                    tc = wi.get("tc")
+                    if isinstance(tc, dict):
+                        # traced item: stamp its arrival (the worker-queue
+                        # hop opens here); our clock offset rides each
+                        # stamp so the client can remap it
+                        tc.setdefault("hops", []).append(
+                            [self.worker_name or "w?", "recv",
+                             item.attempt, time.perf_counter_ns(),
+                             self._clock_offset_ns])
+                    else:
+                        tc = None
                     with self._held_lock:
                         self._held[(cid, item.ordinal)] = item.attempt
-                    self._work.put((cid, item))
+                    self._work.put((cid, item, tc))
                 elif kind == "job_done":
                     with self._fn_lock:
                         self._jobs.pop(msg["client"], None)
@@ -459,11 +492,30 @@ class ServiceWorker:
             job = self._jobs.get(cid)
             return job["codec"] if job else ""
 
+    def _trace_stamp(self, tc: Dict, name: str, attempt: int,
+                     prev: Optional[str] = None,
+                     hop: Optional[str] = None) -> int:
+        """Append one hop stamp to a traced item's context; when ``prev``/
+        ``hop`` name the stamp that opened this hop, record the same-
+        process monotonic delta into the ``service.hop.<hop>`` histogram
+        (skew-free - both ends are our own clock)."""
+        now_ns = time.perf_counter_ns()
+        hops = tc.setdefault("hops", [])
+        if prev is not None and self.telemetry.enabled:
+            for who, hname, _a, t_ns, _off in reversed(hops):
+                if hname == prev and who != "d":
+                    self.telemetry.histogram(f"service.hop.{hop}").record(
+                        max(0, now_ns - t_ns) / 1e9)
+                    break
+        hops.append([self.worker_name or "w?", name, attempt, now_ns,
+                     self._clock_offset_ns])
+        return now_ns
+
     def _processor_loop(self) -> None:
         tele = self.telemetry
         while not self._stop_event.is_set():
             try:
-                cid, item = self._work.get(timeout=0.05)
+                cid, item, tc = self._work.get(timeout=0.05)
             except queue.Empty:
                 continue
             with self._busy_lock:
@@ -472,6 +524,9 @@ class ServiceWorker:
             attempt = getattr(item, "attempt", 0)
             try:
                 try:
+                    if tc is not None:
+                        self._trace_stamp(tc, "start", attempt,
+                                          prev="recv", hop="worker_queue")
                     fn = self._fn_for(cid)
                     result = fn(item)
                 except BaseException as exc:  # noqa: BLE001 - forwarded
@@ -493,6 +548,13 @@ class ServiceWorker:
                             "t": "result", "client": cid,
                             "ordinal": ordinal, "attempt": attempt,
                             "rows": getattr(result, "num_rows", 0)})
+                        if tc is not None:
+                            # exec+encode done: close the worker-exec hop
+                            # and return the accumulated timeline with the
+                            # result header
+                            self._trace_stamp(tc, "done", attempt,
+                                              prev="start", hop="worker_exec")
+                            header["tc"] = tc
                         if t0 is not None:
                             # outbound wire-encoding cost, per direction
                             # (the client records service.decode)
@@ -574,6 +636,9 @@ class ServiceWorker:
                     # shedding the outcome forgets the assignment too: the
                     # client's resync re-enqueues it (re-fetch, not a hang)
                     self._held.pop(old_key, None)
+                self._pending_events.append({"kind": "outbox_shed",
+                                             "outbox_items":
+                                                 len(self._outbox)})
                 logger.warning("outbox overflow while disconnected: shed one"
                                " buffered outcome (client will re-fetch)")
 
@@ -651,6 +716,18 @@ class ServiceWorker:
             self._hb_snapshot[name] = value
         return deltas
 
+    def _hb_hists(self) -> Dict[str, Dict]:
+        """Cumulative histogram snapshots to ship with the heartbeat:
+        stage latencies plus our same-process trace hops.  Cumulative (not
+        deltas) - the dispatcher keeps the latest per worker and merges
+        fleet-wide via the fixed shared bucket bounds."""
+        if not self.telemetry.enabled:
+            return {}
+        hists = self.telemetry.snapshot().get("histograms", {})
+        return {n: s for n, s in hists.items()
+                if n.startswith("service.hop.")
+                or (n.startswith("stage.") and n.endswith(".latency_s"))}
+
     def _heartbeat_loop(self) -> None:
         # wakes every 0.25s so a drain completes promptly, but heartbeats
         # still go out only every _hb_interval
@@ -670,8 +747,20 @@ class ServiceWorker:
                 continue
             with self._busy_lock:
                 busy = self._busy + self._work.qsize()
-            self._send({"t": "heartbeat", "busy": busy,
-                        "counters": self._counter_deltas()})
+            hb = {"t": "heartbeat", "busy": busy,
+                  "counters": self._counter_deltas()}
+            hists = self._hb_hists()
+            if hists:
+                hb["hists"] = hists
+            evs = []
+            while self._pending_events:
+                try:
+                    evs.append(self._pending_events.popleft())
+                except IndexError:
+                    break
+            if evs:
+                hb["events"] = evs
+            self._send(hb)
 
     def _check_drained(self, now: float) -> bool:
         """Drain-completion check (heartbeat thread): everything this

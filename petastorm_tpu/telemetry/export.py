@@ -46,6 +46,8 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 _STAGE_RE = re.compile(r"^stage\.([^.]+)\.(busy_s|count|latency_s)$")
+_HOP_RE = re.compile(r"^service\.hop\.([^.]+)$")
+_LABEL_RE = re.compile(r"[^a-zA-Z0-9_\-.:@]")
 
 
 def _sanitize(name: str) -> str:
@@ -107,10 +109,14 @@ def render_prometheus(snapshot: Dict,
 
     histograms = snapshot.get("histograms", {})
     stage_hists = {}
+    hop_hists = {}
     for name, hist in histograms.items():
         m = _STAGE_RE.match(name)
+        hm = _HOP_RE.match(name)
         if m and m.group(2) == "latency_s":
             stage_hists[m.group(1)] = hist
+        elif hm:
+            hop_hists[hm.group(1)] = hist
         else:
             metric = f"{PREFIX}_{_sanitize(name)}"
             family(metric, "summary", f"Distribution of {name}.",
@@ -145,6 +151,29 @@ def render_prometheus(snapshot: Dict,
                "Cumulative stage latency quantiles (fixed-bucket upper"
                " bounds).", q_samples)
 
+    if hop_hists:
+        # per-hop trace latency decomposition folds into one labeled family
+        # (same pattern as stages) rather than N generic summaries
+        hq_samples = []
+        hc_samples = []
+        for h in sorted(hop_hists):
+            hist = hop_hists[h]
+            hc_samples.append(
+                f"{PREFIX}_service_hop_ops_total{{hop=\"{h}\"}} "
+                f"{_fmt(hist.get('count', 0))}")
+            if not hist.get("count"):
+                continue
+            for q in (0.5, 0.99):
+                hq_samples.append(
+                    f"{PREFIX}_service_hop_latency_seconds"
+                    f"{{hop=\"{h}\",quantile=\"{q}\"}} "
+                    f"{_fmt(_hist_quantile(hist, q))}")
+        family(f"{PREFIX}_service_hop_ops_total", "counter",
+               "Traced items observed per service hop.", hc_samples)
+        family(f"{PREFIX}_service_hop_latency_seconds", "gauge",
+               "Per-hop latency quantiles of traced service items"
+               " (fixed-bucket upper bounds).", hq_samples)
+
     if sampler_point:
         point_stages = sorted(sampler_point.get("stages", {}))
         family(f"{PREFIX}_stage_rate_per_second", "gauge",
@@ -164,11 +193,129 @@ def render_prometheus(snapshot: Dict,
         family(f"{PREFIX}_stage_interval_latency_seconds", "gauge",
                "Stage latency quantiles over the last sampled interval.",
                iq_samples)
+        hop_point = sampler_point.get("hops", {})
+        hiq_samples = []
+        for h in sorted(hop_point):
+            for q, key in ((0.5, "p50_s"), (0.99, "p99_s")):
+                v = hop_point[h].get(key)
+                if v is None:
+                    continue
+                hiq_samples.append(
+                    f"{PREFIX}_service_hop_interval_latency_seconds"
+                    f"{{hop=\"{h}\",quantile=\"{q}\"}} {_fmt(v)}")
+        family(f"{PREFIX}_service_hop_interval_latency_seconds", "gauge",
+               "Per-hop latency quantiles over the last sampled interval.",
+               hiq_samples)
         family(f"{PREFIX}_sample_interval_seconds", "gauge",
                "Measured length of the last sampled interval.",
                [f"{PREFIX}_sample_interval_seconds "
                 f"{_fmt(sampler_point.get('dt_s', 0.0))}"])
 
+    return "\n".join(lines) + "\n"
+
+
+def render_fleet_prometheus(fleet: Dict) -> str:
+    """Render a dispatcher ``fleet_stats()`` dict as Prometheus text: the
+    fleet aggregation plane's per-worker-labeled families plus fleet-merged
+    histogram quantiles.  Pure function (golden-testable); appended to the
+    dispatcher's ``/metrics`` body via ``MetricsExportServer(extra=...)``.
+
+    Families::
+
+        petastorm_tpu_fleet_worker_up{worker=...}            1
+        petastorm_tpu_fleet_worker_busy{worker=...}          in-flight+queued
+        petastorm_tpu_fleet_worker_capacity{worker=...}
+        petastorm_tpu_fleet_worker_inflight{worker=...}      dispatcher view
+        petastorm_tpu_fleet_worker_heartbeat_age_seconds{worker=...}
+        petastorm_tpu_fleet_worker_counter_total{worker=...,counter=...}
+        petastorm_tpu_fleet_worker_latency_seconds{worker=...,hist=...,quantile=...}
+        petastorm_tpu_fleet_latency_seconds{hist=...,quantile=...}   merged
+        petastorm_tpu_fleet_counter_total{counter=...}       dispatcher fold
+    """
+    lines: List[str] = []
+
+    def family(name: str, mtype: str, help_text: str,
+               samples: Iterable) -> None:
+        rendered = list(samples)
+        if not rendered:
+            return
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.extend(rendered)
+
+    def lbl(v) -> str:
+        return _LABEL_RE.sub("_", str(v))
+
+    workers = fleet.get("workers", {}) or {}
+    names = sorted(workers)
+    family(f"{PREFIX}_fleet_worker_up", "gauge",
+           "1 for every worker currently registered with the dispatcher.",
+           [f"{PREFIX}_fleet_worker_up{{worker=\"{lbl(w)}\"}} 1"
+            for w in names])
+    for field, metric, help_text in (
+            ("busy", "fleet_worker_busy",
+             "Worker-reported in-flight + queued items (last heartbeat)."),
+            ("capacity", "fleet_worker_capacity",
+             "Configured concurrent-item capacity per worker."),
+            ("inflight", "fleet_worker_inflight",
+             "Dispatcher-recorded assignments in flight toward the worker."),
+            ("heartbeat_age_s", "fleet_worker_heartbeat_age_seconds",
+             "Seconds since the worker's last heartbeat.")):
+        family(f"{PREFIX}_{metric}", "gauge", help_text,
+               [f"{PREFIX}_{metric}{{worker=\"{lbl(w)}\"}} "
+                f"{_fmt(float(workers[w].get(field, 0) or 0))}"
+                for w in names if field in workers[w]])
+    ctr_samples = []
+    for w in names:
+        counters = workers[w].get("counters", {}) or {}
+        for c in sorted(counters):
+            ctr_samples.append(
+                f"{PREFIX}_fleet_worker_counter_total"
+                f"{{worker=\"{lbl(w)}\",counter=\"{lbl(c)}\"}} "
+                f"{_fmt(float(counters[c]))}")
+    family(f"{PREFIX}_fleet_worker_counter_total", "counter",
+           "Per-worker cumulative counters folded from heartbeat deltas.",
+           ctr_samples)
+    wq_samples = []
+    for w in names:
+        hists = workers[w].get("hists", {}) or {}
+        for h in sorted(hists):
+            for q, key in ((0.5, "p50_s"), (0.99, "p99_s")):
+                v = hists[h].get(key)
+                if v is None:
+                    continue
+                wq_samples.append(
+                    f"{PREFIX}_fleet_worker_latency_seconds"
+                    f"{{worker=\"{lbl(w)}\",hist=\"{lbl(h)}\","
+                    f"quantile=\"{q}\"}} {_fmt(v)}")
+    family(f"{PREFIX}_fleet_worker_latency_seconds", "gauge",
+           "Per-worker stage/hop latency quantiles (heartbeat snapshots).",
+           wq_samples)
+    merged = fleet.get("merged_hists", {}) or {}
+    mq_samples = []
+    for h in sorted(merged):
+        for q, key in ((0.5, "p50_s"), (0.99, "p99_s")):
+            v = merged[h].get(key)
+            if v is None:
+                continue
+            mq_samples.append(
+                f"{PREFIX}_fleet_latency_seconds"
+                f"{{hist=\"{lbl(h)}\",quantile=\"{q}\"}} {_fmt(v)}")
+    family(f"{PREFIX}_fleet_latency_seconds", "gauge",
+           "Fleet-merged stage/hop latency quantiles (bucket-wise merge of"
+           " every worker's snapshot).", mq_samples)
+    fleet_counters = fleet.get("fleet_counters", {}) or {}
+    family(f"{PREFIX}_fleet_counter_total", "counter",
+           "Fleet-wide cumulative counters (dispatcher heartbeat fold).",
+           [f"{PREFIX}_fleet_counter_total{{counter=\"{lbl(c)}\"}} "
+            f"{_fmt(float(fleet_counters[c]))}"
+            for c in sorted(fleet_counters)])
+    if "epoch" in fleet:
+        family(f"{PREFIX}_fleet_epoch", "gauge",
+               "Current dispatcher fencing epoch.",
+               [f"{PREFIX}_fleet_epoch {_fmt(float(fleet['epoch']))}"])
+    if not lines:
+        return ""
     return "\n".join(lines) + "\n"
 
 
@@ -183,9 +330,13 @@ class MetricsExportServer:
     """
 
     def __init__(self, telemetry, sampler=None, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", extra=None):
         self.telemetry = telemetry
         self.sampler = sampler
+        #: optional zero-arg callable returning extra exposition text to
+        #: append per scrape (the dispatcher's fleet families); a failure
+        #: there degrades the scrape to local metrics, never a 500
+        self.extra = extra
         self.host = host
         self._requested_port = int(port)
         self._server: Optional[ThreadingHTTPServer] = None
@@ -220,6 +371,12 @@ class MetricsExportServer:
                     logger.warning("metrics render failed", exc_info=True)
                     self.send_error(500, "metrics render failed")
                     return
+                if outer.extra is not None:
+                    try:
+                        body += outer.extra() or ""
+                    except Exception:  # noqa: BLE001
+                        logger.warning("extra metrics render failed",
+                                       exc_info=True)
                 payload = body.encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", CONTENT_TYPE)

@@ -74,6 +74,50 @@ def _hist_quantile(hist: Dict, q: float) -> float:
     return buckets[-1]
 
 
+def hist_quantile(hist: Dict, q: float) -> float:
+    """Approximate quantile of a ``Histogram.snapshot()`` dict: the upper
+    bound of the bucket holding the q-th observation (0.0 when empty).
+    Public twin of the report's internal helper - the fleet aggregation
+    plane derives per-worker and merged quantiles from wire-shipped
+    snapshots with it."""
+    if not hist or not hist.get("count"):
+        return 0.0
+    return _hist_quantile(hist, q)
+
+
+def merge_hist_snapshots(snaps) -> Dict:
+    """Merge fixed-bucket ``Histogram.snapshot()`` dicts element-wise.
+
+    The registry's histograms use a fixed bucket shape precisely so
+    snapshots from different processes are mergeable: counts add, sums
+    add.  Snapshots whose bucket bounds differ from the first one's are
+    skipped (a foreign/fuzzed frame must degrade coverage, not poison the
+    merge).  Returns an empty-count snapshot when nothing merges.
+    """
+    buckets = None
+    counts: List[int] = []
+    total_sum = 0.0
+    total_count = 0
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        b = snap.get("buckets")
+        c = snap.get("counts")
+        if not isinstance(b, (list, tuple)) or not isinstance(c, (list,
+                                                                  tuple)):
+            continue
+        if buckets is None:
+            buckets = list(b)
+            counts = [0] * len(c)
+        if list(b) != buckets or len(c) != len(counts):
+            continue
+        counts = [x + int(y) for x, y in zip(counts, c)]
+        total_sum += float(snap.get("sum", 0.0))
+        total_count += int(snap.get("count", 0))
+    return {"buckets": buckets or [], "counts": counts,
+            "sum": total_sum, "count": total_count}
+
+
 def dominant_stage(snapshot: Dict) -> str:
     """Name of the stage with the most cumulative busy time ('' if none).
     Stages that are registered but have recorded no execution yet are not

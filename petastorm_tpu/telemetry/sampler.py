@@ -177,7 +177,20 @@ class MetricsSampler:
                  for n, v in counters.items()}
         prev_hists = prev.get("histograms", {})
         stages: Dict[str, Dict] = {}
+        hops: Dict[str, Dict] = {}
         for n, hist in cur.get("histograms", {}).items():
+            if n.startswith("service.hop."):
+                # per-hop latency decomposition of traced service items:
+                # same per-interval quantile treatment as stages, its own
+                # section (hops are legs of one item, not pipeline stages)
+                hops[n[len("service.hop."):]] = {
+                    "count": int(hist.get("count", 0)),
+                    "p50_s": _delta_hist_quantile(prev_hists.get(n), hist,
+                                                  0.5),
+                    "p99_s": _delta_hist_quantile(prev_hists.get(n), hist,
+                                                  0.99),
+                }
+                continue
             if not (n.startswith("stage.") and n.endswith(".latency_s")):
                 continue
             stage = n.split(".", 2)[1]
@@ -190,13 +203,16 @@ class MetricsSampler:
             }
         # counters already registered as stages render via ``stages``; keep
         # the raw maps complete anyway (flight-record analysis wants totals)
-        return {"t": float(cur.get("uptime_s", 0.0)),
-                "wall_time": wall,
-                "dt_s": dt,
-                "counters": dict(counters),
-                "rates": rates,
-                "gauges": dict(cur.get("gauges", {})),
-                "stages": stages}
+        point = {"t": float(cur.get("uptime_s", 0.0)),
+                 "wall_time": wall,
+                 "dt_s": dt,
+                 "counters": dict(counters),
+                 "rates": rates,
+                 "gauges": dict(cur.get("gauges", {})),
+                 "stages": stages}
+        if hops:
+            point["hops"] = hops
+        return point
 
     # -- reads ----------------------------------------------------------------
 
@@ -228,18 +244,23 @@ class MetricsSampler:
 
 def flight_record(sampler: MetricsSampler, reason: str = "",
                   window_s: float = DEFAULT_FLIGHT_WINDOW_S,
-                  trace_tail: int = DEFAULT_TRACE_TAIL) -> Dict:
+                  trace_tail: int = DEFAULT_TRACE_TAIL,
+                  fleet_events: Optional[List[Dict]] = None) -> Dict:
     """Capture the last ``window_s`` of sampled series plus the trace tail.
 
     Called at the moment of a terminal pipeline failure (the reader wires
     this into its stall-abort / worker-error / budget-exhaustion paths); a
     final ``sample_now()`` flushes the partial interval so the series reaches
-    the failure moment.  Returns a JSON-serializable record::
+    the failure moment.  ``fleet_events`` (optional) carries the dispatcher's
+    structured event tail fetched at failure time, so one artifact holds the
+    local curves AND the fleet's last minute of promotions / requeues /
+    autoscale decisions.  Returns a JSON-serializable record::
 
         {"reason", "wall_time", "window_s", "interval_s",
          "points": [<sample points>...],
          "final": <full Telemetry.snapshot()>,
-         "trace_tail": [<last spans, TraceBuffer.tail schema>...]}
+         "trace_tail": [<last spans, TraceBuffer.tail schema>...],
+         "fleet_events": [<dispatcher event dicts>...]}   # may be empty
     """
     sampler.sample_now()
     tele = sampler.telemetry
@@ -252,6 +273,7 @@ def flight_record(sampler: MetricsSampler, reason: str = "",
         "points": sampler.tail(window_s),
         "final": tele.snapshot(),
         "trace_tail": trace.tail(trace_tail) if trace is not None else [],
+        "fleet_events": list(fleet_events or []),
     }
 
 
@@ -260,7 +282,9 @@ def dump_flight_record(record: Dict, path: str) -> str:
 
     One header line (``kind='flight_recorder'``: reason, window, interval),
     one ``kind='point'`` line per sampled point, one ``kind='final_snapshot'``
-    line, then one ``kind='trace_event'`` line per trace span.  Append mode:
+    line, one ``kind='trace_event'`` line per trace span, then one
+    ``kind='fleet_event'`` line per dispatcher event (when the record carries
+    a fleet tail).  Append mode:
     a long-lived job that crashes repeatedly accumulates one record per
     incident in the same artifact (header ``wall_time`` separates them).
     """
@@ -276,6 +300,11 @@ def dump_flight_record(record: Dict, path: str) -> str:
                             "snapshot": record["final"]}) + "\n")
         for event in record.get("trace_tail", []):
             f.write(json.dumps({"kind": "trace_event", **event}) + "\n")
+        for event in record.get("fleet_events", []):
+            # nested: dispatcher events carry their OWN "kind" field (the
+            # event type), which must not collide with the line discriminator
+            f.write(json.dumps({"kind": "fleet_event", "event": event})
+                    + "\n")
     return path
 
 
@@ -294,7 +323,7 @@ def load_flight_records(path: str) -> List[Dict]:
             if kind == "flight_recorder":
                 obj.pop("points", None)
                 records.append({**obj, "points": [], "final": {},
-                                "trace_tail": []})
+                                "trace_tail": [], "fleet_events": []})
             elif not records:
                 continue        # tolerate a truncated/foreign prefix
             elif kind == "point":
@@ -303,4 +332,6 @@ def load_flight_records(path: str) -> List[Dict]:
                 records[-1]["final"] = obj.get("snapshot", {})
             elif kind == "trace_event":
                 records[-1]["trace_tail"].append(obj)
+            elif kind == "fleet_event":
+                records[-1]["fleet_events"].append(obj.get("event", obj))
     return records

@@ -149,6 +149,19 @@ def build_parser() -> argparse.ArgumentParser:
                              " 'Reproducibility'): 'seed' releases batches"
                              " in plan order so the stream digest is"
                              " bit-identical across configurations")
+    parser.add_argument("--service-address", default=None,
+                        metavar="HOST:PORT",
+                        help="read through the disaggregated ingest service"
+                        " at this dispatcher instead of a local pool"
+                        " (failover list 'a:p,b:p' accepted)")
+    parser.add_argument("--trace-items", type=int, default=None, metavar="N",
+                        help="arm per-item DISTRIBUTED tracing on the"
+                        " service plane: every Nth item carries a trace"
+                        " context through client/dispatcher/worker; the"
+                        " merged cross-process timeline lands in"
+                        " --trace-out and the service.hop.* decomposition"
+                        " renders in --watch (needs --service-address;"
+                        " default off)")
     parser.add_argument("--stream-digest", action="store_true",
                         help="print the run's stream certificate as a"
                              " machine-parseable 'stream_digest ...' line -"
@@ -174,6 +187,8 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                   cache_location: Optional[str] = None,
                   shuffle_seed: Optional[int] = None,
                   deterministic: str = "auto",
+                  service_address: Optional[str] = None,
+                  trace_items=None,
                   on_reader=None) -> dict:
     """Read ``dataset_url`` with telemetry enabled; returns a result dict
     with ``rows``, ``batches``, ``snapshot``, ``report``,
@@ -208,6 +223,7 @@ def run_diagnosis(dataset_url: str, method: str = "batch",
                  flight_record_path=flight_record_path,
                  sample_interval_s=sample_interval_s,
                  cache_type=cache_type, cache_location=cache_location,
+                 service_address=service_address, trace_items=trace_items,
                  autotune=autotune or None) as reader:
         if on_reader is not None:
             on_reader(reader)
@@ -392,6 +408,22 @@ def render_watch_frame(point: Dict, diagnostics: Optional[Dict] = None,
                 f"  connected {gauges.get('service.connected', 0):g}")
         else:
             lines.append("service: (no samples yet)")
+    hops = point.get("hops", {})
+    if hops:
+        # per-hop latency decomposition of traced service items, in wire
+        # order (the seven legs telescope to the end-to-end 'total')
+        hop_order = ("client_serialize", "dispatcher_queue", "relay",
+                     "worker_queue", "worker_exec", "return_relay",
+                     "client_deserialize", "total")
+        ordered_hops = [h for h in hop_order if h in hops]
+        ordered_hops += sorted(set(hops) - set(hop_order))
+        parts = []
+        for name in ordered_hops:
+            h = hops[name]
+            p50 = h.get("p50_s")
+            parts.append(f"{name}={p50 * 1e3:.1f}ms"
+                         if p50 is not None else f"{name}=-")
+        lines.append("hops p50 (traced items): " + "  ".join(parts))
     faults = {n: v for n, v in counters.items()
               if n.startswith(_WATCH_FAULT_PREFIXES) and v}
     if faults:
@@ -448,6 +480,8 @@ def _watch(args, url: str, chaos) -> int:
                 cache_location=args.cache_location,
                 shuffle_seed=args.seed,
                 deterministic=args.deterministic,
+                service_address=args.service_address,
+                trace_items=args.trace_items,
                 on_reader=lambda r: reader_box.update(reader=r))
         except BaseException as exc:  # noqa: BLE001 - reported on main thread
             box["error"] = exc
@@ -670,7 +704,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                cache_type=args.cache_type,
                                cache_location=args.cache_location,
                                shuffle_seed=args.seed,
-                               deterministic=args.deterministic)
+                               deterministic=args.deterministic,
+                               service_address=args.service_address,
+                               trace_items=args.trace_items)
         if args.trace_out:
             result["telemetry"].export_chrome_trace(args.trace_out)
         if args.json:

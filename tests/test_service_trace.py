@@ -1,0 +1,578 @@
+"""Fleet-wide distributed tracing + unified observability plane (ISSUE
+19): trace-context propagation through the v2 wire, client-side span merge
+and ``service.hop.*`` latency decomposition, heartbeat histogram/event
+folding into the dispatcher's fleet aggregation point (``fleet?`` /
+``events?`` frames, per-worker Prometheus, ``stats --watch``), and the
+cross-process flight-recorder enrichment."""
+
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.pool import VentilatedItem
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.schema import Field, Schema
+from petastorm_tpu.service.client import ServiceExecutor
+from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.service.protocol import WireItem, connect_frames
+from petastorm_tpu.service.worker import ServiceWorker
+from petastorm_tpu.telemetry import Telemetry
+from petastorm_tpu.telemetry.export import (render_fleet_prometheus,
+                                            render_prometheus)
+from petastorm_tpu.telemetry.report import (hist_quantile,
+                                            merge_hist_snapshots)
+from petastorm_tpu.telemetry.sampler import (MetricsSampler,
+                                             dump_flight_record,
+                                             flight_record,
+                                             load_flight_records)
+from petastorm_tpu.telemetry.trace import TraceBuffer
+from petastorm_tpu.test_util.matrix import (MatrixCell, run_cell,
+                                            service_fleet)
+
+
+def _wait_for(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def int_dataset(tmp_path):
+    url = str(tmp_path / "ds")
+    schema = Schema("TraceInts", [Field("x", np.int64)])
+    write_dataset(url, schema, [{"x": i} for i in range(200)],
+                  row_group_size_rows=10)
+    return url
+
+
+def _traced_read(url, addr, tele, **kwargs):
+    with make_batch_reader(url, service_address=addr,
+                           shuffle_row_groups=False, telemetry=tele,
+                           trace_items=1, **kwargs) as reader:
+        rows = sorted(x for b in reader.iter_batches()
+                      for x in b.columns["x"])
+        diag = reader.diagnostics
+    return rows, diag
+
+
+# -- wire: trace context propagation ------------------------------------------
+
+def test_wireitem_trace_context_roundtrip():
+    """An armed item's ``tc`` survives encode -> to_wire -> from_wire with
+    appended hop stamps intact; untraced items carry NO tc key (tracing is
+    free on the wire when disarmed)."""
+    item = VentilatedItem(7, ("payload", 7))
+    plain = WireItem.encode(item)
+    assert "tc" not in plain
+    armed = WireItem.encode(item, trace_id=7)
+    assert armed["tc"] == {"id": 7, "hops": []}
+    wi = WireItem.from_wire(armed)
+    wi.tc["hops"].append(["d", "recv", 0, 123456789, 0])
+    wi.tc["hops"].append(["w0", "done", 0, 123456999, -42])
+    out = WireItem.from_wire(wi.to_wire())
+    assert out.tc["id"] == 7
+    assert out.tc["hops"] == [["d", "recv", 0, 123456789, 0],
+                              ["w0", "done", 0, 123456999, -42]]
+    # a malformed tc (non-dict) is dropped, not fatal
+    bad = dict(armed, tc=[1, 2])
+    assert WireItem.from_wire(bad).tc is None
+
+
+def test_trace_buffer_process_tracks():
+    """Spans carrying a synthetic pid/proc render as their own named
+    process track in the Chrome export, and ``tail()`` carries the proc."""
+    buf = TraceBuffer(max_events=64)
+    buf.add("local", "service.trace", 1000, 10)
+    buf.add("remote", "service.trace", 2000, 20,
+            pid=900001, proc="worker:w0", tid=1)
+    trace = buf.chrome_trace()
+    names = [e for e in trace["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert any(e["pid"] == 900001
+               and e["args"]["name"] == "worker:w0" for e in names)
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert {s["pid"] for s in spans} == {spans[0]["pid"], 900001}
+    tail = buf.tail(10)
+    assert any(t.get("proc") == "worker:w0" for t in tail)
+
+
+def test_client_merges_hop_timeline_into_spans_and_hops():
+    """Unit: ``_finish_trace`` on a canned returned timeline - remote
+    stamps map through the handshake offset into client-clock spans on
+    per-process tracks, a requeued attempt opens a SECOND annotated span
+    tree under the SAME trace id, and the seven ``service.hop.*``
+    histograms telescope exactly to the end-to-end latency."""
+    tele = Telemetry()
+    ex = ServiceExecutor("127.0.0.1:1", telemetry=tele, trace_items=1)
+    ex._disp_clock_offset_ns = 1000  # dispatcher clock = ours + 1000
+    ms = 1_000_000
+    put, sent, recv, done = 0, 1 * ms, 20 * ms, 21 * ms
+    ex._traces[5] = {"id": 5, "put_ns": put, "sent_ns": sent}
+    woff = 500  # worker offset to the DISPATCHER clock
+    d = 1000    # dispatcher-clock stamps: ours + 1000
+
+    def w(t_ns):  # worker-clock stamp for client-clock time t_ns
+        return t_ns + 1000 - woff
+
+    hops = [
+        # attempt 0: assigned to w0, which died mid-item
+        ["d", "recv", 0, 2 * ms + d, 0],
+        ["d", "assign", 0, 3 * ms + d, 0],
+        ["w0", "recv", 0, w(4 * ms), woff],
+        ["w0", "start", 0, w(5 * ms), woff],
+        # attempt 1: requeued to w1, which completed
+        ["d", "requeue", 1, 8 * ms + d, 0],
+        ["d", "assign", 1, 9 * ms + d, 0],
+        ["w1", "recv", 1, w(10 * ms), woff],
+        ["w1", "start", 1, w(11 * ms), woff],
+        ["w1", "done", 1, w(17 * ms), woff],
+        ["d", "relay", 1, 18 * ms + d, 0],
+    ]
+    ex._finish_trace({"ordinal": 5, "attempt": 1},
+                     {"id": 5, "hops": hops}, recv, done)
+    spans = {}
+    for name, _cat, _tid, start, dur, args, pid in tele.trace._events:
+        spans.setdefault(name, []).append((start, dur, args, pid))
+    # both attempts under one trace id, requeue annotated
+    assert [a["trace_id"] for lst in spans.values()
+            for (_s, _d, a, _p) in lst if "trace_id" in a] \
+        == [5] * sum(len(v) for v in spans.values())
+    assert spans["dispatch.queue"][0][2]["requeued"] is False
+    assert spans["dispatch.requeue"][0][2]["requeued"] is True
+    # offset mapping: the first dispatcher recv stamp lands at 2ms ours
+    assert spans["dispatch.queue"][0][0] == 2 * ms
+    # worker spans ride the worker's synthetic process track
+    w0_pid = spans["worker.queue"][0][3]
+    w1_pid = spans["worker.queue"][1][3]
+    assert w0_pid != w1_pid
+    assert spans["worker.exec"][0][3] == w1_pid  # only w1 reached exec
+    assert spans["worker.exec"][0][0] == 11 * ms
+    assert spans["worker.exec"][0][1] == 6 * ms
+    # hop decomposition telescopes exactly to done - put
+    hists = tele.snapshot()["histograms"]
+    hop = {n[len("service.hop."):]: h["sum"]
+           for n, h in hists.items() if n.startswith("service.hop.")}
+    parts = ("client_serialize", "dispatcher_queue", "relay",
+             "worker_queue", "worker_exec", "return_relay",
+             "client_deserialize")
+    assert set(parts) <= set(hop)
+    assert sum(hop[p] for p in parts) == pytest.approx(hop["total"])
+    assert hop["total"] == pytest.approx((done - put) / 1e9)
+    # dispatcher_queue absorbed the dead first attempt (sent -> assign#1)
+    assert hop["dispatcher_queue"] == pytest.approx(8 * ms / 1e9)
+
+
+def test_trace_disarmed_by_default_and_validated():
+    """Tracing is default-off (no tc on the wire, no registry) and
+    ``trace_items`` without a service plane is a loud reader error."""
+    ex = ServiceExecutor("127.0.0.1:1", telemetry=Telemetry())
+    assert ex._trace_every == 0 and not ex._tracing
+    assert ex.diagnostics["trace_items"] == 0
+    # bool True -> 1-in-16 sampling
+    ex16 = ServiceExecutor("127.0.0.1:1", telemetry=Telemetry(),
+                           trace_items=True)
+    assert ex16._trace_every == 16
+    with pytest.raises(PetastormTpuError, match="trace_items"):
+        make_batch_reader("file:///nonexistent", trace_items=4)
+
+
+# -- end-to-end: one item's whole cross-process life --------------------------
+
+def test_trace_end_to_end_merged_timeline(int_dataset):
+    """Acceptance core: a traced read through a real fleet yields ONE
+    Chrome trace whose spans cover >= 3 distinct processes (client,
+    dispatcher, both workers), and the hop decomposition sums (within
+    tolerance) to the observed end-to-end latency."""
+    with service_fleet(n_workers=2) as (_disp, addr, _workers):
+        tele = Telemetry()
+        rows, diag = _traced_read(int_dataset, addr, tele)
+    assert rows == list(range(200))
+    assert diag["trace_items"] == 1
+    trace = tele.trace.chrome_trace()
+    spans = [e for e in trace["traceEvents"]
+             if e.get("cat") == "service.trace" and e.get("ph") == "X"]
+    procs = {e["pid"] for e in spans}
+    assert len(procs) >= 3, f"expected client+dispatcher+worker: {procs}"
+    named = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert any(n.startswith("dispatcher@") for n in named), named
+    assert any(n.startswith("worker:") for n in named), named
+    kinds = {e["name"] for e in spans}
+    assert {"service.item", "dispatch.queue", "worker.exec",
+            "return.relay"} <= kinds
+    hists = tele.snapshot()["histograms"]
+    hop = {n[len("service.hop."):]: h
+           for n, h in hists.items() if n.startswith("service.hop.")}
+    parts = ("client_serialize", "dispatcher_queue", "relay",
+             "worker_queue", "worker_exec", "return_relay",
+             "client_deserialize")
+    assert set(parts) <= set(hop), hop.keys()
+    # every item recorded the full chain: all parts saw every traced item
+    assert len({hop[p]["count"] for p in parts}) == 1
+    total = hop["total"]["sum"]
+    decomposed = sum(hop[p]["sum"] for p in parts)
+    assert decomposed == pytest.approx(total, rel=0.05), \
+        (decomposed, total)
+
+
+@pytest.mark.slow
+def test_trace_sigkill_requeue_same_trace_id(int_dataset):
+    """Satellite: SIGKILL a worker subprocess mid-item - the merged trace
+    for a requeued item shows the retry as a SECOND span tree under the
+    SAME trace id, annotated as a requeue."""
+    with service_fleet(n_workers=2, subprocess_workers=True) \
+            as (disp, addr, procs):
+        tele = Telemetry()
+        done = threading.Event()
+        out = {}
+
+        def read():
+            try:
+                out["rows"] = _traced_read(int_dataset, addr, tele)[0]
+            finally:
+                done.set()
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        _wait_for(lambda: any(
+            w.get("inflight", 0) > 0
+            for w in disp.stats()["workers"].values()),
+            timeout=30.0, what="a worker holding in-flight work")
+        procs[0].send_signal(signal.SIGKILL)
+        assert done.wait(timeout=120)
+        t.join(timeout=5)
+    assert out["rows"] == list(range(200))
+    assert disp.stats()["counters"].get("service.requeued_items", 0) >= 1
+    spans = [(name, args) for name, cat, _tid, _s, _d, args, _pid
+             in tele.trace._events if cat == "service.trace"]
+    requeues = [a for n, a in spans if n == "dispatch.requeue"]
+    assert requeues, "requeued attempt must surface as its own span"
+    tid = requeues[0]["trace_id"]
+    # the same trace id carries BOTH attempts' trees
+    attempts = {a.get("attempt") for n, a in spans
+                if a.get("trace_id") == tid and "attempt" in a}
+    assert len(attempts) >= 2, attempts
+    # the dispatcher's requeue landed in the fleet event log too
+    kinds = [e["kind"] for e in disp.events_tail()]
+    assert "requeue" in kinds or "worker_gone" in kinds, kinds
+
+
+def test_trace_rollover_span_on_dispatcher_failover(int_dataset):
+    """Dispatcher loss mid-read: the reconnect window surfaces in the
+    merged trace as an annotated ``service.rollover`` gap span."""
+    from petastorm_tpu.retry import RetryPolicy
+    from petastorm_tpu.test_util.matrix import recoverable_fleet
+
+    with recoverable_fleet(n_workers=2) as fleet:
+        tele = Telemetry()
+        with make_batch_reader(int_dataset, service_address=fleet.address,
+                               shuffle_row_groups=False, telemetry=tele,
+                               trace_items=1) as reader:
+            reader._executor._reconnect_policy = RetryPolicy(
+                max_attempts=40, initial_backoff_s=0.05,
+                backoff_multiplier=1.5, max_backoff_s=0.5)
+            it = reader.iter_batches()
+            rows = []
+            for _ in range(4):
+                rows.extend(next(it).columns["x"])
+            fleet.restart_dispatcher(downtime_s=0.2)
+            rows.extend(x for b in it for x in b.columns["x"])
+    assert sorted(rows) == list(range(200))
+    rollovers = [(dur, args) for name, cat, _tid, _s, dur, args, _pid
+                 in tele.trace._events if name == "service.rollover"]
+    assert rollovers, "reconnect must emit an annotated rollover span"
+    dur, args = rollovers[0]
+    assert dur > 0 and args["attempts"] >= 1
+    assert "address" in args and "epoch" in args
+
+
+def test_determinism_tracing_on_off_bit_identical(int_dataset):
+    """Satellite: arming tracing must not perturb the delivered stream -
+    tracing-on and tracing-off digests are bit-identical."""
+    with service_fleet(n_workers=2) as (_disp, addr, _workers):
+        plain = run_cell(int_dataset, 1234, MatrixCell(transport="service"),
+                         num_epochs=2, service_address=addr)
+        traced = run_cell(int_dataset, 1234,
+                          MatrixCell(transport="service"),
+                          num_epochs=2, service_address=addr,
+                          reader_kwargs={"trace_items": 1,
+                                         "telemetry": Telemetry()})
+    assert traced.digest == plain.digest
+    assert traced.rows == plain.rows
+
+
+# -- fleet aggregation plane --------------------------------------------------
+
+def test_fleet_stats_folds_heartbeat_hists_and_frames(int_dataset):
+    """Worker heartbeats piggyback stage/hop histogram snapshots; the
+    dispatcher folds them into ``fleet_stats()`` (per-worker + merged) and
+    serves the whole thing over one-shot ``fleet?`` / ``events?`` /
+    ``event`` frames."""
+    disp = Dispatcher(telemetry=Telemetry(), heartbeat_timeout_s=5.0).start()
+    addr = f"127.0.0.1:{disp.port}"
+    workers = [ServiceWorker(addr, capacity=2, name=f"fw{i}",
+                             heartbeat_interval_s=0.2,
+                             telemetry=Telemetry())
+               for i in range(2)]
+    threads = [threading.Thread(target=w.run, daemon=True) for w in workers]
+    for t in threads:
+        t.start()
+    try:
+        _wait_for(lambda: len(disp.stats()["workers"]) == 2)
+        rows, _diag = _traced_read(int_dataset, addr, Telemetry())
+        assert rows == list(range(200))
+        _wait_for(lambda: any(
+            w.get("hists") for w in disp.fleet_stats()["workers"].values()),
+            what="heartbeat histogram fold")
+        fleet = disp.fleet_stats()
+        assert set(fleet["workers"]) == {"fw0", "fw1"}
+        some = [w for w in fleet["workers"].values() if w["hists"]]
+        assert some and all(
+            {"count", "p50_s", "p99_s"} <= set(next(iter(w["hists"]
+                                                         .values())))
+            for w in some)
+        merged = fleet["merged_hists"]
+        assert merged, "fleet-merged histograms must exist"
+        name, m = next(iter(merged.items()))
+        assert m["count"] > 0 and "snapshot" in m
+        # heartbeat counter deltas folded fleet-wide (prefix stripped)
+        assert fleet["fleet_counters"].get("worker.rowgroups_decoded",
+                                           0) > 0, fleet["fleet_counters"]
+        # one-shot frames
+        conn = connect_frames(("127.0.0.1", disp.port), timeout=5.0)
+        try:
+            conn.send({"t": "fleet?"})
+            reply = conn.recv(timeout=5.0)
+        finally:
+            conn.close()
+        assert reply["t"] == "fleet"
+        assert set(reply["fleet"]["workers"]) == {"fw0", "fw1"}
+        conn = connect_frames(("127.0.0.1", disp.port), timeout=5.0)
+        try:
+            conn.send({"t": "event", "kind": "autoscale.scale_up",
+                       "src": "autoscale", "spawned": 1})
+            assert conn.recv(timeout=5.0)["t"] == "event_ok"
+        finally:
+            conn.close()
+        conn = connect_frames(("127.0.0.1", disp.port), timeout=5.0)
+        try:
+            conn.send({"t": "events?", "n": 8})
+            events = conn.recv(timeout=5.0)["events"]
+        finally:
+            conn.close()
+        assert any(e["kind"] == "autoscale.scale_up"
+                   and e["src"] == "autoscale" for e in events)
+    finally:
+        for w in workers:
+            w.stop()
+        disp.stop()
+        disp.join()
+
+
+def test_event_log_sanitizes_peer_events():
+    """A peer cannot bloat the bounded fleet log: non-scalar fields drop,
+    strings truncate, field count caps at 8."""
+    disp = Dispatcher(telemetry=Telemetry())
+    try:
+        disp._on_peer_event({"t": "event", "kind": "x" * 100,
+                             "src": "rogue", "long": "y" * 500,
+                             "nested": {"a": 1}, "token": "secret",
+                             **{f"f{i}": i for i in range(12)}})
+        ev = disp.events_tail()[-1]
+        assert len(ev["kind"]) == 64
+        assert len(ev["long"]) == 200
+        assert "nested" not in ev and "token" not in ev
+        assert len([k for k in ev if k not in ("ts", "src", "kind")]) <= 8
+        # junk is ignored outright
+        disp._on_peer_event({"t": "event"})
+        disp._on_peer_event("not a dict")
+        assert disp.events_tail()[-1] is ev
+    finally:
+        disp.stop()
+        disp.join()
+
+
+def test_stats_ha_section_reports_standby_sync():
+    """Satellite: ``stats()`` carries the HA sync view - role, fencing
+    epoch, journal position, and per-standby lag."""
+    disp = Dispatcher(telemetry=Telemetry())
+    try:
+        ha = disp.stats()["ha"]
+        assert ha["role"] == "primary"
+        assert ha["epoch"] == disp.epoch
+        assert ha["journal_seq"] >= 0
+        assert ha["standbys"] == {}
+        # a subscribed standby surfaces with its lag (jseq - synced_seq)
+        disp._standby_feeds["127.0.0.1:9999"] = max(
+            0, ha["journal_seq"] - 3)
+        lagged = disp.stats()["ha"]["standbys"]["127.0.0.1:9999"]
+        assert lagged["standby_lag_items"] == min(3, ha["journal_seq"])
+        assert "synced_seq" in lagged
+    finally:
+        disp.stop()
+        disp.join()
+
+
+# -- histogram merge / quantile units -----------------------------------------
+
+def test_merge_hist_snapshots_and_quantile():
+    tele = Telemetry()
+    h = tele.histogram("service.hop.worker_exec")
+    for v in (0.001, 0.002, 0.004, 0.008):
+        h.record(v)
+    snap = tele.snapshot()["histograms"]["service.hop.worker_exec"]
+    merged = merge_hist_snapshots([snap, snap])
+    assert merged["count"] == 2 * snap["count"]
+    assert merged["sum"] == pytest.approx(2 * snap["sum"])
+    assert hist_quantile(merged, 0.5) == pytest.approx(
+        hist_quantile(snap, 0.5))
+    assert hist_quantile({}, 0.5) == 0.0
+    # mismatched bucket bounds are skipped, not crashed on
+    other = dict(snap, buckets=[1.0, 2.0], counts=[1, 1, 1])
+    still = merge_hist_snapshots([snap, other])
+    assert still["count"] == snap["count"]
+    assert merge_hist_snapshots([]) == {"buckets": [], "counts": [],
+                                        "sum": 0.0, "count": 0} \
+        or merge_hist_snapshots([])["count"] == 0
+
+
+# -- exporters / renderers ----------------------------------------------------
+
+def test_prometheus_exposes_hop_families():
+    tele = Telemetry()
+    tele.histogram("service.hop.worker_exec").record(0.004)
+    tele.histogram("service.hop.total").record(0.01)
+    body = render_prometheus(tele.snapshot())
+    assert 'petastorm_tpu_service_hop_ops_total{hop="worker_exec"} 1' \
+        in body
+    assert 'petastorm_tpu_service_hop_latency_seconds{hop="worker_exec"' \
+        in body
+    assert 'quantile="0.99"' in body
+
+
+def test_render_fleet_prometheus_per_worker_labels():
+    fleet = {
+        "epoch": 3,
+        "workers": {
+            "w0": {"busy": 1, "capacity": 2, "inflight": 1,
+                   "heartbeat_age_s": 0.4,
+                   "counters": {"service.fleet.worker.items_completed": 9},
+                   "hists": {"service.hop.worker_exec":
+                             {"count": 9, "p50_s": 0.004, "p99_s": 0.02}}},
+            "w1": {"busy": 0, "capacity": 2, "inflight": 0,
+                   "heartbeat_age_s": 0.1, "counters": {}, "hists": {}},
+        },
+        "merged_hists": {"service.hop.worker_exec":
+                         {"count": 9, "p50_s": 0.004, "p99_s": 0.02}},
+        "fleet_counters": {"service.fleet.worker.items_completed": 9},
+    }
+    body = render_fleet_prometheus(fleet)
+    assert 'petastorm_tpu_fleet_worker_up{worker="w0"} 1' in body
+    assert 'petastorm_tpu_fleet_worker_up{worker="w1"} 1' in body
+    assert 'petastorm_tpu_fleet_worker_counter_total{worker="w0"' in body
+    assert ('petastorm_tpu_fleet_worker_latency_seconds{worker="w0",'
+            'hist="service.hop.worker_exec",quantile="0.5"}') in body
+    assert 'petastorm_tpu_fleet_latency_seconds{' in body
+    assert "petastorm_tpu_fleet_epoch 3" in body
+    assert render_fleet_prometheus({}) == ""
+
+
+def test_render_fleet_frame_from_canned_dicts():
+    from petastorm_tpu.service.cli import render_fleet_frame
+
+    stats = {"ha": {"role": "primary", "epoch": 2, "journal_seq": 40,
+                    "standbys": {"sb": {"synced_seq": 37,
+                                        "standby_lag_items": 3}}}}
+    fleet = {
+        "epoch": 2, "uptime_s": 12.0,
+        "workers": {"w0": {"busy": 1, "capacity": 2, "inflight": 1,
+                           "heartbeat_age_s": 0.3, "draining": False,
+                           "counters": {"service.fleet.worker"
+                                        ".items_completed": 100},
+                           "hists": {"service.hop.worker_exec":
+                                     {"count": 10, "p50_s": 0.004,
+                                      "p99_s": 0.02}}}},
+        "merged_hists": {"service.hop.worker_exec":
+                         {"count": 10, "p50_s": 0.004, "p99_s": 0.02}},
+        "fleet_counters": {"service.fleet.worker.items_completed": 100},
+        "events": [{"ts": 1.0, "src": "autoscale",
+                    "kind": "autoscale.scale_up", "spawned": 1}],
+        "scaling": {"verdict": "hold"},
+    }
+    prev = {"fleet_counters": {"service.fleet.worker.items_completed": 50}}
+    frame = render_fleet_frame(stats, fleet, prev_fleet=prev, dt_s=2.0,
+                               elapsed_s=4.0)
+    assert "petastorm-tpu fleet" in frame and "workers=1" in frame
+    assert "primary" in frame and "lag" in frame
+    assert "w0" in frame and "4.0" in frame  # exec p50 in ms
+    assert "worker_exec" in frame
+    assert "autoscale.scale_up" in frame
+    # rates line from counter deltas: (100-50)/2s = 25/s
+    assert "25.0" in frame
+    # unreachable probes render a degraded frame, not a crash
+    assert "workers=0" in render_fleet_frame(None, None)
+
+
+def test_diagnose_watch_renders_hop_line():
+    from petastorm_tpu.tools.diagnose import render_watch_frame
+
+    point = {"dt_s": 1.0, "rates": {}, "counters": {},
+             "hops": {"worker_exec": {"count": 4, "p50_s": 0.004,
+                                      "p99_s": 0.02},
+                      "total": {"count": 4, "p50_s": 0.01,
+                                "p99_s": 0.05}}}
+    frame = render_watch_frame(point)
+    assert "hops p50" in frame
+    assert "worker_exec=4.0ms" in frame
+    assert "total=10.0ms" in frame
+    # hopless points render no hops line
+    assert "hops p50" not in render_watch_frame({"dt_s": 1.0})
+
+
+# -- sampler point + flight-record enrichment ---------------------------------
+
+def test_sampler_point_hops_and_flight_record_fleet_events(tmp_path):
+    tele = Telemetry()
+    sampler = MetricsSampler(tele, interval_s=60.0)
+    sampler.sample_now()  # establishes the baseline snapshot
+    tele.histogram("service.hop.worker_exec").record(0.004)
+    time.sleep(0.005)     # sample_now skips sub-millisecond intervals
+    point = sampler.sample_now()
+    assert point["hops"]["worker_exec"]["count"] == 1
+    assert point["hops"]["worker_exec"]["p50_s"] > 0
+    events = [{"ts": 1.0, "src": "dispatcher", "kind": "item_requeued",
+               "ordinal": 3}]
+    record = flight_record(sampler, reason="test", fleet_events=events)
+    assert record["fleet_events"] == events
+    path = dump_flight_record(record, str(tmp_path / "fr.jsonl"))
+    loaded = load_flight_records(path)[-1]
+    assert loaded["fleet_events"] == events
+    assert loaded["reason"] == "test"
+
+
+def test_flight_record_on_failure_carries_fleet_events(int_dataset):
+    """The crash-artifact path end to end: a terminal service failure
+    fetches the dispatcher's event tail into the reader's flight record."""
+    with service_fleet(n_workers=2) as (disp, addr, _workers):
+        disp._event("requeue", client="c0", ordinal=7, attempt=1)
+        from petastorm_tpu.test_util.chaos import ChaosSpec
+
+        with pytest.raises(Exception):  # noqa: B017 - any terminal failure
+            with make_batch_reader(
+                    int_dataset, service_address=addr,
+                    shuffle_row_groups=False, telemetry=Telemetry(),
+                    chaos=ChaosSpec(decode_fail_ordinals=tuple(range(20))),
+                    on_error="raise") as reader:
+                list(reader.iter_batches())
+        record = reader._flight_record
+        assert record is not None
+        kinds = [e["kind"] for e in record["fleet_events"]]
+        assert "requeue" in kinds

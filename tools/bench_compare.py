@@ -80,6 +80,19 @@ time-to-90% - the planner's cold-start win; ``planner_time_to_90pct_seconds``
 is the seeded arm's absolute t90 (lower is better via the ``time_to``
 marker).
 
+Tracing metrics (ISSUE 19, docs/operations.md "Distributed tracing &
+fleet view"): ``service_trace_armed_vs_untraced_ratio`` prices arming
+per-item distributed tracing (``trace_items=8`` - 1-in-8 wire items carry
+a trace context and collect per-hop monotonic stamps at dispatcher and
+workers, merged client-side into spans + ``service.hop.*`` histograms)
+against the identical untraced fleet read, interleaved in the same
+session (drift-immune).  Absolute floor 0.98 = the <= 2% overhead
+acceptance bar: tracing is meant to be cheap enough to leave sampled-on
+in production, so a candidate below the floor fails an armed gate even
+against a baseline that was already below it.  The two absolute-rate
+members (``service_trace_armed_samples_per_sec`` /
+``service_untraced_anchor_samples_per_sec``) drift with the host.
+
 Autoscale metrics (BENCH_r12+, docs/operations.md "Fleet autoscaling &
 QoS"): ``autoscale_vs_static_ratio`` prices the closed loop - an
 undersized 1-worker fleet plus a live ``AutoscaleSupervisor`` over a
@@ -134,6 +147,9 @@ ABSOLUTE_FLOORS = {
     # steady-state delivery at least 1.2x sooner than the runtime loop
     # climbing from bad static knobs
     "planner_cold_start_ratio": 1.2,
+    # ISSUE 19: arming per-item distributed tracing (trace_items=8) must
+    # cost <= 2% of untraced fleet throughput in the same session
+    "service_trace_armed_vs_untraced_ratio": 0.98,
 }
 
 
